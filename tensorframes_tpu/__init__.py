@@ -1,0 +1,144 @@
+"""tensorframes_tpu — a TPU-native columnar-frame compute framework.
+
+A brand-new framework with the capabilities of TensorFrames (the reference,
+databricks/tensorframes): attach numeric programs to the columns of a
+distributed dataframe through five verbs — ``map_rows``, ``map_blocks``
+(± trimmed), ``reduce_rows``, ``reduce_blocks``, keyed ``aggregate`` — plus
+schema tooling (``analyze``, ``append_shape``, ``print_schema``).
+
+Architecture (TPU-first, not a port — see SURVEY.md §7):
+
+* a frame is a block-partitioned columnar container of arrays
+  (host numpy and/or device ``jax.Array`` shards over a mesh), not a Spark
+  DataFrame;
+* a user program is a traced JAX function / expression graph
+  (jaxpr / StableHLO), not a protobuf ``GraphDef`` fed to a TF Session;
+* distribution is ``jax.sharding`` + ``shard_map`` with ICI collectives,
+  not driver-coordinated ``RDD.reduce`` / Catalyst shuffles.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+from .config import get_config as _get_config, configure  # noqa: F401
+
+if _get_config().enable_x64:
+    # The reference's core column types are Double/Long
+    # (datatypes.scala:265-267); x64 makes those exact end-to-end.
+    _jax.config.update("jax_enable_x64", True)
+
+from . import dtypes  # noqa: E402,F401
+from .shape import Shape, Unknown  # noqa: E402,F401
+from .schema import ColumnInfo, Schema  # noqa: E402,F401
+from .frame import TensorFrame, frame_from_arrays, frame_from_pandas, frame_from_rows  # noqa: E402,F401
+from .frame import analyze, append_shape, print_schema, explain  # noqa: E402,F401
+from .dsl import (  # noqa: E402,F401
+    Node,
+    abs_,
+    add,
+    apply_fn,
+    block,
+    constant,
+    div,
+    exp,
+    fill,
+    identity,
+    log,
+    matmul,
+    mul,
+    ones,
+    placeholder,
+    reduce_max,
+    reduce_mean,
+    reduce_min,
+    reduce_sum,
+    relu,
+    row,
+    scope,
+    sigmoid,
+    sqrt,
+    square,
+    sub,
+    tanh,
+    with_graph,
+    zeros,
+)
+from .program import (  # noqa: E402,F401
+    Program,
+    TensorSpec,
+    load_program,
+    program_from_function,
+    save_program,
+)
+from .validation import ValidationError  # noqa: E402,F401
+from .ops.verbs import (  # noqa: E402,F401
+    aggregate,
+    map_blocks,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "TensorFrame",
+    "frame_from_arrays",
+    "frame_from_pandas",
+    "frame_from_rows",
+    "Shape",
+    "Unknown",
+    "ColumnInfo",
+    "Schema",
+    "dtypes",
+    "configure",
+    # verbs (≙ reference __init__.py:15-21 public surface)
+    "map_rows",
+    "map_blocks",
+    "reduce_rows",
+    "reduce_blocks",
+    "aggregate",
+    "analyze",
+    "append_shape",
+    "print_schema",
+    "explain",
+    # dsl / placeholder helpers
+    "Node",
+    "block",
+    "row",
+    "placeholder",
+    "constant",
+    "zeros",
+    "ones",
+    "fill",
+    "with_graph",
+    "scope",
+    # op catalog
+    "identity",
+    "add",
+    "sub",
+    "mul",
+    "div",
+    "matmul",
+    "reduce_sum",
+    "reduce_min",
+    "reduce_max",
+    "reduce_mean",
+    "exp",
+    "log",
+    "tanh",
+    "sqrt",
+    "abs_",
+    "square",
+    "sigmoid",
+    "relu",
+    "apply_fn",
+    # programs
+    "Program",
+    "TensorSpec",
+    "program_from_function",
+    "save_program",
+    "load_program",
+    "ValidationError",
+]

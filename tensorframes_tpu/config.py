@@ -1,0 +1,64 @@
+"""Runtime configuration for tensorframes_tpu.
+
+The reference has no runtime config system (SURVEY.md §5-config); its only
+knobs are per-call ``ShapeDescription`` hints. The TPU build adds a small,
+explicit config object because compilation behavior (padding buckets, x64,
+default mesh axis names) genuinely needs global knobs on XLA.
+
+All values can be overridden via environment variables (``TFTPU_*``) or
+programmatically via :func:`configure`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if v is None else int(v)
+
+
+@dataclasses.dataclass
+class Config:
+    # Enable float64/int64 end-to-end (the reference's Double/Long columns).
+    enable_x64: bool = _env_bool("TFTPU_ENABLE_X64", True)
+    # Pad block row-counts up to powers of two between these bounds so jit
+    # caches stay small (XLA wants static shapes; SURVEY.md §7 hard-part 1).
+    min_bucket: int = _env_int("TFTPU_MIN_BUCKET", 8)
+    max_bucket_doublings: int = _env_int("TFTPU_MAX_BUCKET_DOUBLINGS", 30)
+    # Default number of blocks when partitioning un-blocked input.
+    default_num_blocks: int = _env_int("TFTPU_DEFAULT_NUM_BLOCKS", 4)
+    # Mesh axis names used by sharded execution.
+    batch_axis: str = os.environ.get("TFTPU_BATCH_AXIS", "dp")
+    # aggregate(): rows buffered before compaction in the streaming keyed
+    # aggregator (≙ TensorFlowUDAF bufferSize=10, DebugRowOps.scala:580).
+    aggregate_buffer_size: int = _env_int("TFTPU_AGG_BUFFER", 10)
+    # Per-verb timing metrics collection (upgrade over the reference's
+    # log4j-only observability, SURVEY.md §5-tracing).
+    collect_metrics: bool = _env_bool("TFTPU_METRICS", True)
+
+
+_config = Config()
+
+
+def get_config() -> Config:
+    return _config
+
+
+def configure(**kwargs) -> Config:
+    """Update global config fields by keyword; returns the live config."""
+    for k, v in kwargs.items():
+        if not hasattr(_config, k):
+            raise AttributeError(f"No such config field: {k!r}")
+        setattr(_config, k, v)
+    return _config

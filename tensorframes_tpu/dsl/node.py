@@ -1,0 +1,403 @@
+"""The DSL expression graph: nodes, naming, and compilation to Programs.
+
+This is the TPU-native analogue of the reference's two graph-building
+surfaces at once:
+
+* the Python placeholder style (``x = tfs.block(df, "x"); z = tf.add(x, 3,
+  name='z')``, README.md:69-76) — here ``block``/``row`` return DSL nodes
+  that support operators and named ops;
+* the Scala DSL (``dsl/package.scala:17-134``: placeholder, constant,
+  zeros, ones, fill, identity, add, div, reduce_sum, reduce_min; operator
+  sugar and ``named``; ``dsl/Operation.scala``) with its scoped, counted
+  naming context (``dsl/Paths.scala:17-55`` — ``scope/name``, dedup as
+  ``name_1``, ``name_2``).
+
+Instead of emitting ``NodeDef`` protos to feed a TF Session, a fetch list
+compiles directly to a :class:`~tensorframes_tpu.program.Program` — a
+jit-traceable function evaluated under XLA. Graph *state* differs from the
+reference deliberately: naming counters live in an explicit context object
+(with a default global instance) and ``with_graph`` scopes/resets it, which
+doubles as the test-hygiene reset (≙ ``GraphScoping.testGraph``,
+dsl/GraphScoping.scala:8-15). Unlike the reference's ``Paths`` the context
+can be swapped thread-locally, removing the documented thread-unsafety
+(dsl/Paths.scala:10-11).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as dt
+from ..program import Program, TensorSpec, analyze_program
+from ..shape import Shape, Unknown
+
+
+class GraphContext:
+    """Naming state: scope stack + per-name dedup counters."""
+
+    def __init__(self):
+        self.scopes: List[str] = []
+        self.counters: Dict[str, int] = {}
+
+    def reset(self):
+        self.scopes.clear()
+        self.counters.clear()
+
+    def qualify(self, name: str) -> str:
+        return "/".join(self.scopes + [name]) if self.scopes else name
+
+    def unique(self, base: str) -> str:
+        """TF-style dedup: first use keeps ``base``, later uses get
+        ``base_1``, ``base_2``, … (≙ dsl/Paths.scala:40-55)."""
+        qualified = self.qualify(base)
+        n = self.counters.get(qualified, 0)
+        self.counters[qualified] = n + 1
+        return qualified if n == 0 else f"{qualified}_{n}"
+
+
+_tls = threading.local()
+
+
+def current_graph() -> GraphContext:
+    g = getattr(_tls, "graph", None)
+    if g is None:
+        g = GraphContext()
+        _tls.graph = g
+    return g
+
+
+@contextlib.contextmanager
+def with_graph():
+    """Fresh naming context for the duration of the block (recommended
+    scoping practice, README.md:133-135; test hygiene ≙ GraphScoping)."""
+    old = getattr(_tls, "graph", None)
+    _tls.graph = GraphContext()
+    try:
+        yield _tls.graph
+    finally:
+        _tls.graph = old
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Name scope: nodes created inside get ``name/`` prefixed
+    (≙ dsl/package.scala:32-33, Paths.withScope)."""
+    g = current_graph()
+    g.scopes.append(name)
+    try:
+        yield
+    finally:
+        g.scopes.pop()
+
+
+ConstLike = Union[int, float, bool, list, tuple, np.ndarray]
+
+
+class Node:
+    """One DSL graph node.
+
+    ``eval_fn`` consumes the evaluated parent arrays and produces this
+    node's array; placeholders instead read from the feed dict at
+    compile time.
+    """
+
+    def __init__(
+        self,
+        op: str,
+        parents: Sequence["Node"],
+        eval_fn: Optional[Callable],
+        name: Optional[str] = None,
+        dtype: Optional[dt.ScalarType] = None,
+        shape: Optional[Shape] = None,
+        reduce_axis: Optional[int] = None,
+    ):
+        g = current_graph()
+        self.op = op
+        self.parents = list(parents)
+        self.eval_fn = eval_fn
+        self.name = g.unique(name) if name else g.unique(op)
+        self.dtype = dtype
+        self.shape = shape
+        # set for algebraic reducers (reduce_sum/min/max/mean over axis 0);
+        # lets `aggregate` lower to vectorized segment ops.
+        self.reduce_axis = reduce_axis
+        self.is_placeholder = op == "placeholder"
+
+    # -- naming -------------------------------------------------------------
+    def named(self, name: str) -> "Node":
+        """Rename (≙ the DSL's ``named``, dsl/Operation.scala:30-38)."""
+        self.name = current_graph().qualify(name)
+        return self
+
+    def __repr__(self):
+        return f"Node({self.op}:{self.name})"
+
+    # -- operator sugar (≙ dsl/Implicits + Operation `+` `/`) ----------------
+    def _lift(self, other) -> "Node":
+        if isinstance(other, Node):
+            return other
+        return constant(other)
+
+    def __add__(self, other):
+        return add(self, self._lift(other))
+
+    def __radd__(self, other):
+        return add(self._lift(other), self)
+
+    def __sub__(self, other):
+        return sub(self, self._lift(other))
+
+    def __rsub__(self, other):
+        return sub(self._lift(other), self)
+
+    def __mul__(self, other):
+        return mul(self, self._lift(other))
+
+    def __rmul__(self, other):
+        return mul(self._lift(other), self)
+
+    def __truediv__(self, other):
+        return div(self, self._lift(other))
+
+    def __rtruediv__(self, other):
+        return div(self._lift(other), self)
+
+    def __neg__(self):
+        return unary("neg", jnp.negative, self)
+
+    def __pow__(self, other):
+        return binary("pow", jnp.power, self, self._lift(other))
+
+
+def placeholder(
+    dtype, shape, name: Optional[str] = None
+) -> Node:
+    """Explicit placeholder (≙ dsl/package.scala:45-50; tf.placeholder in
+    the Python path). ``shape`` entries may be None/-1 for Unknown."""
+    scalar = dtype if isinstance(dtype, dt.ScalarType) else dt.from_numpy(dtype)
+    return Node(
+        "placeholder",
+        [],
+        None,
+        name=name or "placeholder",
+        dtype=scalar,
+        shape=Shape.from_any(shape),
+    )
+
+
+def constant(value: ConstLike, name: Optional[str] = None) -> Node:
+    """Embed a constant (≙ dsl/package.scala:53-58; DenseTensor constants).
+    Python floats become float64, ints int64 — matching frame inference."""
+    arr = np.asarray(value)
+    scalar = dt.from_numpy(arr.dtype)
+    val = jnp.asarray(arr)
+    return Node(
+        "constant",
+        [],
+        lambda: val,
+        name=name or "constant",
+        dtype=scalar,
+        shape=Shape(arr.shape),
+    )
+
+
+def zeros(shape, dtype=np.float64, name=None) -> Node:
+    return constant(np.zeros(shape, dtype=dtype), name=name or "zeros")
+
+
+def ones(shape, dtype=np.float64, name=None) -> Node:
+    return constant(np.ones(shape, dtype=dtype), name=name or "ones")
+
+
+def fill(shape, value, name=None) -> Node:
+    return constant(np.full(shape, value), name=name or "fill")
+
+
+def unary(op: str, fn: Callable, x: Node, name=None) -> Node:
+    return Node(op, [x], fn, name=name)
+
+
+def binary(op: str, fn: Callable, x: Node, y: Node, name=None) -> Node:
+    return Node(op, [x, y], fn, name=name)
+
+
+# -- op catalog (superset of dsl/package.scala:110-132) ----------------------
+
+def identity(x: Node, name=None) -> Node:
+    return unary("identity", lambda v: v, x, name=name)
+
+
+def add(x: Node, y, name=None) -> Node:
+    return binary("add", jnp.add, x, x._lift(y) if not isinstance(y, Node) else y, name=name)
+
+
+def sub(x: Node, y, name=None) -> Node:
+    return binary("sub", jnp.subtract, x, x._lift(y) if not isinstance(y, Node) else y, name=name)
+
+
+def mul(x: Node, y, name=None) -> Node:
+    return binary("mul", jnp.multiply, x, x._lift(y) if not isinstance(y, Node) else y, name=name)
+
+
+def div(x: Node, y, name=None) -> Node:
+    return binary("div", jnp.divide, x, x._lift(y) if not isinstance(y, Node) else y, name=name)
+
+
+def matmul(x: Node, y: Node, name=None) -> Node:
+    return binary("matmul", jnp.matmul, x, y, name=name)
+
+
+def _reducer(op: str, fn: Callable, x: Node, axis, name) -> Node:
+    ax = axis
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(ax)
+        single = ax[0] if len(ax) == 1 else None
+    else:
+        single = ax
+        ax = (ax,) if ax is not None else None
+
+    def eval_fn(v):
+        # preserve the input dtype: the reduce contract requires fetch and
+        # input dtypes to match (Operations.scala:98-108), but jnp.sum
+        # would promote small ints to the default int under x64.
+        return fn(v, axis=ax).astype(v.dtype)
+
+    return Node(op, [x], eval_fn, name=name, reduce_axis=single)
+
+
+def reduce_sum(x: Node, axis=0, name=None) -> Node:
+    """≙ dsl/package.scala:122-127 (& build_reducer, DslImpl.scala:175-200)."""
+    return _reducer("reduce_sum", jnp.sum, x, axis, name)
+
+
+def reduce_min(x: Node, axis=0, name=None) -> Node:
+    return _reducer("reduce_min", jnp.min, x, axis, name)
+
+
+def reduce_max(x: Node, axis=0, name=None) -> Node:
+    return _reducer("reduce_max", jnp.max, x, axis, name)
+
+
+def reduce_mean(x: Node, axis=0, name=None) -> Node:
+    return _reducer("reduce_mean", jnp.mean, x, axis, name)
+
+
+def apply_fn(fn: Callable, *xs: Node, name=None) -> Node:
+    """Escape hatch: apply an arbitrary jax function to DSL nodes. This is
+    where the TPU build exceeds the reference's fixed op set — any traceable
+    jnp program can join the graph."""
+    return Node(getattr(fn, "__name__", "apply"), list(xs), fn, name=name)
+
+
+def exp(x: Node, name=None) -> Node:
+    return unary("exp", jnp.exp, x, name)
+
+
+def log(x: Node, name=None) -> Node:
+    return unary("log", jnp.log, x, name)
+
+
+def tanh(x: Node, name=None) -> Node:
+    return unary("tanh", jnp.tanh, x, name)
+
+
+def sqrt(x: Node, name=None) -> Node:
+    return unary("sqrt", jnp.sqrt, x, name)
+
+
+def abs_(x: Node, name=None) -> Node:
+    return unary("abs", jnp.abs, x, name)
+
+
+def square(x: Node, name=None) -> Node:
+    return unary("square", jnp.square, x, name)
+
+
+def sigmoid(x: Node, name=None) -> Node:
+    import jax.nn
+
+    return unary("sigmoid", jax.nn.sigmoid, x, name)
+
+
+def relu(x: Node, name=None) -> Node:
+    import jax.nn
+
+    return unary("relu", jax.nn.relu, x, name)
+
+
+# ---------------------------------------------------------------------------
+# Compilation: fetches → Program
+# ---------------------------------------------------------------------------
+
+def _closure(fetches: Sequence[Node]) -> List[Node]:
+    """Transitive closure in topological order, deduped by node identity
+    (≙ DslImpl.getClosure, dsl/DslImpl.scala:62-75)."""
+    seen: Dict[int, Node] = {}
+    order: List[Node] = []
+
+    def visit(n: Node):
+        if id(n) in seen:
+            return
+        seen[id(n)] = n
+        for p in n.parents:
+            visit(p)
+        order.append(n)
+
+    for f in fetches:
+        visit(f)
+    return order
+
+
+def compile_fetches(fetches: Union[Node, Sequence[Node]]) -> Program:
+    """Compile a fetch list into a Program (≙ DslImpl.buildGraph +
+    analyzeGraphTF rolled into one, statically)."""
+    if isinstance(fetches, Node):
+        fetches = [fetches]
+    fetches = list(fetches)
+    names = [f.name for f in fetches]
+    base = [n.split("/")[-1] for n in names]
+    if len(set(base)) != len(base):
+        # ≙ core.py:106-108 unique-column-name check
+        raise ValueError(
+            f"Could not infer a list of unique names for the columns: {names}"
+        )
+    nodes = _closure(fetches)
+    placeholders = [n for n in nodes if n.is_placeholder]
+    inputs = [TensorSpec(p.name, p.dtype, p.shape) for p in placeholders]
+
+    def fn(feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        values: Dict[int, jnp.ndarray] = {}
+        for n in nodes:
+            if n.is_placeholder:
+                values[id(n)] = feeds[n.name]
+            else:
+                args = [values[id(p)] for p in n.parents]
+                values[id(n)] = n.eval_fn(*args)
+        # column name = last path segment of the fetch name (feed-style
+        # qualified names keep scopes; output columns use the base name,
+        # ≙ core.py:106 stripping ":0")
+        return {f.name.split("/")[-1]: values[id(f)] for f in fetches}
+
+    prog = Program(fn, inputs, fetch_order=[n.split("/")[-1] for n in names])
+    return prog
+
+
+def segment_reduce_info(fetches: Sequence[Node]) -> Optional[List[Tuple[str, str, str]]]:
+    """If every fetch is an algebraic reducer over axis 0 applied directly
+    to a placeholder, return [(out_name, op, input_placeholder)] — enabling
+    `aggregate`/`reduce_blocks` to lower to vectorized segment/psum ops
+    instead of generic per-group execution. Otherwise None."""
+    out = []
+    for f in fetches:
+        if f.reduce_axis != 0 or len(f.parents) != 1:
+            return None
+        p = f.parents[0]
+        if not p.is_placeholder:
+            return None
+        out.append((f.name.split("/")[-1], f.op, p.name))
+    return out

@@ -1,0 +1,3 @@
+from .verbs import aggregate, map_blocks, map_rows, reduce_blocks, reduce_rows
+
+__all__ = ["aggregate", "map_blocks", "map_rows", "reduce_blocks", "reduce_rows"]

@@ -1,0 +1,570 @@
+"""The five verbs: map_blocks, map_rows, reduce_blocks, reduce_rows,
+aggregate.
+
+Public surface parity with the reference
+(``OperationsInterface``, Operations.scala:20-135; Python client
+core.py:144-419). Execution is TPU-native:
+
+* ``map_blocks`` — one jitted XLA program per block (per distinct block
+  shape), replacing Session-per-partition (DebugRowOps.scala:305-400).
+* ``map_rows`` — ``jax.vmap`` over the block's rows (one compiled program,
+  rows batched onto the MXU), replacing the per-row Session loop
+  (DebugRowOps.scala:826-864); ragged rows fall back to per-shape
+  compilation (≙ per-row dynamic lead dims, TFDataOps.scala:90-103).
+* ``reduce_rows`` — a ``lax.scan`` pairwise fold inside one jit per block,
+  then across block partials (≙ sequential performReducePairwise,
+  DebugRowOps.scala:939-979, minus the per-pair Session.run overhead).
+* ``reduce_blocks`` — per-block program run, partials stacked and reduced
+  once more (≙ performReduceBlock + driver pairwise RDD.reduce,
+  DebugRowOps.scala:510-533 — the stack-and-rerun replaces O(blocks)
+  driver round-trips).
+* ``aggregate`` — keyed aggregation: a vectorized ``jax.ops.segment_*``
+  fast path when the fetches are algebraic reducers, else chunked
+  compaction with a bounded buffer (≙ TensorFlowUDAF's compact-every-10,
+  DebugRowOps.scala:608-702).
+
+Programs may be DSL nodes, plain Python functions over jnp, or loaded
+StableHLO artifacts (see program.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as dt
+from ..config import get_config
+from ..dsl.node import Node, compile_fetches, segment_reduce_info
+from ..frame import Block, GroupedData, TensorFrame, _block_num_rows
+from ..program import Program, TensorSpec, analyze_program, program_from_function
+from ..schema import ColumnInfo, Schema
+from ..shape import Shape, Unknown
+from ..utils import get_logger
+from ..validation import (
+    ValidationError,
+    validate_map,
+    validate_reduce_blocks,
+    validate_reduce_rows,
+)
+from .executor import (
+    CompiledProgram,
+    block_is_ragged,
+    gather_feeds,
+    make_pair_fold,
+)
+
+logger = get_logger(__name__)
+
+Fetches = Union[Node, Sequence[Node], Program, Callable]
+
+
+def _is_pandas(obj) -> bool:
+    try:
+        import pandas as pd
+
+        return isinstance(obj, pd.DataFrame)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _input_specs_from_schema(schema: Schema, block: bool) -> Dict[str, TensorSpec]:
+    specs = {}
+    for c in schema.device_columns:
+        shape = c.block_shape if block else c.cell_shape
+        specs[c.name] = TensorSpec(c.name, c.dtype, shape)
+    return specs
+
+
+def _normalize_program(
+    fetches: Fetches,
+    schema: Schema,
+    block: bool,
+    reduce_mode: Optional[str] = None,
+) -> Tuple[Program, Optional[List[Tuple[str, str, str]]]]:
+    """Accept DSL nodes / a python function / a Program; return an analyzed
+    Program plus (for DSL reducer fetches) segment-lowering info.
+
+    ``reduce_mode`` ('rows' | 'blocks') extends the input-spec namespace for
+    plain-function fetches so parameters may follow the reduce naming
+    contracts (``x_1``/``x_2``, ``x_input``) in addition to column names.
+    """
+    seg_info = None
+    if isinstance(fetches, Program):
+        program = fetches
+    elif isinstance(fetches, Node) or (
+        isinstance(fetches, (list, tuple))
+        and fetches
+        and all(isinstance(f, Node) for f in fetches)
+    ):
+        nodes = [fetches] if isinstance(fetches, Node) else list(fetches)
+        program = compile_fetches(nodes)
+        seg_info = segment_reduce_info(nodes)
+    elif callable(fetches):
+        specs = _input_specs_from_schema(schema, block)
+        if reduce_mode == "rows":
+            for c in schema.device_columns:
+                specs[f"{c.name}_1"] = TensorSpec(f"{c.name}_1", c.dtype, c.cell_shape)
+                specs[f"{c.name}_2"] = TensorSpec(f"{c.name}_2", c.dtype, c.cell_shape)
+        elif reduce_mode == "blocks":
+            for c in schema.device_columns:
+                specs[f"{c.name}_input"] = TensorSpec(
+                    f"{c.name}_input", c.dtype, c.block_shape
+                )
+        program = program_from_function(fetches, specs)
+    else:
+        raise TypeError(
+            "fetches must be a DSL Node, a list of Nodes, a Program, or a "
+            f"callable; got {type(fetches).__name__}"
+        )
+    program = analyze_program(program)
+    return program, seg_info
+
+
+def _apply_feed_dict(program: Program, feed_dict: Optional[Dict[str, str]]) -> Program:
+    """feed_dict: placeholder name → column name (≙ core.py:128-142).
+    Placeholders not mentioned keep their own name as the column name."""
+    if not feed_dict:
+        return program
+    unknown = [k for k in feed_dict if k not in program.input_names]
+    if unknown:
+        raise ValidationError(
+            f"feed_dict key(s) {unknown} do not match any program input; "
+            f"inputs: {program.input_names}"
+        )
+    return program.rename_inputs(dict(feed_dict))
+
+
+def _sorted_output_infos(program: Program, block_mode: bool) -> List[ColumnInfo]:
+    """Output columns first, sorted by name (≙ DebugRowOps.scala:353-379)."""
+    infos = []
+    for o in sorted(program.outputs, key=lambda s: s.name):
+        if block_mode:
+            block_shape = o.shape if o.shape.rank > 0 else Shape((Unknown,))
+            block_shape = block_shape.with_leading_unknown()
+        else:
+            block_shape = o.shape.prepend(Unknown)
+        infos.append(ColumnInfo(o.name, o.dtype, block_shape))
+    return infos
+
+
+# ---------------------------------------------------------------------------
+# map_blocks
+# ---------------------------------------------------------------------------
+
+def map_blocks(
+    fetches: Fetches,
+    frame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    trim: bool = False,
+) -> "TensorFrame":
+    """Transform a frame block by block, appending one column per output
+    (or replacing all columns when ``trim=True``, in which case the output
+    row count may differ from the input's).
+
+    ≙ ``tfs.map_blocks`` (core.py:267-313) → DebugRowOps.mapBlocks
+    (DebugRowOps.scala:305-400); trimmed variant ≙ mapBlocksTrimmed.
+    Lazy: returns a frame with a pending computation (core.py:278-279).
+    """
+    if _is_pandas(frame):
+        return _map_pandas(fetches, frame, feed_dict, block=True)
+    program, _ = _normalize_program(fetches, frame.schema, block=True)
+    program = _apply_feed_dict(program, feed_dict)
+    validate_map(program, frame.schema, block=True, trim=trim)
+    compiled = CompiledProgram(program)
+    out_infos = _sorted_output_infos(program, block_mode=True)
+    if trim:
+        schema = Schema(out_infos)
+    else:
+        schema = Schema(out_infos + frame.schema.columns)
+    parent = frame
+    input_names = program.input_names
+
+    def compute() -> List[Block]:
+        out_blocks: List[Block] = []
+        for b in parent.blocks():
+            n = _block_num_rows(b)
+            feeds = gather_feeds(b, input_names, program)
+            outs = compiled.run_block(feeds)
+            if trim:
+                out_blocks.append({i.name: outs[i.name] for i in out_infos})
+                continue
+            for o in program.outputs:
+                got = outs[o.name].shape[0] if outs[o.name].ndim > 0 else None
+                if got != n:
+                    raise ValidationError(
+                        f"map_blocks output {o.name!r} produced {got} rows "
+                        f"for a block of {n} rows. Appending requires "
+                        "matching row counts; use trim=True for "
+                        "row-count-changing programs."
+                    )
+            nb: Block = {i.name: outs[i.name] for i in out_infos}
+            nb.update(b)
+            out_blocks.append(nb)
+        return out_blocks
+
+    return TensorFrame(None, schema, pending=compute)
+
+
+# ---------------------------------------------------------------------------
+# map_rows
+# ---------------------------------------------------------------------------
+
+def map_rows(
+    fetches: Fetches,
+    frame,
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> "TensorFrame":
+    """Transform a frame row by row (placeholders are cell-shaped).
+
+    ≙ ``tfs.map_rows`` (core.py:224-265) → DebugRowOps.mapRows
+    (DebugRowOps.scala:403-484). Uniform blocks run as one vmapped XLA
+    program; ragged blocks fall back to per-row execution with a
+    per-cell-shape compile cache.
+    """
+    if _is_pandas(frame):
+        return _map_pandas(fetches, frame, feed_dict, block=False)
+    program, _ = _normalize_program(fetches, frame.schema, block=False)
+    program = _apply_feed_dict(program, feed_dict)
+    validate_map(program, frame.schema, block=False)
+    compiled = CompiledProgram(program)
+    out_infos = _sorted_output_infos(program, block_mode=False)
+    schema = Schema(out_infos + frame.schema.columns)
+    parent = frame
+    input_names = program.input_names
+
+    def compute() -> List[Block]:
+        out_blocks: List[Block] = []
+        for b in parent.blocks():
+            n = _block_num_rows(b)
+            if n == 0:
+                nb = {}
+                for i in out_infos:
+                    # preserve the cell rank so cross-block concatenation
+                    # works; Unknown inner dims degrade to 0
+                    dims = tuple(
+                        0 if d == Unknown else d for d in i.cell_shape.dims
+                    )
+                    nb[i.name] = np.empty((0,) + dims, dtype=i.dtype.np_dtype)
+                nb.update(b)
+                out_blocks.append(nb)
+                continue
+            if not block_is_ragged(b, input_names):
+                feeds = gather_feeds(b, input_names, program)
+                outs = compiled.run_rows(feeds)
+            else:
+                # ragged path: per-row programs, compiled per cell shape
+                # (≙ per-row dynamic lead dim, TFDataOps.scala:90-103)
+                per_row: List[Dict[str, np.ndarray]] = []
+                for i in range(n):
+                    feeds = {
+                        name: np.asarray(b[name][i]) for name in input_names
+                    }
+                    per_row.append(compiled.run_single_row(feeds))
+                outs = {}
+                for o in program.outputs:
+                    cells = [r[o.name] for r in per_row]
+                    shapes = {c.shape for c in cells}
+                    if len(shapes) == 1:
+                        outs[o.name] = np.stack(cells)
+                    else:
+                        outs[o.name] = cells  # ragged output column
+            nb: Block = {i.name: outs[i.name] for i in out_infos}
+            nb.update(b)
+            out_blocks.append(nb)
+        return out_blocks
+
+    return TensorFrame(None, schema, pending=compute)
+
+
+def _map_pandas(fetches, pdf, feed_dict, block: bool):
+    """Local pandas path (≙ ``_map_pd``, core.py:171-183): run the program
+    on the pandas columns and append the outputs to a copy of the frame."""
+    from ..frame import frame_from_pandas
+
+    tf_frame = frame_from_pandas(pdf, num_blocks=1)
+    # the reference's _map_pd always feeds whole columns (block semantics)
+    result = map_blocks(fetches, tf_frame, feed_dict=feed_dict)
+    out = pdf.copy()
+    for name in result.schema.names:
+        if name not in pdf.columns:
+            out[name] = list(result.column_values(name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reduce_rows
+# ---------------------------------------------------------------------------
+
+def _unpack_results(program: Program, finals: Dict[str, np.ndarray]):
+    """Return numpy results in fetch order; single fetch unwraps
+    (≙ _unpack_row, core.py:111-125)."""
+    out = []
+    for name in program.fetch_order or program.output_names:
+        v = finals[name]
+        arr = np.asarray(v)
+        out.append(arr if arr.ndim > 0 else arr.item())
+    return out[0] if len(out) == 1 else out
+
+
+def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
+    """Pairwise-reduce all rows to a single row. Each fetch ``x`` consumes
+    placeholders ``x_1``/``x_2`` (Operations.scala:83-96). Eager
+    (core.py:197 "not lazy").
+
+    Execution: within each block, a sequential ``lax.scan`` fold under one
+    jit; block partials are folded the same way. Reduction order is
+    unspecified by contract (core.py:186-187), so the block split does not
+    change the result class the reference supports (associative programs).
+    """
+    program, _ = _normalize_program(
+        fetches, frame.schema, block=False, reduce_mode="rows"
+    )
+    validate_reduce_rows(program, frame.schema)
+    out_names = [o.name for o in program.outputs]
+    fold = make_pair_fold(program, out_names)
+
+    partials: List[Dict[str, np.ndarray]] = []
+    for b in frame.blocks():
+        n = _block_num_rows(b)
+        if n == 0:
+            continue
+        feeds = {}
+        for x in out_names:
+            v = b[x]
+            if isinstance(v, list):
+                spec = program.input(f"{x}_1")
+                try:
+                    v = np.asarray(v, dtype=spec.dtype.np_dtype)
+                except (ValueError, TypeError):
+                    raise ValueError(
+                        f"Column {x!r} holds ragged cells; reduce_rows "
+                        "needs dense blocks (run analyze() first)."
+                    ) from None
+            feeds[x] = v
+        if n == 1:
+            partials.append({x: np.asarray(feeds[x][0]) for x in out_names})
+        else:
+            res = fold({x: jnp.asarray(feeds[x]) for x in out_names})
+            partials.append({x: np.asarray(res[x]) for x in out_names})
+    if not partials:
+        raise ValueError("reduce_rows on an empty frame")
+    if len(partials) == 1:
+        finals = partials[0]
+    else:
+        stacked = {
+            x: jnp.asarray(np.stack([p[x] for p in partials])) for x in out_names
+        }
+        res = fold(stacked)
+        finals = {x: np.asarray(res[x]) for x in out_names}
+    return _unpack_results(program, finals)
+
+
+# ---------------------------------------------------------------------------
+# reduce_blocks
+# ---------------------------------------------------------------------------
+
+def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
+    """Block-reduce all rows to a single row. Each fetch ``x`` consumes a
+    placeholder ``x_input`` with one extra (Unknown) leading dim
+    (Operations.scala:98-108). Eager.
+
+    Execution ≙ performReduceBlock per partition + pairwise merge
+    (DebugRowOps.scala:510-533), except partials are stacked and reduced in
+    one final program run instead of driver-coordinated pairwise merging.
+    """
+    program, _ = _normalize_program(
+        fetches, frame.schema, block=True, reduce_mode="blocks"
+    )
+    validate_reduce_blocks(program, frame.schema)
+    out_names = [o.name for o in program.outputs]
+    compiled = CompiledProgram(program)
+
+    partials: List[Dict[str, np.ndarray]] = []
+    for b in frame.blocks():
+        if _block_num_rows(b) == 0:
+            continue
+        feeds = {}
+        for x in out_names:
+            v = b[x]
+            if isinstance(v, list):
+                spec = program.input(f"{x}_input")
+                try:
+                    v = np.asarray(v, dtype=spec.dtype.np_dtype)
+                except (ValueError, TypeError):
+                    raise ValueError(
+                        f"Column {x!r} holds ragged cells; reduce_blocks "
+                        "needs dense blocks (run analyze() first)."
+                    ) from None
+            feeds[f"{x}_input"] = v
+        partials.append(compiled.run_block(feeds))
+    if not partials:
+        raise ValueError("reduce_blocks on an empty frame")
+    if len(partials) == 1:
+        finals = partials[0]
+    else:
+        feeds = {
+            f"{x}_input": np.stack([p[x] for p in partials]) for x in out_names
+        }
+        finals = compiled.run_block(feeds)
+    return _unpack_results(program, finals)
+
+
+# ---------------------------------------------------------------------------
+# aggregate (keyed)
+# ---------------------------------------------------------------------------
+
+_SEGMENT_OPS = {
+    "reduce_sum": jax.ops.segment_sum,
+    "reduce_min": jax.ops.segment_min,
+    "reduce_max": jax.ops.segment_max,
+}
+
+
+def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
+    """Algebraic aggregation over grouped data: one output row per key.
+
+    ≙ ``tfs.aggregate`` (core.py:401-419) → DebugRowOps.aggregate via
+    ``TensorFlowUDAF`` (DebugRowOps.scala:554-599, 608-702). Fetches follow
+    the ``x`` / ``x_input`` naming contract, like reduce_blocks.
+
+    Execution: rows are sorted by key on the host; then either
+    (a) *segment fast path* — the fetches are recognized algebraic
+    reducers and lower to one vectorized ``jax.ops.segment_*`` program
+    over the whole frame (replacing the Catalyst shuffle + UDAF with a
+    single XLA program), or
+    (b) *generic path* — per group, chunked compaction through the user
+    program with a bounded buffer (compact-every-N,
+    ≙ DebugRowOps.scala:646-657), keeping the jit cache ≤ N shapes.
+    """
+    frame = grouped.frame
+    keys = grouped.keys
+    program, seg_info = _normalize_program(
+        fetches, frame.schema, block=True, reduce_mode="blocks"
+    )
+    validate_reduce_blocks(program, frame.schema)
+    out_names = [o.name for o in program.outputs]
+
+    # -- gather rows to host, sort by key -----------------------------------
+    key_cols = {k: frame.column_values(k) for k in keys}
+    val_cols = {}
+    for x in out_names:
+        vals = frame.column_values(x)
+        if vals.dtype == object:
+            raise ValueError(
+                f"Column {x!r} is ragged; aggregate requires uniform cells "
+                "(run analyze() first)."
+            )
+        val_cols[x] = vals
+    n = len(next(iter(key_cols.values())))
+    if n == 0:
+        infos = [
+            frame.schema[k].with_block_shape(
+                frame.schema[k].cell_shape.prepend(Unknown)
+            )
+            for k in keys
+        ] + [
+            ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown))
+            for o in sorted(program.outputs, key=lambda s: s.name)
+        ]
+        empty: Block = {}
+        for i in infos:
+            dims = tuple(0 if d == Unknown else d for d in i.cell_shape.dims)
+            if i.is_device:
+                empty[i.name] = np.empty((0,) + dims, dtype=i.dtype.np_dtype)
+            else:
+                empty[i.name] = []
+        return TensorFrame([empty], Schema(infos))
+    order = np.lexsort(tuple(np.asarray(key_cols[k]) for k in reversed(keys)))
+    sorted_keys = {k: np.asarray(key_cols[k])[order] for k in keys}
+    # group boundaries over the sorted key tuples
+    if len(keys) == 1:
+        kview = sorted_keys[keys[0]]
+        change = np.empty(n, dtype=bool)
+        change[0] = True
+        change[1:] = kview[1:] != kview[:-1]
+    else:
+        change = np.zeros(n, dtype=bool)
+        change[0] = True
+        for k in keys:
+            kv = sorted_keys[k]
+            change[1:] |= kv[1:] != kv[:-1]
+    seg_ids = np.cumsum(change) - 1
+    num_groups = int(seg_ids[-1]) + 1 if n else 0
+    group_starts = np.flatnonzero(change)
+
+    out_cols: Dict[str, np.ndarray] = {}
+    if seg_info is not None and all(op in _SEGMENT_OPS or op == "reduce_mean" for _, op, _ in seg_info):
+        # -- segment fast path ----------------------------------------------
+        sids = jnp.asarray(seg_ids)
+
+        def seg_prog(vals: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+            outs = {}
+            for out_name, op, _ in seg_info:
+                v = vals[out_name]
+                if op == "reduce_mean":
+                    s = jax.ops.segment_sum(v, sids, num_segments=num_groups)
+                    c = jax.ops.segment_sum(
+                        jnp.ones(v.shape[:1], v.dtype), sids, num_segments=num_groups
+                    )
+                    c = c.reshape((-1,) + (1,) * (v.ndim - 1))
+                    # cast back: fetch dtype == input dtype by contract
+                    # (the generic path does this via _reducer's astype)
+                    outs[out_name] = (s / c).astype(v.dtype)
+                else:
+                    outs[out_name] = _SEGMENT_OPS[op](
+                        v, sids, num_segments=num_groups
+                    )
+            return outs
+
+        sorted_vals = {x: jnp.asarray(val_cols[x][order]) for x in out_names}
+        res = jax.jit(seg_prog)(sorted_vals)
+        out_cols = {x: np.asarray(res[x]) for x in out_names}
+    else:
+        # -- generic chunked-compaction path --------------------------------
+        compiled = CompiledProgram(program)
+        buf = max(2, get_config().aggregate_buffer_size)
+        sorted_vals = {x: val_cols[x][order] for x in out_names}
+        results = {x: [] for x in out_names}
+        bounds = list(group_starts) + [n]
+        for gi in range(num_groups):
+            lo, hi = bounds[gi], bounds[gi + 1]
+            cur = {x: sorted_vals[x][lo:hi] for x in out_names}
+            m = hi - lo
+            # compact in chunks of <= buf rows until one buffer-load remains
+            # (≙ the UDAF's compact-every-bufferSize, DebugRowOps.scala:646-657)
+            while m > buf:
+                partials = {x: [] for x in out_names}
+                for c0 in range(0, m, buf):
+                    feeds = {
+                        f"{x}_input": cur[x][c0 : min(c0 + buf, m)]
+                        for x in out_names
+                    }
+                    outs = compiled.run_block(feeds)
+                    for x in out_names:
+                        partials[x].append(outs[x])
+                cur = {x: np.stack(partials[x]) for x in out_names}
+                m = len(partials[out_names[0]])
+            finals = compiled.run_block(
+                {f"{x}_input": cur[x] for x in out_names}
+            )
+            for x in out_names:
+                results[x].append(finals[x])
+        out_cols = {x: np.stack(results[x]) if results[x] else np.empty((0,)) for x in out_names}
+
+    # -- assemble result frame: key cols + fetch cols -----------------------
+    out_key_cols = {k: np.asarray(sorted_keys[k])[group_starts] for k in keys}
+    infos: List[ColumnInfo] = []
+    for k in keys:
+        infos.append(frame.schema[k].with_block_shape(
+            frame.schema[k].cell_shape.prepend(Unknown)
+        ))
+    for o in sorted(program.outputs, key=lambda s: s.name):
+        infos.append(ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown)))
+    block: Block = {}
+    block.update(out_key_cols)
+    for o in program.outputs:
+        block[o.name] = out_cols[o.name]
+    return TensorFrame([block], Schema(infos))

@@ -1,0 +1,303 @@
+"""Program capture and static analysis.
+
+The reference ingests user programs in three forms
+(project/Build.scala:102-107): the TF Python API, serialized protobuf
+``GraphDef``\\ s, and a small Scala DSL — all funnelled into a byte blob that
+is later *re-imported into the TF runtime* to discover inputs/outputs/
+dtypes/shapes (``analyzeGraphTF``, TensorFlowOps.scala:101-141).
+
+The TPU-native equivalents here:
+
+* **traced Python functions** over ``jax.numpy`` (primary; ≙ the TF Python
+  path — closure-captured values play the role of frozen ``tf.Variable``
+  constants, core.py:42-56);
+* **DSL expression graphs** (:mod:`tensorframes_tpu.dsl`), compiled to the
+  same ``Program`` form;
+* **serialized StableHLO** via ``jax.export`` (≙ ``GraphDef`` file loading,
+  PythonInterface.scala:115-118).
+
+Analysis is *static*: instead of loading a graph into a live runtime, we
+``jax.eval_shape`` the program against abstract inputs. Unknown (batch)
+dimensions are discovered by probing two distinct batch sizes and marking
+every output dim that co-varies with the probe — this replaces the
+reference's shape-hints workaround for dims the graph pruned
+(ShapeDescription.scala:12-19). Explicit user hints still override
+(the hint-override rule, TensorFlowOps.scala:126-133).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+from .shape import Shape, Unknown
+
+# Probe batch sizes used to discover batch-covariant output dims. Coprime and
+# unequal so a dim matching both probes by accident is effectively impossible.
+_PROBE_A = 3
+_PROBE_B = 7
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Name + dtype + (partial) shape of one program input or output.
+
+    ≙ ``GraphNodeSummary`` (TensorFlowOps.scala:163-169).
+    """
+
+    name: str
+    dtype: dt.ScalarType
+    shape: Shape  # may contain Unknown dims
+
+    def pretty(self) -> str:
+        return f"{self.name}: {self.dtype.name}{self.shape}"
+
+
+class Program:
+    """A compiled-form user program: named inputs → named outputs.
+
+    ``fn`` maps a dict of arrays (keyed by input name) to a dict of arrays
+    (keyed by output name). It must be jit-traceable. ``inputs`` carries the
+    declared dtype/shape of each input (shapes may have Unknown dims);
+    ``outputs`` is filled in by :func:`analyze_program`.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[Dict[str, jnp.ndarray]], Dict[str, jnp.ndarray]],
+        inputs: Sequence[TensorSpec],
+        outputs: Optional[Sequence[TensorSpec]] = None,
+        fetch_order: Optional[Sequence[str]] = None,
+    ):
+        self.fn = fn
+        self.inputs: List[TensorSpec] = list(inputs)
+        self.outputs: List[TensorSpec] = list(outputs) if outputs else []
+        # order in which the user listed fetches (defines result ordering for
+        # reduce verbs returning numpy arrays)
+        self.fetch_order: List[str] = (
+            list(fetch_order) if fetch_order else [o.name for o in self.outputs]
+        )
+
+    @property
+    def input_names(self) -> List[str]:
+        return [s.name for s in self.inputs]
+
+    @property
+    def output_names(self) -> List[str]:
+        return [s.name for s in self.outputs]
+
+    def input(self, name: str) -> TensorSpec:
+        for s in self.inputs:
+            if s.name == name:
+                return s
+        raise KeyError(
+            f"Program has no input {name!r}; inputs: {self.input_names}"
+        )
+
+    def output(self, name: str) -> TensorSpec:
+        for s in self.outputs:
+            if s.name == name:
+                return s
+        raise KeyError(
+            f"Program has no output {name!r}; outputs: {self.output_names}"
+        )
+
+    def rename_inputs(self, mapping: Dict[str, str]) -> "Program":
+        """Rename inputs (placeholder → column feed_dict remapping,
+        ≙ core.py:128-142). ``mapping`` maps old input name → new name."""
+        new_inputs = [
+            TensorSpec(mapping.get(s.name, s.name), s.dtype, s.shape)
+            for s in self.inputs
+        ]
+        inner = self.fn
+        inv = {mapping.get(s.name, s.name): s.name for s in self.inputs}
+
+        def fn(feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+            return inner({inv.get(k, k): v for k, v in feeds.items()})
+
+        return Program(fn, new_inputs, self.outputs, self.fetch_order)
+
+    def explain(self) -> str:
+        ins = ", ".join(s.pretty() for s in self.inputs)
+        outs = ", ".join(s.pretty() for s in self.outputs)
+        return f"Program(inputs=[{ins}], outputs=[{outs}])"
+
+
+def _abstract_inputs(
+    inputs: Sequence[TensorSpec], probe: int
+) -> Dict[str, jax.ShapeDtypeStruct]:
+    out = {}
+    for s in inputs:
+        dims = tuple(probe if d == Unknown else d for d in s.shape.dims)
+        out[s.name] = jax.ShapeDtypeStruct(dims, s.dtype.np_dtype)
+    return out
+
+
+def analyze_program(
+    program: Program,
+    hints: Optional[Dict[str, Shape]] = None,
+) -> Program:
+    """Static shape/dtype analysis of a Program (≙ ``analyzeGraphTF``).
+
+    Runs ``jax.eval_shape`` with two different probe values substituted for
+    Unknown input dims; output dims equal to a probe in both runs (and
+    scaling with it) are marked Unknown (batch-covariant). ``hints``
+    (output name → Shape) override discovered shapes wherever the hint dim
+    is known — the reference's hint-override rule
+    (TensorFlowOps.scala:126-133).
+    """
+    hints = hints or {}
+
+    def run(probe: int):
+        abstract = _abstract_inputs(program.inputs, probe)
+        return jax.eval_shape(program.fn, abstract)
+
+    res_a = run(_PROBE_A)
+    if any(s.shape.has_unknown for s in program.inputs):
+        res_b = run(_PROBE_B)
+    else:
+        res_b = res_a
+
+    if not isinstance(res_a, dict):
+        raise TypeError(
+            "Program function must return a dict of named outputs; got "
+            f"{type(res_a).__name__}"
+        )
+
+    outputs: List[TensorSpec] = []
+    order = program.fetch_order or list(res_a.keys())
+    for name in res_a:
+        sa, sb = res_a[name], res_b[name]
+        dims = []
+        for da, db in zip(sa.shape, sb.shape):
+            if da == db:
+                dims.append(da)
+            else:
+                # dim co-varied with the probe → batch-dependent → Unknown
+                dims.append(Unknown)
+        shape = Shape(dims)
+        if name in hints:
+            shape = shape.refine(Shape.from_any(hints[name]))
+        outputs.append(TensorSpec(name, dt.from_numpy(sa.dtype), shape))
+    # keep fetch order where given
+    by_name = {o.name: o for o in outputs}
+    ordered = [by_name[n] for n in order if n in by_name] + [
+        o for o in outputs if o.name not in order
+    ]
+    return Program(program.fn, program.inputs, ordered, order)
+
+
+# ---------------------------------------------------------------------------
+# Ingestion form (a): plain Python functions
+# ---------------------------------------------------------------------------
+
+def program_from_function(
+    fn: Callable,
+    input_specs: Dict[str, TensorSpec],
+    output_names: Optional[Sequence[str]] = None,
+) -> Program:
+    """Wrap a Python function whose positional args are column names.
+
+    The function receives one array per parameter (parameter name = input
+    name) and returns either a dict name→array or a single array / tuple —
+    singles are named after ``output_names`` (or the function's name).
+    Closure-captured arrays are compile-time constants, playing the role of
+    the reference's frozen variables (core.py:42-56).
+    """
+    import inspect
+
+    sig = inspect.signature(fn)
+    params = [p.name for p in sig.parameters.values()
+              if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)]
+    missing = [p for p in params if p not in input_specs]
+    if missing:
+        raise ValueError(
+            f"Function parameter(s) {missing} do not match any known input; "
+            f"available: {sorted(input_specs)}"
+        )
+    inputs = [input_specs[p] for p in params]
+
+    def wrapped(feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        res = fn(*[feeds[p] for p in params])
+        if isinstance(res, dict):
+            return res
+        if isinstance(res, (tuple, list)):
+            names = output_names or [f"{fn.__name__}_{i}" for i in range(len(res))]
+            if len(names) != len(res):
+                raise ValueError(
+                    f"Function returned {len(res)} outputs but "
+                    f"{len(names)} output names were given"
+                )
+            return dict(zip(names, res))
+        name = (output_names or [fn.__name__])[0]
+        return {name: res}
+
+    return Program(wrapped, inputs, fetch_order=list(output_names or []))
+
+
+# ---------------------------------------------------------------------------
+# Ingestion form (c): serialized StableHLO artifacts (jax.export)
+# ---------------------------------------------------------------------------
+
+def save_program(program: Program, path: str, batch: int = 8) -> None:
+    """Serialize a Program to a StableHLO artifact on disk
+    (≙ writing ``proto.pb``, core.py:58-69). Unknown dims are exported as
+    symbolic dimensions so the artifact stays batch-polymorphic."""
+    from jax import export as jax_export
+
+    names = [s.name for s in program.inputs]
+    scopes = jax_export.SymbolicScope()
+    args = []
+    for s in program.inputs:
+        dims = tuple(
+            jax_export.symbolic_shape(f"b{i}", scope=scopes)[0]
+            if d == Unknown
+            else d
+            for i, d in enumerate(s.shape.dims)
+        )
+        args.append(jax.ShapeDtypeStruct(dims, s.dtype.np_dtype))
+
+    def positional(*xs):
+        return program.fn(dict(zip(names, xs)))
+
+    exported = jax_export.export(jax.jit(positional))(*args)
+    blob = exported.serialize()
+    meta = {
+        "inputs": [(s.name, s.dtype.name, list(s.shape.dims)) for s in program.inputs],
+        "fetch_order": program.fetch_order,
+    }
+    import json
+
+    with open(path, "wb") as f:
+        header = json.dumps(meta).encode("utf-8")
+        f.write(len(header).to_bytes(8, "little"))
+        f.write(header)
+        f.write(blob)
+
+
+def load_program(path: str) -> Program:
+    """Load a serialized Program (≙ ``graphFromFile``,
+    PythonInterface.scala:115-118)."""
+    import json
+
+    from jax import export as jax_export
+
+    with open(path, "rb") as f:
+        hlen = int.from_bytes(f.read(8), "little")
+        meta = json.loads(f.read(hlen).decode("utf-8"))
+        blob = f.read()
+    exported = jax_export.deserialize(bytearray(blob))
+    names = [n for (n, _, _) in meta["inputs"]]
+    inputs = [
+        TensorSpec(n, dt.by_name(t), Shape(dims)) for (n, t, dims) in meta["inputs"]
+    ]
+
+    def fn(feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        return exported.call(*[feeds[n] for n in names])
+
+    return Program(fn, inputs, fetch_order=meta.get("fetch_order"))

@@ -1,0 +1,24 @@
+"""Logging setup (≙ the reference's ``Logging`` trait, Logging.scala:5-9,
+and its log4j bootstrap, PythonInterface.scala:29-44 — here just stdlib
+logging with a package-level logger and an opt-in debug env var)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_ROOT = "tensorframes_tpu"
+
+
+def get_logger(name: str = _ROOT) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logging.getLogger(_ROOT).handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        root = logging.getLogger(_ROOT)
+        root.addHandler(handler)
+        level = os.environ.get("TFTPU_LOG", "WARNING").upper()
+        root.setLevel(getattr(logging, level, logging.WARNING))
+    return logger

@@ -1,0 +1,27 @@
+"""Test fixture: force a virtual 8-device CPU platform BEFORE jax loads.
+
+≙ the reference's shared local-mode fixture (TensorFramesTestSparkContext:
+local[1] Spark with 4 shuffle partitions) — here "distributed" is tested by
+device count, not hosts: 8 virtual CPU devices stand in for a TPU slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    """Graph-state hygiene: every test runs in a fresh naming context
+    (≙ GraphScoping.testGraph, dsl/GraphScoping.scala:8-15)."""
+    from tensorframes_tpu.dsl import with_graph
+
+    with with_graph():
+        yield

@@ -1,0 +1,76 @@
+"""Dtype-parameterized verb replay: the same behavioral tests executed for
+every core scalar type.
+
+≙ the reference's type-genericity harness: ``CommonOperationsSuite[T]``
+defines tests once and replays them per dtype
+(CommonOperationsSuite.scala:10-86, type_suites.scala:190-213 over shared
+BasicIdentityTests/BasicMonoidTests).
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dtypes as dt
+
+CORE_TYPES = [dt.float64, dt.float32, dt.int32, dt.int64]
+
+
+def _mk(values, t):
+    arr = np.asarray(values, dtype=t.np_dtype)
+    return tfs.frame_from_arrays({"x": arr}, num_blocks=2)
+
+
+@pytest.mark.parametrize("t", CORE_TYPES, ids=lambda t: t.name)
+def test_identity_scalar(t):
+    df = _mk([1, 2, 3, 4], t)
+    x = tfs.block(df, "x")
+    out = tfs.map_blocks(tfs.identity(x, name="y"), df).collect()
+    assert [r["y"] for r in out] == [1, 2, 3, 4]
+    assert tfs.map_blocks(tfs.identity(x, name="y2"), df).schema["y2"].dtype is t
+
+
+@pytest.mark.parametrize("t", CORE_TYPES, ids=lambda t: t.name)
+def test_add_constant(t):
+    df = _mk([1, 2, 3], t)
+    x = tfs.block(df, "x")
+    c = tfs.constant(np.asarray(2, dtype=t.np_dtype))
+    out = tfs.map_blocks(tfs.add(x, c, name="y"), df).collect()
+    assert [r["y"] for r in out] == [3, 4, 5]
+
+
+@pytest.mark.parametrize("t", CORE_TYPES, ids=lambda t: t.name)
+def test_reduce_blocks_monoid(t):
+    # ≙ BasicMonoidTests: sum over blocks
+    df = _mk([1, 2, 3, 4, 5], t)
+    x_input = tfs.block(df, "x", tf_name="x_input")
+    x = tfs.reduce_sum(x_input, axis=0, name="x")
+    assert tfs.reduce_blocks(x, df) == 15
+
+
+@pytest.mark.parametrize("t", CORE_TYPES, ids=lambda t: t.name)
+def test_reduce_rows_monoid(t):
+    df = _mk([1, 2, 3, 4], t)
+    x1 = tfs.placeholder(t, [], name="x_1")
+    x2 = tfs.placeholder(t, [], name="x_2")
+    x = tfs.add(x1, x2, name="x")
+    assert tfs.reduce_rows(x, df) == 10
+
+
+@pytest.mark.parametrize("t", CORE_TYPES, ids=lambda t: t.name)
+def test_map_rows_identity(t):
+    df = _mk([7, 8, 9], t)
+    x = tfs.row(df, "x")
+    out = tfs.map_rows(tfs.identity(x, name="y"), df).collect()
+    assert [r["y"] for r in out] == [7, 8, 9]
+
+
+@pytest.mark.parametrize("t", [dt.float64, dt.float32], ids=lambda t: t.name)
+def test_vector_roundtrip(t):
+    arr = np.arange(12, dtype=t.np_dtype).reshape(6, 2)
+    df = tfs.frame_from_arrays({"v": arr}, num_blocks=3)
+    v = tfs.block(df, "v")
+    out = tfs.map_blocks((v * 2).named("w"), df)
+    got = np.stack([r["w"] for r in out.collect()])
+    assert np.allclose(got, arr * 2)
+    assert out.schema["w"].dtype is t

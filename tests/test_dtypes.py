@@ -1,0 +1,42 @@
+"""Dtype registry tests (≙ datatypes.scala contracts: closed registry,
+no implicit casting, host-only strings)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu import dtypes as dt
+
+
+def test_registry_roundtrip():
+    for t in dt.device_types():
+        assert dt.from_numpy(t.np_dtype) is t
+        assert dt.by_name(t.name) is t
+
+
+def test_core_four_present():
+    # the reference's supported set (datatypes.scala:265-267)
+    for name in ("float64", "float32", "int32", "int64"):
+        assert dt.by_name(name).device
+
+
+def test_host_only_types():
+    assert not dt.string.device
+    assert not dt.binary.device
+    with pytest.raises(TypeError):
+        dt.string.jax_dtype
+
+
+def test_python_value_inference():
+    assert dt.from_python_value(1.5) is dt.float64
+    assert dt.from_python_value(3) is dt.int64
+    assert dt.from_python_value(True) is dt.bool_
+    assert dt.from_python_value("s") is dt.string
+    assert dt.from_python_value(b"b") is dt.binary
+    assert dt.from_python_value(np.float32(1)) is dt.float32
+
+
+def test_unsupported_rejected():
+    with pytest.raises(dt.UnsupportedTypeError):
+        dt.from_numpy(np.complex128)
+    with pytest.raises(dt.UnsupportedTypeError):
+        dt.by_name("float128")

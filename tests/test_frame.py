@@ -1,0 +1,117 @@
+"""TensorFrame container + analyze/append_shape tests
+(≙ ExtraOperationsSuite: analyze on scalars/vectors, multi-partition,
+ragged; BasicOperationsSuite fixtures)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.shape import Unknown
+
+
+def test_from_rows_scalars():
+    df = tfs.frame_from_rows([{"x": float(i)} for i in range(10)])
+    assert df.num_rows == 10
+    assert df.schema["x"].dtype is dt.float64
+    assert df.schema["x"].cell_shape.rank == 0
+    assert [r["x"] for r in df.collect()] == [float(i) for i in range(10)]
+
+
+def test_from_rows_vectors_start_unknown():
+    # list columns get Unknown dims pre-analyze
+    # (≙ ColumnInformation.scala:124-138 ArrayType recursion)
+    df = tfs.frame_from_rows([{"y": [1.0, 2.0]} for _ in range(4)])
+    assert df.schema["y"].cell_shape.dims == (Unknown,)
+
+
+def test_analyze_refines_shapes():
+    # ≙ the README reduce example flow (README.md:100-109)
+    df = tfs.frame_from_rows([{"y": [float(i), float(-i)]} for i in range(10)])
+    df2 = tfs.analyze(df)
+    assert df2.schema["y"].cell_shape.dims == (2,)
+    assert "[?,2]" in tfs.explain(df2)
+
+
+def test_analyze_ragged_keeps_unknown():
+    # ragged rows merge to Unknown (≙ ExtraOperationsSuite ragged, :73-84)
+    df = tfs.frame_from_rows(
+        [{"y": [1.0]}, {"y": [1.0, 2.0]}, {"y": [1.0, 2.0, 3.0]}]
+    )
+    df2 = tfs.analyze(df)
+    assert df2.schema["y"].cell_shape.dims == (Unknown,)
+
+
+def test_analyze_multi_block():
+    # shapes merged across partitions (≙ ExtraOperationsSuite :62-71)
+    df = tfs.frame_from_rows(
+        [{"y": [float(i), 0.0]} for i in range(9)], num_blocks=3
+    )
+    assert df.num_blocks == 3
+    df2 = tfs.analyze(df)
+    assert df2.schema["y"].cell_shape.dims == (2,)
+
+
+def test_append_shape():
+    # manual shape declaration (≙ core.py:381-399)
+    df = tfs.frame_from_rows([{"y": [1.0, 2.0]} for _ in range(4)])
+    df2 = tfs.append_shape(df, "y", [2])
+    assert df2.schema["y"].cell_shape.dims == (2,)
+    # None entries mean Unknown
+    df3 = tfs.append_shape(df, "y", [None])
+    assert df3.schema["y"].cell_shape.dims == (Unknown,)
+
+
+def test_from_arrays_dense_shapes_immediate():
+    df = tfs.frame_from_arrays({"m": np.zeros((6, 3, 4), dtype=np.float32)})
+    assert df.schema["m"].dtype is dt.float32
+    assert df.schema["m"].cell_shape.dims == (3, 4)
+
+
+def test_from_pandas_roundtrip():
+    import pandas as pd
+
+    pdf = pd.DataFrame({"a": [1.0, 2.0, 3.0], "s": ["x", "y", "z"]})
+    df = tfs.frame_from_pandas(pdf)
+    assert df.schema["a"].dtype is dt.float64
+    assert df.schema["s"].dtype is dt.string
+    assert df.to_pandas()["s"].tolist() == ["x", "y", "z"]
+
+
+def test_repartition():
+    df = tfs.frame_from_rows([{"x": float(i)} for i in range(10)], num_blocks=2)
+    df2 = df.repartition(3)
+    assert df2.num_blocks == 3
+    assert df2.num_rows == 10
+    assert [r["x"] for r in df2.collect()] == [float(i) for i in range(10)]
+
+
+def test_select_and_alias():
+    df = tfs.frame_from_rows([{"a": 1.0, "b": 2.0}])
+    assert df.select(["b"]).columns == ["b"]
+    df2 = df.alias_column("a", "c")
+    assert df2.first()["c"] == 1.0
+
+
+def test_group_by_missing_key_errors():
+    df = tfs.frame_from_rows([{"a": 1.0}])
+    with pytest.raises(KeyError):
+        df.group_by("nope")
+
+
+def test_host_string_column_rides_along():
+    df = tfs.frame_from_rows(
+        [{"x": float(i), "s": f"row{i}"} for i in range(4)]
+    )
+    assert df.schema["s"].dtype is dt.string
+    with tfs.with_graph():
+        x = tfs.block(df, "x")
+        z = (x * 2.0).named("z")
+        out = tfs.map_blocks(z, df).collect()
+    assert out[2]["s"] == "row2" and out[2]["z"] == 4.0
+
+
+def test_block_placeholder_rejects_host_column():
+    df = tfs.frame_from_rows([{"s": "a"}])
+    with pytest.raises(TypeError):
+        tfs.block(df, "s")

@@ -1,0 +1,139 @@
+"""Program capture + static analysis tests (≙ TFInitializationSuite graph
+import/analysis; graph file loading, test/dsl.scala:109-112)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.program import (
+    TensorSpec,
+    analyze_program,
+    load_program,
+    program_from_function,
+    save_program,
+)
+from tensorframes_tpu.shape import Shape, Unknown
+
+
+def _specs(**kw):
+    return {
+        name: TensorSpec(name, dtype, Shape.from_any(shape))
+        for name, (dtype, shape) in kw.items()
+    }
+
+
+def test_analysis_discovers_batch_dims():
+    # output dims co-varying with an Unknown input dim are marked Unknown
+    prog = program_from_function(
+        lambda x: {"z": x + 1.0},
+        _specs(x=(dt.float64, [None, 3])),
+    )
+    prog = analyze_program(prog)
+    out = prog.output("z")
+    assert out.shape.dims == (Unknown, 3)
+    assert out.dtype is dt.float64
+
+
+def test_analysis_static_dims_stay_static():
+    import jax.numpy as jnp
+
+    prog = program_from_function(
+        lambda x: {"z": jnp.sum(x, axis=0)},
+        _specs(x=(dt.float64, [None, 4])),
+    )
+    prog = analyze_program(prog)
+    assert prog.output("z").shape.dims == (4,)
+
+
+def test_analysis_hint_override():
+    # the hint-override rule (TensorFlowOps.scala:126-133)
+    prog = program_from_function(
+        lambda x: {"z": x * 2.0},
+        _specs(x=(dt.float64, [None])),
+    )
+    prog = analyze_program(prog, hints={"z": Shape.of(7)})
+    assert prog.output("z").shape.dims == (7,)
+
+
+def test_dsl_compile_inputs_outputs():
+    with tfs.with_graph():
+        a = tfs.placeholder(dt.float64, [None], name="a")
+        b = tfs.placeholder(dt.float64, [None], name="b")
+        s = tfs.add(a, b, name="s")
+        prog = analyze_program(tfs.dsl.compile_fetches([s]))
+    assert set(prog.input_names) == {"a", "b"}
+    assert prog.output_names == ["s"]
+
+
+def test_dsl_duplicate_fetch_names_rejected():
+    # ≙ core.py:106-108 unique-column-name check
+    with tfs.with_graph():
+        a = tfs.placeholder(dt.float64, [None], name="a")
+        f1 = tfs.identity(a).named("z")
+        f2 = tfs.identity(a).named("z")
+        with pytest.raises(ValueError):
+            tfs.dsl.compile_fetches([f1, f2])
+
+
+def test_dsl_name_dedup_counters():
+    # TF-style name_1, name_2 dedup (dsl/Paths.scala:40-55)
+    with tfs.with_graph():
+        a = tfs.placeholder(dt.float64, [None], name="a")
+        n1 = tfs.identity(a)
+        n2 = tfs.identity(a)
+        assert n1.name == "identity"
+        assert n2.name == "identity_1"
+
+
+def test_dsl_scopes():
+    with tfs.with_graph():
+        with tfs.scope("outer"):
+            a = tfs.placeholder(dt.float64, [None], name="a")
+            assert a.name == "outer/a"
+            with tfs.scope("inner"):
+                b = tfs.constant(1.0, name="c")
+                assert b.name == "outer/inner/c"
+
+
+def test_rename_inputs():
+    prog = program_from_function(
+        lambda x: {"z": x + 1.0}, _specs(x=(dt.float64, [None]))
+    )
+    prog2 = prog.rename_inputs({"x": "col"})
+    assert prog2.input_names == ["col"]
+    import jax.numpy as jnp
+
+    out = prog2.fn({"col": jnp.asarray([1.0, 2.0])})
+    assert np.allclose(np.asarray(out["z"]), [2.0, 3.0])
+
+
+def test_save_load_roundtrip(tmp_path):
+    # serialized StableHLO artifacts ≙ proto GraphDef files
+    # (PythonInterface.scala:115-118)
+    prog = program_from_function(
+        lambda x: {"z": x * 3.0}, _specs(x=(dt.float32, [None]))
+    )
+    prog = analyze_program(prog)
+    path = str(tmp_path / "prog.tfpu")
+    save_program(prog, path)
+    loaded = load_program(path)
+    assert loaded.input_names == ["x"]
+    import jax.numpy as jnp
+
+    out = loaded.fn({"x": jnp.asarray(np.array([1.0, 2.0], np.float32))})
+    z = np.asarray(out["z"])
+    assert np.allclose(z, [3.0, 6.0])
+
+
+def test_loaded_program_drives_map_blocks(tmp_path):
+    prog = program_from_function(
+        lambda x: {"z": x + 10.0}, _specs(x=(dt.float64, [None]))
+    )
+    prog = analyze_program(prog)
+    path = str(tmp_path / "prog.tfpu")
+    save_program(prog, path)
+    loaded = load_program(path)
+    df = tfs.frame_from_rows([{"x": float(i)} for i in range(4)])
+    out = tfs.map_blocks(loaded, df).collect()
+    assert [r["z"] for r in out] == [10.0 + i for i in range(4)]

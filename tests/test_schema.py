@@ -1,0 +1,69 @@
+"""ColumnInfo / Schema tests (≙ ColumnInformation + DataFrameInfo)."""
+
+import pytest
+
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.schema import ColumnInfo, Schema
+from tensorframes_tpu.shape import Shape, Unknown
+
+
+def _col(name, dtype=dt.float64, dims=(Unknown,)):
+    return ColumnInfo(name, dtype, Shape(dims))
+
+
+def test_cell_vs_block_shape():
+    c = _col("x", dims=(Unknown, 2))
+    assert c.block_shape.dims == (Unknown, 2)
+    assert c.cell_shape.dims == (2,)
+
+
+def test_block_shape_needs_lead_dim():
+    with pytest.raises(ValueError):
+        ColumnInfo("x", dt.float64, Shape.empty())
+
+
+def test_host_columns_scalar_only():
+    # ≙ datatypes.scala:577-581 single-scalar strings
+    ColumnInfo("s", dt.string, Shape((Unknown,)))
+    with pytest.raises(ValueError):
+        ColumnInfo("s", dt.string, Shape((Unknown, 3)))
+
+
+def test_merge_dtype_conflict():
+    a = _col("x", dt.float64)
+    b = _col("x", dt.float32)
+    with pytest.raises(dt.UnsupportedTypeError):
+        a.merge(b)
+
+
+def test_merge_shapes():
+    a = _col("x", dims=(5, 2))
+    b = _col("x", dims=(7, 2))
+    assert a.merge(b).block_shape.dims == (Unknown, 2)
+
+
+def test_schema_lookup_and_errors():
+    s = Schema([_col("a"), _col("b")])
+    assert s.names == ["a", "b"]
+    assert "a" in s
+    with pytest.raises(KeyError) as e:
+        s["zzz"]
+    assert "a" in str(e.value)  # error enumerates available columns
+    with pytest.raises(ValueError):
+        Schema([_col("a"), _col("a")])
+
+
+def test_schema_transforms():
+    s = Schema([_col("a"), _col("b")])
+    assert s.select(["b"]).names == ["b"]
+    s2 = s.append([_col("c")])
+    assert s2.names == ["a", "b", "c"]
+    s3 = s.replace(_col("a", dt.int32))
+    assert s3["a"].dtype is dt.int32
+
+
+def test_explain_rendering():
+    s = Schema([_col("y", dims=(Unknown, 2))])
+    text = s.explain()
+    assert "root" in text
+    assert "y" in text and "[?,2]" in text

@@ -1,0 +1,90 @@
+"""Shape lattice tests (≙ the reference's Shape behaviors,
+Shape.scala:16-109, exercised through ExtraOperationsSuite)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.shape import (
+    Shape,
+    Unknown,
+    infer_physical_shape,
+    shape_of_nested,
+)
+
+
+def test_basic_construction():
+    s = Shape.of(2, 3)
+    assert s.dims == (2, 3)
+    assert s.rank == 2
+    assert not s.has_unknown
+    assert Shape.empty().is_scalar
+    assert Shape.unknown(2).dims == (Unknown, Unknown)
+
+
+def test_from_any_none_is_unknown():
+    # None → -1, the Python client convention (core.py:38-40)
+    s = Shape.from_any([None, 3])
+    assert s.dims == (Unknown, 3)
+
+
+def test_prepend_tail_drop_inner():
+    s = Shape.of(3, 4)
+    assert s.prepend(10).dims == (10, 3, 4)
+    assert s.prepend(None).dims == (Unknown, 3, 4)
+    assert s.prepend(10).tail == s
+    assert s.drop_inner().dims == (3,)
+    with pytest.raises(ValueError):
+        Shape.empty().tail
+
+
+def test_num_elements():
+    assert Shape.of(2, 3).num_elements == 6
+    assert Shape.empty().num_elements == 1
+    assert Shape.of(2, Unknown).num_elements is None
+
+
+def test_precision_lattice():
+    # ≙ Shape.checkMorePreciseThan (Shape.scala:54-59)
+    assert Shape.of(2, 3).is_more_precise_than(Shape.of(Unknown, 3))
+    assert Shape.of(2, 3).is_more_precise_than(Shape.of(2, 3))
+    assert not Shape.of(Unknown, 3).is_more_precise_than(Shape.of(2, 3))
+    assert not Shape.of(2).is_more_precise_than(Shape.of(2, 3))
+
+
+def test_merge_to_unknown():
+    # ≙ ExperimentalOperations.scala:168-178
+    m = Shape.of(2, 3).merge(Shape.of(2, 5))
+    assert m.dims == (2, Unknown)
+    assert Shape.of(2).merge(Shape.of(2, 3)) is None
+    assert Shape.of(2, 3).merge(Shape.of(2, 3)).dims == (2, 3)
+
+
+def test_refine_hint_override():
+    # hint dims win where known (TensorFlowOps.scala:126-133)
+    s = Shape.of(Unknown, 3)
+    assert s.refine(Shape.of(5, Unknown)).dims == (5, 3)
+    assert s.refine(Shape.of(Unknown, 7)).dims == (Unknown, 7)
+
+
+def test_infer_physical_shape():
+    # ≙ DataOps.inferPhysicalShape (DataOps.scala:103-144)
+    assert infer_physical_shape(12, Shape.of(Unknown, 3)).dims == (4, 3)
+    assert infer_physical_shape(12, Shape.of(4, 3)).dims == (4, 3)
+    with pytest.raises(ValueError):
+        infer_physical_shape(13, Shape.of(Unknown, 3))
+    with pytest.raises(ValueError):
+        infer_physical_shape(12, Shape.of(Unknown, Unknown))
+    with pytest.raises(ValueError):
+        infer_physical_shape(10, Shape.of(5, 3))
+    assert infer_physical_shape(0, Shape.of(Unknown, 0)).dims == (0, 0)
+
+
+def test_shape_of_nested():
+    assert shape_of_nested(1.0).dims == ()
+    assert shape_of_nested([1.0, 2.0]).dims == (2,)
+    assert shape_of_nested([[1, 2, 3], [4, 5, 6]]).dims == (2, 3)
+    assert shape_of_nested(np.zeros((4, 5))).dims == (4, 5)
+
+
+def test_str_rendering():
+    assert str(Shape.of(Unknown, 2)) == "[?,2]"
