@@ -1,0 +1,126 @@
+"""Benchmark harness. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric: map_blocks model-scoring throughput in rows/sec/chip on
+the real TPU (BASELINE.json: "map_blocks rows/sec/chip"). The model config
+escalates as model families land (logreg → Inception-v3); sub-metrics are
+printed as comment lines prefixed with '#' so the driver's JSON line stays
+unambiguous.
+
+The reference publishes no numbers (BASELINE.md) — the baseline here is
+the first recorded value of this harness; vs_baseline is measured against
+the "published" dict in BASELINE.json when present, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import logreg
+
+    x, _ = logreg.make_synthetic_mnist(n_rows)
+    frame = tfs.frame_from_arrays({"features": x}, num_blocks=1).to_device()
+    params = logreg.init_params()
+    scoring = logreg.scoring_program(params)
+    program = tfs.compile_program(lambda features: scoring(features), frame)
+
+    def run_once():
+        out = tfs.map_blocks(program, frame)
+        [b] = out.blocks()
+        for v in (b["scores"], b["label"]):
+            v.block_until_ready()
+
+    run_once()  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt = time.perf_counter() - t0
+    return n_rows * iters / dt
+
+
+def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
+    """README add-3 config (BASELINE config 1)."""
+    import tensorframes_tpu as tfs
+
+    frame = tfs.frame_from_arrays(
+        {"x": np.arange(n_rows, dtype=np.float32)}, num_blocks=1
+    ).to_device()
+    program = tfs.compile_program(lambda x: {"z": x + 3.0}, frame)
+
+    def run_once():
+        out = tfs.map_blocks(program, frame)
+        [b] = out.blocks()
+        b["z"].block_until_ready()
+
+    run_once()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt = time.perf_counter() - t0
+    return n_rows * iters / dt
+
+
+def _bench_reduce_blocks(n_rows: int = 1_000_000):
+    """reduce_blocks wall-clock (BASELINE config 2 analogue)."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import dtypes as dt
+
+    arr = np.stack([np.arange(n_rows, dtype=np.float32)] * 2, axis=1)
+    frame = tfs.frame_from_arrays({"y": arr}, num_blocks=1).to_device()
+    with tfs.with_graph():
+        y_input = tfs.block(frame, "y", tf_name="y_input")
+        y = tfs.reduce_sum(y_input, axis=0, name="y")
+        program = tfs.compile_program(y, frame, reduce_mode="blocks")
+
+    def run_once():
+        return tfs.reduce_blocks(program, frame)
+
+    run_once()
+    t0 = time.perf_counter()
+    run_once()
+    return time.perf_counter() - t0
+
+
+def main():
+    import jax
+
+    n_chips = max(1, len(jax.devices()))
+    logreg_rps = _bench_map_blocks_logreg()
+    add3_rps = _bench_add3()
+    reduce_s = _bench_reduce_blocks()
+
+    print(f"# chips={n_chips} devices={jax.devices()}")
+    print(f"# add3_map_blocks_rows_per_sec={add3_rps:.0f}")
+    print(f"# reduce_blocks_1M_wall_s={reduce_s:.4f}")
+    print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")
+
+    baseline = None
+    try:
+        with open("BASELINE.json") as f:
+            baseline = json.load(f).get("published", {}).get(
+                "logreg_map_blocks_rows_per_sec_per_chip"
+            )
+    except Exception:
+        pass
+    value = logreg_rps / n_chips
+    vs = value / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "map_blocks logreg-784 rows/sec/chip",
+                "value": round(value, 1),
+                "unit": "rows/s/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,7 @@ from .program import (  # noqa: E402,F401
 from .validation import ValidationError  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
     aggregate,
+    compile_program,
     map_blocks,
     map_rows,
     reduce_blocks,
@@ -99,6 +100,7 @@ __all__ = [
     "reduce_rows",
     "reduce_blocks",
     "aggregate",
+    "compile_program",
     "analyze",
     "append_shape",
     "print_schema",

@@ -145,7 +145,10 @@ class TensorFrame:
             n = _block_num_rows(b)
             cols = {}
             for name in self.schema.names:
-                cols[name] = b[name]
+                v = b[name]
+                if not isinstance(v, list):
+                    v = np.asarray(v)  # device arrays come back in one copy
+                cols[name] = v
             for i in range(n):
                 row = {}
                 for name, v in cols.items():
@@ -161,15 +164,14 @@ class TensorFrame:
     def first(self) -> Dict[str, object]:
         for b in self.blocks():
             if _block_num_rows(b) > 0:
-                return {
-                    name: (
-                        b[name][0].item()
-                        if isinstance(b[name][0], (np.generic,))
-                        or (isinstance(b[name][0], np.ndarray) and b[name][0].ndim == 0)
-                        else b[name][0]
-                    )
-                    for name in self.schema.names
-                }
+                row = {}
+                for name in self.schema.names:
+                    cell = b[name][0]
+                    if not isinstance(cell, (list, str, bytes)):
+                        cell = np.asarray(cell)  # incl. device arrays
+                        cell = cell.item() if cell.ndim == 0 else cell
+                    row[name] = cell
+                return row
         raise ValueError("Frame is empty")
 
     def to_pandas(self):
@@ -179,7 +181,10 @@ class TensorFrame:
         for name in self.schema.names:
             vals = []
             for b in self.blocks():
-                vals.extend(list(b[name]))
+                v = b[name]
+                if not isinstance(v, (list, np.ndarray)):
+                    v = np.asarray(v)  # device arrays → host in one copy
+                vals.extend(list(v))
             data[name] = vals
         return pd.DataFrame(data)
 
@@ -254,6 +259,76 @@ class TensorFrame:
     def cache(self) -> "TensorFrame":
         self.blocks()
         return self
+
+    # -- device placement ---------------------------------------------------
+    @property
+    def is_sharded(self) -> bool:
+        """True when column storage is global ``jax.Array``\\ s over a mesh."""
+        return getattr(self, "_mesh", None) is not None
+
+    @property
+    def mesh(self):
+        return getattr(self, "_mesh", None)
+
+    def to_device(self, mesh=None, axis: Optional[str] = None) -> "TensorFrame":
+        """Shard the frame over a device mesh: every device column becomes a
+        single global ``jax.Array`` with its row dim split over the batch
+        axis (≙ a Spark DataFrame's partitions living on executors — but in
+        HBM, and chained map verbs never leave the device).
+
+        Host-only columns stay host-resident and ride along.
+        """
+        import jax
+
+        from .parallel.mesh import batch_sharding, make_mesh
+
+        mesh = mesh or make_mesh()
+        axis = axis or get_config().batch_axis
+        dp = mesh.shape[axis]
+        blocks = self.blocks()
+        total = self.num_rows
+        # XLA shards only divisible lead dims; the remainder rows stay in a
+        # small host tail block (verbs handle multi-block frames natively),
+        # so no padding ever corrupts reduction semantics.
+        n_main = (total // dp) * dp
+        merged: Block = {}
+        tail: Block = {}
+        for info in self.schema:
+            parts = [b[info.name] for b in blocks]
+            if info.is_device and all(not isinstance(p, list) for p in parts):
+                arr = np.concatenate([np.asarray(p) for p in parts], axis=0)
+                sharding = batch_sharding(mesh, arr.ndim, axis)
+                merged[info.name] = jax.device_put(arr[:n_main], sharding)
+                if n_main < total:
+                    tail[info.name] = arr[n_main:]
+            else:
+                flat = []
+                for p in parts:
+                    flat.extend(list(p))
+                merged[info.name] = flat[:n_main]
+                if n_main < total:
+                    tail[info.name] = flat[n_main:]
+        out_blocks = [merged] + ([tail] if n_main < total else [])
+        out = TensorFrame(out_blocks, self.schema)
+        out._mesh = mesh
+        out._axis = axis
+        return out
+
+    def to_host(self, num_blocks: Optional[int] = None) -> "TensorFrame":
+        """Materialize device columns back to host numpy blocks."""
+        blocks = self.blocks()
+        host_blocks: List[Block] = []
+        for b in blocks:
+            host_blocks.append(
+                {
+                    k: (np.asarray(v) if not isinstance(v, list) else v)
+                    for k, v in b.items()
+                }
+            )
+        frame = TensorFrame(host_blocks, self.schema)
+        if num_blocks:
+            frame = frame.repartition(num_blocks)
+        return frame
 
     def group_by(self, *keys: str) -> "GroupedData":
         """Group rows by key column(s) for keyed ``aggregate``

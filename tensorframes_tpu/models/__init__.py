@@ -1,0 +1,15 @@
+"""Model-level workloads served through the frame verbs.
+
+The reference ships no model *framework* — its models are demo workloads
+driven through the verbs: k-means via map_blocks+aggregate
+(tensorframes_snippets/kmeans.py:85-162), harmonic/geometric means via
+aggregate (geom_mean.py:26-49), and a VGG-16 inference sketch
+(read_image.py). The BASELINE configs add MNIST logistic-regression
+scoring, Inception-v3 batch inference, and BERT-base embedding extraction.
+
+Here each model family is a first-class module producing *programs* (pure
+jax functions + params) that plug into ``map_blocks``/``map_rows`` like any
+user program, plus sharded training steps for the multi-chip path.
+"""
+
+from . import logreg  # noqa: F401

@@ -1,0 +1,71 @@
+"""Multinomial logistic regression (MNIST-class scoring workload).
+
+BASELINE config 3: "MNIST logistic-regression scoring: map_blocks over a
+784-dim feature column". The model is a single dense layer + softmax —
+one MXU matmul per block; scoring plugs into ``map_blocks`` as a plain
+function program, and a data-parallel training step is provided for
+completeness.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(
+    num_features: int = 784,
+    num_classes: int = 10,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    k = jax.random.PRNGKey(seed)
+    w = jax.random.normal(k, (num_features, num_classes), dtype) * 0.01
+    b = jnp.zeros((num_classes,), dtype)
+    return {"w": w, "b": b}
+
+
+def scoring_program(params: Dict[str, jnp.ndarray]):
+    """A map_blocks program: features block [n, d] → {"scores", "label"}.
+
+    Params are closure-captured constants (≙ frozen tf.Variables,
+    core.py:42-56).
+    """
+
+    def program(features):
+        logits = features @ params["w"] + params["b"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        return {
+            "scores": probs.astype(features.dtype),
+            "label": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+        }
+
+    return program
+
+
+def loss_fn(params, features, labels):
+    logits = features @ params["w"] + params["b"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    return nll
+
+
+def train_step(params, opt_state, features, labels, tx):
+    import optax
+
+    loss, grads = jax.value_and_grad(loss_fn)(params, features, labels)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def make_synthetic_mnist(
+    n: int = 10_000, num_features: int = 784, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, num_features), dtype=np.float32)
+    y = rng.integers(0, 10, size=(n,), dtype=np.int64)
+    return x, y

@@ -1,0 +1,273 @@
+"""A compact, real transformer encoder — the framework's flagship model.
+
+Written in pure JAX (explicit params pytree, no flax dependency) so every
+sharding decision is visible. This powers:
+
+* BERT-style embedding extraction through ``map_rows``/``map_blocks``
+  (BASELINE config 5);
+* the multi-chip training-step dry-run (``__graft_entry__.dryrun_multichip``)
+  with genuine dp/tp/sp shardings over a ``jax.sharding.Mesh``.
+
+Sharding layout (the "How to Scale Your Model" recipe: pick a mesh,
+annotate, let XLA insert the ICI collectives):
+
+* batch dim → ``dp``; sequence dim of activations → ``sp``
+  (attention gathers k/v over ``sp`` via XLA-inserted all-gathers; the
+  manual ring-attention kernel in ops/attention.py is the alternative
+  path for long sequences);
+* attention head dim and MLP hidden dim → ``tp`` (Megatron-style:
+  column-parallel in, row-parallel out, one psum per block);
+* everything is bfloat16 on the MXU with float32 params/optimizer state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    hidden: int = 768
+    num_heads: int = 12
+    num_layers: int = 12
+    mlp_ratio: int = 4
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16  # activations/compute; params stay f32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+    @property
+    def mlp_hidden(self) -> int:
+        return self.hidden * self.mlp_ratio
+
+
+def bert_base(**kw) -> TransformerConfig:
+    """BERT-base geometry (12L/768H/12 heads)."""
+    return TransformerConfig(vocab_size=30_522, **kw)
+
+
+def tiny(**kw) -> TransformerConfig:
+    """A tiny config for tests and CPU dry-runs."""
+    return TransformerConfig(
+        vocab_size=128, hidden=32, num_heads=4, num_layers=2, max_seq_len=16, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict:
+    """Initialize the parameter pytree (float32)."""
+    k = jax.random.PRNGKey(seed)
+    keys = jax.random.split(k, 4 + 4 * cfg.num_layers)
+    h, m = cfg.hidden, cfg.mlp_hidden
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    params = {
+        "embed": {
+            "tok": dense(keys[0], (cfg.vocab_size, h), 0.02),
+            "pos": dense(keys[1], (cfg.max_seq_len, h), 0.02),
+        },
+        "final_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        ka, kb, kc, kd = keys[4 + 4 * i : 8 + 4 * i]
+        params["layers"].append(
+            {
+                "ln1": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+                "ln2": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+                "attn": {
+                    "qkv": dense(ka, (h, 3 * h)),
+                    "out": dense(kb, (h, h)),
+                },
+                "mlp": {
+                    "in": dense(kc, (h, m)),
+                    "in_bias": jnp.zeros((m,)),
+                    "out": dense(kd, (m, h)),
+                    "out_bias": jnp.zeros((h,)),
+                },
+            }
+        )
+    return params
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict:
+    """PartitionSpec pytree: Megatron-style tensor parallelism over ``tp``.
+
+    qkv / mlp-in are column-parallel (output dim sharded); out / mlp-out
+    are row-parallel (input dim sharded) → XLA inserts one psum per block.
+    """
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "ln1": {"scale": ns(), "bias": ns()},
+        "ln2": {"scale": ns(), "bias": ns()},
+        "attn": {"qkv": ns(None, "tp"), "out": ns("tp", None)},
+        "mlp": {
+            "in": ns(None, "tp"),
+            "in_bias": ns("tp"),
+            "out": ns("tp", None),
+            "out_bias": ns(),
+        },
+    }
+    return {
+        "embed": {"tok": ns(), "pos": ns()},
+        "final_ln": {"scale": ns(), "bias": ns()},
+        "layers": [layer for _ in range(cfg.num_layers)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _attention(cfg: TransformerConfig, p, x, mask):
+    b, s, h = x.shape
+    qkv = (x @ p["qkv"].astype(x.dtype)).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    # [b, heads, s, d]
+    q = q.transpose(0, 2, 1, 3) / np.sqrt(cfg.head_dim)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :], scores, -1e9)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+    return ctx @ p["out"].astype(x.dtype)
+
+
+def _mlp(p, x):
+    y = x @ p["in"].astype(x.dtype) + p["in_bias"].astype(x.dtype)
+    y = jax.nn.gelu(y)
+    return y @ p["out"].astype(x.dtype) + p["out_bias"].astype(x.dtype)
+
+
+def forward(
+    cfg: TransformerConfig,
+    params: Dict,
+    tokens: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Encoder forward: int tokens [b, s] → hidden states [b, s, h]."""
+    x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+    s = tokens.shape[1]
+    x = x + params["embed"]["pos"][:s].astype(cfg.dtype)
+    for p in params["layers"]:
+        x = x + _attention(cfg, p["attn"], _layer_norm(x, **p["ln1"]), mask)
+        x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+    return _layer_norm(x, **params["final_ln"])
+
+
+def embed_program(cfg: TransformerConfig, params: Dict):
+    """map_blocks program: token block [n, s] → {"embedding": [n, h]}.
+
+    Mean-pooled final hidden states — BERT-style sentence embeddings
+    (BASELINE config 5)."""
+
+    def program(tokens):
+        hs = forward(cfg, params, tokens)
+        return {"embedding": hs.mean(axis=1).astype(jnp.float32)}
+
+    return program
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+def loss_fn(cfg: TransformerConfig, params, tokens, targets):
+    """Causal-LM-style cross entropy against the token embedding matrix."""
+    hs = forward(cfg, params, tokens)
+    logits = hs.astype(jnp.float32) @ params["embed"]["tok"].T
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return nll.mean()
+
+
+def make_train_step(cfg: TransformerConfig, tx):
+    """Plain (unsharded) jittable train step."""
+
+    def step(params, opt_state, tokens, targets):
+        import optax
+
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_sharded_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    tx,
+    seq_axis: Optional[str] = "sp",
+):
+    """Jit the train step over a mesh with dp/tp(/sp) shardings.
+
+    Data: tokens/targets [b, s] → P('dp', 'sp'). Params: Megatron tp
+    layout. Optimizer state mirrors param shardings. XLA's SPMD partitioner
+    inserts the all-gathers/psums over ICI.
+    """
+    data_spec = P("dp", seq_axis) if seq_axis else P("dp", None)
+    data_sharding = NamedSharding(mesh, data_spec)
+    shardings = param_shardings(cfg, mesh)
+
+    def step(params, opt_state, tokens, targets):
+        import optax
+
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Optimizer state mirrors param shardings: init under jit with sharded
+    # params — XLA propagates the tp layout into adam's mu/nu, so optimizer
+    # memory scales down with tp exactly like the params.
+    init_opt_state = jax.jit(tx.init, in_shardings=(shardings,))
+
+    # opt_state in/out shardings are inferred from the (already sharded)
+    # state arrays produced by init_opt_state.
+    jitted = jax.jit(
+        step,
+        in_shardings=(shardings, None, data_sharding, data_sharding),
+        out_shardings=(shardings, None, NamedSharding(mesh, P())),
+    )
+    return jitted, data_sharding, shardings, init_opt_state
+
+
+def synthetic_batch(cfg: TransformerConfig, batch: int, seq: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    targets = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    return tokens, targets

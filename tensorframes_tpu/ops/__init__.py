@@ -1,3 +1,17 @@
-from .verbs import aggregate, map_blocks, map_rows, reduce_blocks, reduce_rows
+from .verbs import (
+    aggregate,
+    compile_program,
+    map_blocks,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+)
 
-__all__ = ["aggregate", "map_blocks", "map_rows", "reduce_blocks", "reduce_rows"]
+__all__ = [
+    "aggregate",
+    "compile_program",
+    "map_blocks",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+]

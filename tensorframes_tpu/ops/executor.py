@@ -41,12 +41,20 @@ class CompiledProgram:
         # (performMapRows, DebugRowOps.scala:826-864).
         self.jit_vmap = jax.jit(jax.vmap(program.fn))
 
-    def run_block(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    def run_block(
+        self, feeds: Dict[str, np.ndarray], to_numpy: bool = True
+    ) -> Dict[str, np.ndarray]:
         out = self.jit_block({k: jnp.asarray(v) for k, v in feeds.items()})
+        if not to_numpy:
+            return out  # stay in HBM: sharded frames chain without transfers
         return {k: np.asarray(v) for k, v in out.items()}
 
-    def run_rows(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    def run_rows(
+        self, feeds: Dict[str, np.ndarray], to_numpy: bool = True
+    ) -> Dict[str, np.ndarray]:
         out = self.jit_vmap({k: jnp.asarray(v) for k, v in feeds.items()})
+        if not to_numpy:
+            return out
         return {k: np.asarray(v) for k, v in out.items()}
 
     def run_single_row(self, feeds: Dict[str, object]) -> Dict[str, np.ndarray]:

@@ -49,12 +49,7 @@ from ..validation import (
     validate_reduce_blocks,
     validate_reduce_rows,
 )
-from .executor import (
-    CompiledProgram,
-    block_is_ragged,
-    gather_feeds,
-    make_pair_fold,
-)
+from .executor import block_is_ragged, gather_feeds, make_pair_fold
 
 logger = get_logger(__name__)
 
@@ -93,6 +88,11 @@ def _normalize_program(
     """
     seg_info = None
     if isinstance(fetches, Program):
+        # already-analyzed Programs pass through untouched so their memoized
+        # XLA executables (Program.compiled) survive across verb calls;
+        # seg_info recorded at compile time keeps the aggregate fast path.
+        if fetches.outputs:
+            return fetches, getattr(fetches, "seg_info", None)
         program = fetches
     elif isinstance(fetches, Node) or (
         isinstance(fetches, (list, tuple))
@@ -120,6 +120,7 @@ def _normalize_program(
             f"callable; got {type(fetches).__name__}"
         )
     program = analyze_program(program)
+    program.seg_info = seg_info  # survives Program reuse via compile_program
     return program, seg_info
 
 
@@ -150,6 +151,25 @@ def _sorted_output_infos(program: Program, block_mode: bool) -> List[ColumnInfo]
     return infos
 
 
+def compile_program(
+    fetches: Fetches,
+    frame,
+    block: bool = True,
+    reduce_mode: Optional[str] = None,
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> Program:
+    """Pre-compile fetches against a frame's schema into a reusable Program.
+
+    Passing the returned Program to a verb repeatedly reuses one XLA
+    executable across calls (the jit cache lives on the Program), instead
+    of re-tracing per invocation — the steady-state serving path.
+    """
+    program, _ = _normalize_program(
+        fetches, frame.schema, block=block, reduce_mode=reduce_mode
+    )
+    return _apply_feed_dict(program, feed_dict)
+
+
 # ---------------------------------------------------------------------------
 # map_blocks
 # ---------------------------------------------------------------------------
@@ -173,7 +193,7 @@ def map_blocks(
     program, _ = _normalize_program(fetches, frame.schema, block=True)
     program = _apply_feed_dict(program, feed_dict)
     validate_map(program, frame.schema, block=True, trim=trim)
-    compiled = CompiledProgram(program)
+    compiled = program.compiled()
     out_infos = _sorted_output_infos(program, block_mode=True)
     if trim:
         schema = Schema(out_infos)
@@ -181,13 +201,17 @@ def map_blocks(
         schema = Schema(out_infos + frame.schema.columns)
     parent = frame
     input_names = program.input_names
+    sharded = frame.is_sharded
 
     def compute() -> List[Block]:
         out_blocks: List[Block] = []
         for b in parent.blocks():
             n = _block_num_rows(b)
             feeds = gather_feeds(b, input_names, program)
-            outs = compiled.run_block(feeds)
+            # sharded frames keep outputs in HBM; XLA propagates the input
+            # sharding through the program (SPMD), so chained maps run
+            # entirely on-device with no host round-trip.
+            outs = compiled.run_block(feeds, to_numpy=not sharded)
             if trim:
                 out_blocks.append({i.name: outs[i.name] for i in out_infos})
                 continue
@@ -205,7 +229,11 @@ def map_blocks(
             out_blocks.append(nb)
         return out_blocks
 
-    return TensorFrame(None, schema, pending=compute)
+    result = TensorFrame(None, schema, pending=compute)
+    if sharded:
+        result._mesh = frame.mesh
+        result._axis = getattr(frame, "_axis", None)
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -229,7 +257,7 @@ def map_rows(
     program, _ = _normalize_program(fetches, frame.schema, block=False)
     program = _apply_feed_dict(program, feed_dict)
     validate_map(program, frame.schema, block=False)
-    compiled = CompiledProgram(program)
+    compiled = program.compiled()
     out_infos = _sorted_output_infos(program, block_mode=False)
     schema = Schema(out_infos + frame.schema.columns)
     parent = frame
@@ -253,7 +281,7 @@ def map_rows(
                 continue
             if not block_is_ragged(b, input_names):
                 feeds = gather_feeds(b, input_names, program)
-                outs = compiled.run_rows(feeds)
+                outs = compiled.run_rows(feeds, to_numpy=not parent.is_sharded)
             else:
                 # ragged path: per-row programs, compiled per cell shape
                 # (≙ per-row dynamic lead dim, TFDataOps.scala:90-103)
@@ -276,7 +304,11 @@ def map_rows(
             out_blocks.append(nb)
         return out_blocks
 
-    return TensorFrame(None, schema, pending=compute)
+    result = TensorFrame(None, schema, pending=compute)
+    if frame.is_sharded:
+        result._mesh = frame.mesh
+        result._axis = getattr(frame, "_axis", None)
+    return result
 
 
 def _map_pandas(fetches, pdf, feed_dict, block: bool):
@@ -343,6 +375,11 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
                         f"Column {x!r} holds ragged cells; reduce_rows "
                         "needs dense blocks (run analyze() first)."
                     ) from None
+            elif not isinstance(v, np.ndarray):
+                # sharded columns: the pairwise fold is sequential by
+                # contract, so pull the shard-split array to host rather
+                # than scan over a dp-sharded lead dim (unsupported slice)
+                v = np.asarray(v)
             feeds[x] = v
         if n == 1:
             partials.append({x: np.asarray(feeds[x][0]) for x in out_names})
@@ -380,7 +417,7 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     )
     validate_reduce_blocks(program, frame.schema)
     out_names = [o.name for o in program.outputs]
-    compiled = CompiledProgram(program)
+    compiled = program.compiled()
 
     partials: List[Dict[str, np.ndarray]] = []
     for b in frame.blocks():
@@ -524,7 +561,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         out_cols = {x: np.asarray(res[x]) for x in out_names}
     else:
         # -- generic chunked-compaction path --------------------------------
-        compiled = CompiledProgram(program)
+        compiled = program.compiled()
         buf = max(2, get_config().aggregate_buffer_size)
         sorted_vals = {x: val_cols[x][order] for x in out_names}
         results = {x: [] for x in out_names}

@@ -1,0 +1,13 @@
+from .distributed import init_distributed, is_multiprocess, process_index
+from .mesh import BATCH_AXIS, batch_sharding, device_count, make_mesh, replicated
+
+__all__ = [
+    "BATCH_AXIS",
+    "batch_sharding",
+    "device_count",
+    "init_distributed",
+    "is_multiprocess",
+    "make_mesh",
+    "process_index",
+    "replicated",
+]

@@ -1,0 +1,73 @@
+"""Multi-host (multi-process) initialization.
+
+The reference ships work to executors over Spark's cluster runtime; the
+TPU-native equivalent is JAX's single-controller multi-process model: one
+process per TPU host, all running the same program, glued by
+``jax.distributed.initialize`` (DCN for control, ICI/DCN for collectives).
+One Spark-executor-per-host maps to one-process-per-host (the BASELINE
+north star's deployment shape).
+
+On a single host this module is a no-op; every entry point is safe to call
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> None:
+    """Initialize multi-process JAX if configured (env vars or args).
+
+    Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``); if neither args nor env are
+    present this is a single-process no-op.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        logger.debug("init_distributed: single-process mode (no coordinator)")
+        return
+    num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "0")
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "init_distributed: process %d/%d via %s",
+        process_id,
+        num_processes,
+        coordinator_address,
+    )
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -1,0 +1,82 @@
+"""Device mesh construction and axis conventions.
+
+The reference's distributed substrate is Apache Spark: partitions +
+broadcast + driver-coordinated reduce (SURVEY.md §5-comm). The TPU-native
+substrate is a ``jax.sharding.Mesh`` over the chips of a slice, with data
+laid out by ``NamedSharding`` and cross-chip traffic compiled to ICI
+collectives by XLA's SPMD partitioner.
+
+Axis naming conventions used across the framework:
+
+* ``dp``  — data/batch parallelism (≙ Spark partitions; frames shard their
+  row dimension here)
+* ``tp``  — tensor parallelism (model weights; used by models/)
+* ``sp``  — sequence/context parallelism (long-context attention)
+* ``pp`` / ``ep`` — pipeline / expert parallelism (model-level)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import get_config
+
+BATCH_AXIS = "dp"
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh. Default: a 1-D data-parallel mesh over every device.
+
+    ``axes`` maps axis name → size; one entry may be -1 meaning "all
+    remaining devices". Example: ``make_mesh({"dp": -1})`` or
+    ``make_mesh({"dp": 2, "tp": 4})``.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if not axes:
+        axes = {BATCH_AXIS: n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if n % known != 0:
+            raise ValueError(
+                f"Cannot infer -1 axis: {n} devices not divisible by {known}"
+            )
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"Mesh axes {dict(zip(names, sizes))} need "
+            f"{math.prod(sizes)} devices but {n} are available"
+        )
+    # Auto axis types: XLA's SPMD partitioner solves intermediate shardings
+    # (explicit sharding-in-types would demand out_sharding annotations on
+    # ambiguous ops like embedding gathers).
+    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
+    return jax.make_mesh(
+        tuple(sizes), tuple(names), axis_types, devices=devices
+    )
+
+
+def batch_sharding(mesh: Mesh, rank: int, axis: Optional[str] = None) -> NamedSharding:
+    """NamedSharding that splits the leading (row) dim over the batch axis
+    and replicates the rest — the frame layout (≙ Spark row partitioning)."""
+    axis = axis or get_config().batch_axis
+    return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -82,6 +82,18 @@ class Program:
         self.fetch_order: List[str] = (
             list(fetch_order) if fetch_order else [o.name for o in self.outputs]
         )
+        self._compiled = None  # memoized CompiledProgram (ops/executor.py)
+
+    def compiled(self):
+        """Memoized jitted entrypoints. Reusing a Program across verb calls
+        reuses the XLA executable — the analogue of the reference keeping
+        one Session across a pairwise fold (DebugRowOps.scala:939-979), but
+        across whole verb invocations."""
+        if self._compiled is None:
+            from .ops.executor import CompiledProgram
+
+            self._compiled = CompiledProgram(self)
+        return self._compiled
 
     @property
     def input_names(self) -> List[str]:

@@ -7,12 +7,23 @@ device count, not hosts: 8 virtual CPU devices stand in for a TPU slice.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the environment pre-sets JAX_PLATFORMS=axon (the real TPU
+# tunnel) and its sitecustomize imports jax at interpreter start, so both
+# the env var and jax's already-captured config must be overridden here —
+# before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    f"conftest expected >=8 virtual CPU devices, got {jax.devices()}"
+)
 
 import pytest  # noqa: E402
 
