@@ -20,6 +20,13 @@ import time
 import numpy as np
 
 
+def _sync(arr):
+    """Force completion of device work. ``block_until_ready`` is a no-op on
+    remote-tunnel platforms (observed on axon), so read a single element
+    back to the host — O(1) transfer, full dependency barrier."""
+    np.asarray(arr[(0,) * arr.ndim])
+
+
 def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
     import tensorframes_tpu as tfs
     from tensorframes_tpu.models import logreg
@@ -33,8 +40,10 @@ def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
     def run_once():
         out = tfs.map_blocks(program, frame)
         [b] = out.blocks()
-        for v in (b["scores"], b["label"]):
-            v.block_until_ready()
+        # force completion: block_until_ready is a no-op on remote-tunnel
+        # platforms, so read one element back to the host instead
+        _sync(b["scores"])
+        _sync(b["label"])
 
     run_once()  # warmup/compile
     t0 = time.perf_counter()
@@ -56,7 +65,7 @@ def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
     def run_once():
         out = tfs.map_blocks(program, frame)
         [b] = out.blocks()
-        b["z"].block_until_ready()
+        _sync(b["z"])
 
     run_once()
     t0 = time.perf_counter()

@@ -40,6 +40,11 @@ class TransformerConfig:
     mlp_ratio: int = 4
     max_seq_len: int = 512
     dtype: Any = jnp.bfloat16  # activations/compute; params stay f32
+    # attention implementation: 'dense' | 'blockwise' | 'flash' | 'ring'
+    # (ring = sequence parallelism over the mesh 'sp' axis; see
+    # ops/attention.py)
+    attention_impl: str = "dense"
+    causal: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -111,17 +116,19 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict:
     qkv / mlp-in are column-parallel (output dim sharded); out / mlp-out
     are row-parallel (input dim sharded) → XLA inserts one psum per block.
     """
+    tp = "tp" if "tp" in mesh.shape else None  # degrade on tp-less meshes
+
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
     layer = {
         "ln1": {"scale": ns(), "bias": ns()},
         "ln2": {"scale": ns(), "bias": ns()},
-        "attn": {"qkv": ns(None, "tp"), "out": ns("tp", None)},
+        "attn": {"qkv": ns(None, tp), "out": ns(tp, None)},
         "mlp": {
-            "in": ns(None, "tp"),
-            "in_bias": ns("tp"),
-            "out": ns("tp", None),
+            "in": ns(None, tp),
+            "in_bias": ns(tp),
+            "out": ns(tp, None),
             "out_bias": ns(),
         },
     }
@@ -144,19 +151,40 @@ def _layer_norm(x, scale, bias, eps=1e-6):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _attention(cfg: TransformerConfig, p, x, mask):
+def _attention(cfg: TransformerConfig, p, x, mask, mesh=None):
+    from ..ops import attention as att
+
     b, s, h = x.shape
     qkv = (x @ p["qkv"].astype(x.dtype)).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     # [b, heads, s, d]
-    q = q.transpose(0, 2, 1, 3) / np.sqrt(cfg.head_dim)
+    q = q.transpose(0, 2, 1, 3)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-    if mask is not None:
-        scores = jnp.where(mask[:, None, None, :], scores, -1e9)
-    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    impl = cfg.attention_impl
+    if mask is not None and impl != "dense":
+        raise NotImplementedError(
+            f"attention_impl={impl!r} does not support a padding mask yet; "
+            "use attention_impl='dense' for padded batches"
+        )
+    if impl == "ring":
+        if mesh is None or "sp" not in mesh.shape:
+            raise ValueError(
+                "attention_impl='ring' requires a mesh with an 'sp' axis "
+                "passed to forward(...); got "
+                f"{None if mesh is None else dict(mesh.shape)}"
+            )
+        ctx = att.ring_attention(q, k, v, mesh, axis="sp", causal=cfg.causal)
+    elif impl == "blockwise":
+        ctx = att.blockwise_attention(q, k, v, causal=cfg.causal)
+    elif impl == "flash":
+        ctx = att.flash_attention(q, k, v, causal=cfg.causal)
+    elif impl == "dense":
+        ctx = att.dense_attention(
+            q, k, v, causal=cfg.causal, padding_mask=mask
+        )
+    else:
+        raise ValueError(f"Unknown attention_impl {impl!r}")
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
     return ctx @ p["out"].astype(x.dtype)
 
@@ -172,13 +200,20 @@ def forward(
     params: Dict,
     tokens: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
+    mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
-    """Encoder forward: int tokens [b, s] → hidden states [b, s, h]."""
+    """Encoder forward: int tokens [b, s] → hidden states [b, s, h].
+
+    ``mask`` (padding mask) is honoured by the dense impl; the blockwise /
+    flash / ring kernels currently assume unpadded sequences. ``mesh`` is
+    required for ``attention_impl='ring'`` (sequence parallelism over its
+    'sp' axis).
+    """
     x = params["embed"]["tok"][tokens].astype(cfg.dtype)
     s = tokens.shape[1]
     x = x + params["embed"]["pos"][:s].astype(cfg.dtype)
     for p in params["layers"]:
-        x = x + _attention(cfg, p["attn"], _layer_norm(x, **p["ln1"]), mask)
+        x = x + _attention(cfg, p["attn"], _layer_norm(x, **p["ln1"]), mask, mesh)
         x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
     return _layer_norm(x, **params["final_ln"])
 
@@ -200,9 +235,9 @@ def embed_program(cfg: TransformerConfig, params: Dict):
 # Training
 # ---------------------------------------------------------------------------
 
-def loss_fn(cfg: TransformerConfig, params, tokens, targets):
+def loss_fn(cfg: TransformerConfig, params, tokens, targets, mesh=None):
     """Causal-LM-style cross entropy against the token embedding matrix."""
-    hs = forward(cfg, params, tokens)
+    hs = forward(cfg, params, tokens, mesh=mesh)
     logits = hs.astype(jnp.float32) @ params["embed"]["tok"].T
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -237,6 +272,8 @@ def make_sharded_train_step(
     layout. Optimizer state mirrors param shardings. XLA's SPMD partitioner
     inserts the all-gathers/psums over ICI.
     """
+    if seq_axis is not None and seq_axis not in mesh.shape:
+        seq_axis = None  # e.g. a pure-dp mesh: sequence stays unsharded
     data_spec = P("dp", seq_axis) if seq_axis else P("dp", None)
     data_sharding = NamedSharding(mesh, data_spec)
     shardings = param_shardings(cfg, mesh)
@@ -245,7 +282,7 @@ def make_sharded_train_step(
         import optax
 
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, tokens, targets)
+            lambda p: loss_fn(cfg, p, tokens, targets, mesh=mesh)
         )(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -1,0 +1,250 @@
+"""Long-context attention kernels: blockwise, pallas-flash, and ring.
+
+The reference has **no** sequence/long-context support at all (SURVEY.md
+§5: max tensor order 2 per cell; scaling is by rows only). For the TPU
+framework long-context is first-class: these kernels power the
+transformer model family and are public ops in their own right.
+
+Three implementations, one contract (``[batch, heads, seq, head_dim]``):
+
+* :func:`blockwise_attention` — pure-jax online-softmax scan over key/value
+  chunks (memory O(seq·block) instead of O(seq²)); runs on any backend and
+  is the reference implementation for the other two.
+* :func:`flash_attention` — dispatches to the TPU pallas flash kernel
+  (VMEM-tiled MXU kernel) on TPU backends, else falls back to blockwise.
+* :func:`ring_attention` — sequence parallelism over a mesh axis: q/k/v
+  are sharded on the sequence dim; each device scans the full sequence by
+  rotating its k/v shard around the ring with ``lax.ppermute`` (ICI
+  neighbor exchange) while accumulating the online softmax. Communication
+  overlaps compute, memory per device is O(seq/sp), and the math is
+  exactly dense attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _online_block(
+    q: jnp.ndarray,  # [b, h, sq, d] (pre-scaled)
+    k: jnp.ndarray,  # [b, h, sk, d]
+    v: jnp.ndarray,  # [b, h, sk, d]
+    o: jnp.ndarray,  # [b, h, sq, d] f32 accumulator
+    m: jnp.ndarray,  # [b, h, sq] f32 running max
+    l: jnp.ndarray,  # [b, h, sq] f32 running denominator
+    mask: Optional[jnp.ndarray],  # [sq, sk] bool or None
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One online-softmax accumulation step (flash-attention recurrence)."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # rows with nothing attended yet keep m at NEG_INF; exp underflows to 0
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    )
+    return o_new, m_new, l_new
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """Memory-efficient attention: lax.scan over k/v chunks with an online
+    softmax. Exact (not an approximation); peak memory O(sq · block_size)
+    per head instead of O(sq · sk)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_size = min(block_size, sk)
+    num_blocks = -(-sk // block_size)
+    pad = num_blocks * block_size - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    scale = 1.0 / np.sqrt(d)
+    qs = (q * scale).astype(q.dtype)
+
+    kb = k.reshape(b, h, num_blocks, block_size, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, num_blocks, block_size, d).transpose(2, 0, 1, 3, 4)
+
+    q_pos = jnp.arange(sq)
+    k_pos_base = jnp.arange(block_size)
+
+    def step(carry, inp):
+        o, m, l = carry
+        blk_idx, k_blk, v_blk = inp
+        if causal or pad:
+            k_pos = blk_idx * block_size + k_pos_base
+            mask = k_pos[None, :] < sk  # mask padding
+            if causal:
+                mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            mask = None
+        o, m, l = _online_block(qs, k_blk, v_blk, o, m, l, mask)
+        return (o, m, l), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(
+        step, (o0, m0, l0), (jnp.arange(num_blocks), kb, vb)
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """TPU pallas flash kernel when available, else blockwise fallback."""
+    if jax.default_backend() in ("tpu", "axon"):
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as pallas_flash,
+            )
+
+            d = q.shape[-1]
+            return pallas_flash(
+                q, k, v, causal=causal, sm_scale=1.0 / np.sqrt(d)
+            )
+        except Exception:  # pragma: no cover - kernel/backend mismatch
+            pass
+    return blockwise_attention(q, k, v, causal=causal, block_size=block_size)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism)
+# ---------------------------------------------------------------------------
+
+def _ring_attention_local(
+    q: jnp.ndarray,  # [b, h, s_loc, d] — local sequence shard
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool,
+) -> jnp.ndarray:
+    """shard_map body: rotate k/v shards around the ring while accumulating
+    the online softmax for the local queries."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    qs = (q * scale).astype(q.dtype)
+    q_pos = my * s_loc + jnp.arange(s_loc)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        o, m, l, k_cur, v_cur = carry
+        # the shard we currently hold originated on device (my - t) mod n
+        src = (my - t) % n
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        o, m, l = _online_block(qs, k_cur, v_cur, o, m, l, mask)
+        # rotate k/v to the next device; overlaps with next iteration's
+        # compute under XLA's async collective scheduling
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = "tp",
+) -> jnp.ndarray:
+    """Sequence-parallel exact attention over ``mesh[axis]``.
+
+    Inputs are global arrays [b, heads, seq, head_dim] with ``seq``
+    (logically) sharded over ``axis``; ``seq`` must divide evenly by the
+    axis size. Batch / heads may additionally be sharded over
+    ``batch_axis`` / ``head_axis`` (heads stay tp-sharded end-to-end in
+    the Megatron layout instead of being all-gathered at the shard_map
+    boundary).
+    """
+    from jax.experimental.shard_map import shard_map
+
+    seq = q.shape[2]
+    sp = mesh.shape[axis]
+    if seq % sp != 0:
+        raise ValueError(
+            f"ring_attention: seq {seq} not divisible by mesh axis "
+            f"{axis!r} of size {sp}"
+        )
+    db = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    ha = head_axis if (head_axis and head_axis in mesh.shape) else None
+    if ha is not None and q.shape[1] % mesh.shape[ha] != 0:
+        ha = None  # fewer heads than tp shards: keep heads replicated
+    spec = P(db, ha, axis, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
+
+
+def dense_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    padding_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Plain O(s²) attention — the correctness oracle for the kernels.
+
+    ``padding_mask``: bool [batch, seq_k]; False positions are masked out.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q / np.sqrt(d), k, preferred_element_type=jnp.float32
+    )
+    if causal:
+        sq, sk = s.shape[-2:]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    if padding_mask is not None:
+        s = jnp.where(padding_mask[:, None, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(q.dtype)

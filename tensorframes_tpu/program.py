@@ -132,7 +132,15 @@ class Program:
         def fn(feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
             return inner({inv.get(k, k): v for k, v in feeds.items()})
 
-        return Program(fn, new_inputs, self.outputs, self.fetch_order)
+        renamed = Program(fn, new_inputs, self.outputs, self.fetch_order)
+        # carry the segment-lowering info (input names remapped) so the
+        # aggregate fast path survives feed_dict renames
+        seg = getattr(self, "seg_info", None)
+        if seg is not None:
+            renamed.seg_info = [
+                (out, op, mapping.get(inp, inp)) for (out, op, inp) in seg
+            ]
+        return renamed
 
     def explain(self) -> str:
         ins = ", ".join(s.pretty() for s in self.inputs)

@@ -20,7 +20,10 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass  # a backend already initialized; the assert below is the arbiter
 assert len(jax.devices()) >= 8, (
     f"conftest expected >=8 virtual CPU devices, got {jax.devices()}"
 )
