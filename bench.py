@@ -27,6 +27,17 @@ def _sync(arr):
     np.asarray(arr[(0,) * arr.ndim])
 
 
+def _time_rows_per_sec(run_once, n_rows: int, iters: int) -> float:
+    """Shared timing scaffold: one warmup/compile call, then the steady
+    state over ``iters`` calls."""
+    run_once()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt = time.perf_counter() - t0
+    return n_rows * iters / dt
+
+
 def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
     import tensorframes_tpu as tfs
     from tensorframes_tpu.models import logreg
@@ -40,17 +51,10 @@ def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
     def run_once():
         out = tfs.map_blocks(program, frame)
         [b] = out.blocks()
-        # force completion: block_until_ready is a no-op on remote-tunnel
-        # platforms, so read one element back to the host instead
         _sync(b["scores"])
         _sync(b["label"])
 
-    run_once()  # warmup/compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        run_once()
-    dt = time.perf_counter() - t0
-    return n_rows * iters / dt
+    return _time_rows_per_sec(run_once, n_rows, iters)
 
 
 def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
@@ -67,12 +71,7 @@ def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
         [b] = out.blocks()
         _sync(b["z"])
 
-    run_once()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        run_once()
-    dt = time.perf_counter() - t0
-    return n_rows * iters / dt
+    return _time_rows_per_sec(run_once, n_rows, iters)
 
 
 def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0):
@@ -93,12 +92,27 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
         [b] = out.blocks()
         _sync(b["label"])
 
-    run_once()  # warmup/compile
+    return _time_rows_per_sec(run_once, n_rows, iters)
+
+
+def _bench_convert(n_rows: int = 1_000_000):
+    """Row→columnar convert + back (re-enabled equivalents of the
+    reference's disabled µbenches, ConvertPerformanceSuite/
+    ConvertBackPerformanceSuite): seconds per call over n scalar int rows,
+    through the native C++ marshalling kernels when available."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import native
+
+    native.available()  # one-time g++ build stays out of the timer
+    rows = [{"x": i} for i in range(n_rows)]
     t0 = time.perf_counter()
-    for _ in range(iters):
-        run_once()
-    dt = time.perf_counter() - t0
-    return n_rows * iters / dt
+    frame = tfs.frame_from_rows(rows, num_blocks=1)
+    convert_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = frame.collect()
+    convertback_s = time.perf_counter() - t0
+    assert out[-1]["x"] == n_rows - 1
+    return convert_s, convertback_s
 
 
 def _bench_reduce_blocks(n_rows: int = 1_000_000):
@@ -138,7 +152,14 @@ def main():
         channel_scale=1.0 if on_tpu else 0.125,
     )
 
+    from tensorframes_tpu import native
+
+    convert_s, convertback_s = _bench_convert()
+
     print(f"# chips={n_chips} devices={jax.devices()}")
+    print(f"# native_marshalling={'on' if native.available() else 'off'}")
+    print(f"# convert_1M_int_rows_s={convert_s:.4f}")
+    print(f"# convertback_1M_int_cells_s={convertback_s:.4f}")
     print(f"# add3_map_blocks_rows_per_sec={add3_rps:.0f}")
     print(f"# reduce_blocks_1M_wall_s={reduce_s:.4f}")
     print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")

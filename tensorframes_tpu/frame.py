@@ -140,6 +140,8 @@ class TensorFrame:
 
         Vector cells come back as numpy arrays; scalars as Python scalars.
         """
+        from . import native
+
         rows: List[Dict[str, object]] = []
         for b in self.blocks():
             n = _block_num_rows(b)
@@ -149,6 +151,21 @@ class TensorFrame:
                 if not isinstance(v, list):
                     v = np.asarray(v)  # device arrays come back in one copy
                 cols[name] = v
+            if all(
+                isinstance(v, np.ndarray)
+                and v.ndim == 1
+                and native.supported_dtype(v.dtype)
+                for v in cols.values()
+            ):
+                # native fast path: all-scalar blocks materialize as row
+                # dicts in one C++ pass (≙ convertBackFast0,
+                # DataOps.scala:20-61)
+                native_rows = native.columns_to_rows(
+                    list(cols.keys()), list(cols.values())
+                )
+                if native_rows is not None:
+                    rows.extend(native_rows)
+                    continue
             for i in range(n):
                 row = {}
                 for name, v in cols.items():
@@ -410,20 +427,53 @@ def frame_from_rows(
     with ``Row`` objects, README.md:67-68)."""
     if not rows:
         raise ValueError("Cannot build a frame from zero rows without a schema")
+    from . import native
+
     names = list(rows[0].keys())
     num_blocks = num_blocks or min(get_config().default_num_blocks, len(rows))
-    cols = {n: [r[n] for r in rows] for n in names}
-    infos = [_infer_column_info(n, cols[n]) for n in names]
+    cols: Dict[str, object] = {}
+    infos: List[ColumnInfo] = []
+    use_native = native.available()
+    for n in names:
+        arr = None
+        if use_native:
+            # native fast path: scalar numeric columns gather in one C++
+            # pass (≙ convertFast0, DataOps.scala:63-81); anything it can't
+            # take — vectors, strings, mixed cells — falls back per column
+            try:
+                dtype = dt.from_python_value(rows[0][n])
+            except dt.UnsupportedTypeError:
+                dtype = None
+            if (
+                dtype is not None
+                and dtype.device
+                and dtype.np_dtype is not None
+                and native.supported_dtype(dtype.np_dtype)
+                and not isinstance(rows[0][n], (list, tuple, np.ndarray))
+            ):
+                try:
+                    arr = native.gather_column(rows, n, dtype.np_dtype)
+                except (TypeError, KeyError, OverflowError, ValueError):
+                    arr = None
+        if arr is not None:
+            cols[n] = arr
+            infos.append(ColumnInfo(n, dt.from_numpy(arr.dtype), Shape((Unknown,))))
+        else:
+            cells = [r[n] for r in rows]
+            cols[n] = cells
+            infos.append(_infer_column_info(n, cells))
     schema = Schema(infos)
     bounds = _partition_bounds(len(rows), num_blocks)
     blocks: List[Block] = []
     for lo, hi in bounds:
-        blocks.append(
-            {
-                info.name: _cells_to_storage(cols[info.name][lo:hi], info)
-                for info in infos
-            }
-        )
+        block: Block = {}
+        for info in infos:
+            c = cols[info.name]
+            if isinstance(c, np.ndarray):
+                block[info.name] = c[lo:hi]
+            else:
+                block[info.name] = _cells_to_storage(c[lo:hi], info)
+        blocks.append(block)
     return TensorFrame(blocks, schema)
 
 

@@ -1,0 +1,230 @@
+// Native row <-> columnar marshalling kernels.
+//
+// The TPU-native framework's equivalent of the reference's hand-unrolled
+// hot loops (DataOps.scala:63-81 convertFast0 — rows -> tensor buffers;
+// DataOps.scala:20-61 convertBackFast0 — tensors -> rows). There the loops
+// ran in Scala against java.nio buffers feeding JNI tf.Tensor.create; here
+// they run in C++ against CPython objects feeding numpy (and from numpy,
+// jax.device_put to HBM) — the host-side half of the host<->device
+// marshalling layer SURVEY.md §7 ranks as hard part #6.
+//
+// Scope mirrors the reference's fast path: scalar numeric columns
+// (Double/Float/Int/Long, datatypes.scala:265-267). Vector cells and
+// host-only (string/binary) columns take the Python slow path, as the
+// reference's reshapeIter slow path did (DataOps.scala:85-101).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+enum DtypeCode { F64 = 0, F32 = 1, I32 = 2, I64 = 3 };
+
+size_t itemsize_for(int code) { return (code == F32 || code == I32) ? 4 : 8; }
+
+// Convert one cell to int64 honouring __index__ (covers numpy integers).
+bool cell_to_i64(PyObject* v, int64_t* out) {
+  if (PyLong_Check(v)) {
+    long long x = PyLong_AsLongLong(v);
+    if (x == -1 && PyErr_Occurred()) return false;
+    *out = static_cast<int64_t>(x);
+    return true;
+  }
+  PyObject* idx = PyNumber_Index(v);
+  if (idx == nullptr) return false;
+  long long x = PyLong_AsLongLong(idx);
+  Py_DECREF(idx);
+  if (x == -1 && PyErr_Occurred()) return false;
+  *out = static_cast<int64_t>(x);
+  return true;
+}
+
+// gather_column(rows, name, code) -> bytearray of len(rows) packed cells.
+//
+// One pass over a sequence of row dicts: borrow rows[i][name], convert,
+// write into a contiguous buffer the wrapper views as a numpy array
+// without copying.
+PyObject* gather_column(PyObject*, PyObject* args) {
+  PyObject* rows;
+  const char* name;
+  int code;
+  if (!PyArg_ParseTuple(args, "Osi", &rows, &name, &code)) return nullptr;
+  if (code < F64 || code > I64) {
+    PyErr_Format(PyExc_ValueError, "bad dtype code %d", code);
+    return nullptr;
+  }
+  PyObject* fast = PySequence_Fast(rows, "rows must be a sequence");
+  if (fast == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  const size_t isz = itemsize_for(code);
+  PyObject* out = PyByteArray_FromStringAndSize(nullptr, n * isz);
+  PyObject* key = PyUnicode_FromString(name);
+  if (out == nullptr || key == nullptr) goto fail;
+  {
+    char* buf = PyByteArray_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* row = PySequence_Fast_GET_ITEM(fast, i);  // borrowed
+      if (!PyDict_Check(row)) {
+        PyErr_Format(PyExc_TypeError, "row %zd is not a dict", (ssize_t)i);
+        goto fail;
+      }
+      PyObject* v = PyDict_GetItemWithError(row, key);  // borrowed
+      if (v == nullptr) {
+        if (!PyErr_Occurred())
+          PyErr_Format(PyExc_KeyError, "row %zd has no column '%s'",
+                       (ssize_t)i, name);
+        goto fail;
+      }
+      switch (code) {
+        case F64: {
+          double d = PyFloat_AsDouble(v);
+          if (d == -1.0 && PyErr_Occurred()) goto fail;
+          reinterpret_cast<double*>(buf)[i] = d;
+          break;
+        }
+        case F32: {
+          double d = PyFloat_AsDouble(v);
+          if (d == -1.0 && PyErr_Occurred()) goto fail;
+          reinterpret_cast<float*>(buf)[i] = static_cast<float>(d);
+          break;
+        }
+        case I32: {
+          int64_t x;
+          if (!cell_to_i64(v, &x)) goto fail;
+          if (x < INT32_MIN || x > INT32_MAX) {
+            PyErr_Format(PyExc_OverflowError,
+                         "row %zd column '%s': %lld out of int32 range",
+                         (ssize_t)i, name, (long long)x);
+            goto fail;
+          }
+          reinterpret_cast<int32_t*>(buf)[i] = static_cast<int32_t>(x);
+          break;
+        }
+        case I64: {
+          int64_t x;
+          if (!cell_to_i64(v, &x)) goto fail;
+          reinterpret_cast<int64_t*>(buf)[i] = x;
+          break;
+        }
+      }
+    }
+  }
+  Py_DECREF(key);
+  Py_DECREF(fast);
+  return out;
+fail:
+  Py_XDECREF(key);
+  Py_XDECREF(out);
+  Py_DECREF(fast);
+  return nullptr;
+}
+
+// scatter_rows(names, buffers, codes) -> list of row dicts.
+//
+// names: tuple of str; buffers: tuple of C-contiguous 1-D buffers (one per
+// column, equal lengths); codes: tuple of dtype codes. Builds the whole
+// list-of-dicts result in one C pass (the collect() hot loop).
+PyObject* scatter_rows(PyObject*, PyObject* args) {
+  PyObject *names, *buffers, *codes;
+  if (!PyArg_ParseTuple(args, "OOO", &names, &buffers, &codes)) return nullptr;
+  if (!PyTuple_Check(names) || !PyTuple_Check(buffers) || !PyTuple_Check(codes)) {
+    PyErr_SetString(PyExc_TypeError, "names/buffers/codes must be tuples");
+    return nullptr;
+  }
+  const Py_ssize_t k = PyTuple_GET_SIZE(names);
+  if (PyTuple_GET_SIZE(buffers) != k || PyTuple_GET_SIZE(codes) != k) {
+    PyErr_SetString(PyExc_ValueError, "names/buffers/codes length mismatch");
+    return nullptr;
+  }
+  if (k == 0) return PyList_New(0);  // zero-column frame: no rows to infer
+  Py_buffer* views = new Py_buffer[k];
+  int* col_codes = new int[k];
+  Py_ssize_t acquired = 0;
+  PyObject* result = nullptr;
+  Py_ssize_t n = -1;
+
+  for (; acquired < k; ++acquired) {
+    PyObject* b = PyTuple_GET_ITEM(buffers, acquired);
+    if (PyObject_GetBuffer(b, &views[acquired], PyBUF_C_CONTIGUOUS) != 0)
+      goto done;
+    long code = PyLong_AsLong(PyTuple_GET_ITEM(codes, acquired));
+    if ((code == -1 && PyErr_Occurred()) || code < F64 || code > I64) {
+      if (!PyErr_Occurred())
+        PyErr_Format(PyExc_ValueError, "bad dtype code %ld", code);
+      ++acquired;  // this view was acquired; release it in cleanup
+      goto done;
+    }
+    col_codes[acquired] = static_cast<int>(code);
+    const Py_ssize_t rows_here =
+        views[acquired].len / (Py_ssize_t)itemsize_for(col_codes[acquired]);
+    if (n == -1) {
+      n = rows_here;
+    } else if (rows_here != n) {
+      PyErr_Format(PyExc_ValueError,
+                   "column %zd has %zd rows, expected %zd",
+                   (ssize_t)acquired, (ssize_t)rows_here, (ssize_t)n);
+      ++acquired;
+      goto done;
+    }
+  }
+
+  result = PyList_New(n);
+  if (result == nullptr) goto done;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PyDict_New();
+    if (row == nullptr) goto fail_rows;
+    PyList_SET_ITEM(result, i, row);  // steals
+    for (Py_ssize_t j = 0; j < k; ++j) {
+      const char* buf = static_cast<const char*>(views[j].buf);
+      PyObject* cell = nullptr;
+      switch (col_codes[j]) {
+        case F64:
+          cell = PyFloat_FromDouble(reinterpret_cast<const double*>(buf)[i]);
+          break;
+        case F32:
+          cell = PyFloat_FromDouble(
+              (double)reinterpret_cast<const float*>(buf)[i]);
+          break;
+        case I32:
+          cell = PyLong_FromLong(reinterpret_cast<const int32_t*>(buf)[i]);
+          break;
+        case I64:
+          cell = PyLong_FromLongLong(reinterpret_cast<const int64_t*>(buf)[i]);
+          break;
+      }
+      if (cell == nullptr) goto fail_rows;
+      if (PyDict_SetItem(row, PyTuple_GET_ITEM(names, j), cell) != 0) {
+        Py_DECREF(cell);
+        goto fail_rows;
+      }
+      Py_DECREF(cell);
+    }
+  }
+  goto done;
+
+fail_rows:
+  Py_CLEAR(result);
+done:
+  for (Py_ssize_t j = 0; j < acquired; ++j) PyBuffer_Release(&views[j]);
+  delete[] views;
+  delete[] col_codes;
+  return result;  // nullptr on error (exception set)
+}
+
+PyMethodDef methods[] = {
+    {"gather_column", gather_column, METH_VARARGS,
+     "gather_column(rows, name, dtype_code) -> bytearray of packed cells"},
+    {"scatter_rows", scatter_rows, METH_VARARGS,
+     "scatter_rows(names, buffers, dtype_codes) -> list of row dicts"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_rowpack",
+                         "Native row<->columnar marshalling kernels.", -1,
+                         methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__rowpack(void) { return PyModule_Create(&moduledef); }
