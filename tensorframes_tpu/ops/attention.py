@@ -199,7 +199,7 @@ def ring_attention(
     the Megatron layout instead of being all-gathered at the shard_map
     boundary).
     """
-    from jax.experimental.shard_map import shard_map
+    from ..parallel._shard_map import shard_map
 
     seq = q.shape[2]
     sp = mesh.shape[axis]
@@ -220,7 +220,7 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check=False,
     )
     return fn(q, k, v)
 

@@ -1,7 +1,10 @@
 from .distributed import init_distributed, is_multiprocess, process_index
 from .mesh import BATCH_AXIS, batch_sharding, device_count, make_mesh, replicated
+from .pipeline import make_pp_train_step, pipeline_apply
 
 __all__ = [
+    "make_pp_train_step",
+    "pipeline_apply",
     "BATCH_AXIS",
     "batch_sharding",
     "device_count",

@@ -1,0 +1,21 @@
+"""Version-tolerant ``shard_map`` entry point (single copy for the whole
+package): jax >= 0.8 exposes ``jax.shard_map`` with ``check_vma``; older
+releases have ``jax.experimental.shard_map.shard_map`` with ``check_rep``.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check
+        )
+    except ImportError:  # pragma: no cover — old jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check
+        )

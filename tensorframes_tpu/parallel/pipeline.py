@@ -1,0 +1,169 @@
+"""Pipeline parallelism: a GPipe-style microbatch schedule over a mesh
+``pp`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.7: absent); this
+extends the parallelism inventory the TPU way. No per-stage processes and
+no send/recv runtime: all stages run the SAME jitted SPMD program under
+``shard_map``, stage-to-stage activation transfer is a ``lax.ppermute``
+ring shift over ICI, and the schedule is a ``lax.scan`` over
+``num_microbatches + num_stages - 1`` ticks with static shapes —
+compiler-friendly control flow throughout (no data-dependent Python).
+
+The scan carries each device's in-flight activation; at tick ``t`` stage
+``s`` computes microbatch ``t - s`` (bubble ticks compute garbage that is
+masked out), then every device shifts its output one hop down the ring.
+Stage 0 feeds from the microbatch queue; the last stage writes into the
+output buffer, which a masked ``psum`` broadcasts to all shards at the
+end. Differentiable end-to-end (``ppermute``/``scan`` have transposes),
+so a full training step jits over pp × dp meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ._shard_map import shard_map as _shard_map
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "pp",
+    num_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = "dp",
+):
+    """Run ``num_stages`` chained applications of ``stage_fn`` as a
+    pipeline over the mesh's ``axis``.
+
+    ``stage_fn(params_slice, h) -> h`` is one stage's computation; shapes
+    of ``h`` must be stage-invariant (equal widths), the usual pipeline
+    constraint. ``stage_params`` is a pytree whose leaves have a leading
+    ``num_stages`` dim (stage ``s`` uses leaf[s]); it is sharded over
+    ``axis`` so each device holds only its own stage's weights.
+    ``x`` is [batch, ...]; it is split into ``num_microbatches`` equal
+    microbatches (default: the pp degree). A ``batch_axis`` present on the
+    mesh splits each microbatch data-parallel across it.
+
+    Returns ``stage_{S-1}(... stage_0(x))`` replicated over ``axis``.
+    """
+    n_stages = mesh.shape[axis]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stage_params)[0]:
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
+                f"dim {leaf.shape[0]}, expected num_stages={n_stages} "
+                f"(mesh axis {axis!r})"
+            )
+    m = num_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % m != 0:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    mb = batch // m
+    db = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    if db and mb % mesh.shape[db] != 0:
+        db = None  # microbatch not divisible by dp: fall back to replication
+    xs = x.reshape(m, mb, *x.shape[1:])
+
+    def shard_fn(params_local, xs_full):
+        # params_local: this stage's slice, leading dim 1 → squeeze
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage_idx = lax.axis_index(axis)
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            h, out_buf = carry
+            # stage 0 pulls microbatch t from the queue (clamped index;
+            # bubble ticks recompute a stale microbatch and are masked out)
+            mb_idx = jnp.clip(t, 0, m - 1)
+            feed = lax.dynamic_index_in_dim(xs_full, mb_idx, keepdims=False)
+            cur = jnp.where(stage_idx == 0, feed, h)
+            y = stage_fn(params_local, cur)
+            # last stage banks microbatch t - (S-1) when it's real
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            is_real = (t >= n_stages - 1) & (stage_idx == n_stages - 1)
+            banked = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(is_real, y, out_buf[out_idx]), out_idx, 0
+            )
+            # ring-shift activations one hop toward the next stage
+            h_next = lax.ppermute(y, axis, perm=fwd)
+            return (h_next, banked), None
+
+        h0 = jnp.zeros_like(xs_full[0])
+        out0 = jnp.zeros_like(xs_full)
+        (_, out_buf), _ = lax.scan(
+            tick, (h0, out0), jnp.arange(m + n_stages - 1)
+        )
+        # outputs live on the last stage only; masked psum broadcasts them
+        mask = (stage_idx == n_stages - 1).astype(out_buf.dtype)
+        return lax.psum(out_buf * mask, axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    data_spec = P(None, db)  # microbatch dim whole, batch dim dp-split
+    out = _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec_params, data_spec),
+        out_specs=data_spec,
+        check=False,
+    )(stage_params, xs)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def make_pp_train_step(
+    stage_fn: Callable,
+    loss_head: Callable,
+    mesh: Mesh,
+    tx,
+    axis: str = "pp",
+    num_microbatches: Optional[int] = None,
+    batch_axis: Optional[str] = "dp",
+):
+    """Jitted full training step for a pipelined model.
+
+    ``loss_head(h, targets) -> scalar`` consumes the final stage output.
+    Stage params are sharded over ``axis`` (leading stage dim); the batch
+    is sharded over ``batch_axis`` so dp replicas each train on their own
+    slice (jit inserts the gradient all-reduce). Gradients flow backward
+    through the ppermute ring (XLA reverses the schedule).
+    """
+    db = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    data_sharding = NamedSharding(mesh, P(db) if db else P())
+
+    def step(stage_params, opt_state, x, targets):
+        import optax
+
+        def loss_fn(p):
+            out = pipeline_apply(
+                stage_fn, p, x, mesh, axis=axis,
+                num_microbatches=num_microbatches, batch_axis=db,
+            )
+            return loss_head(out, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(stage_params)
+        updates, opt_state = tx.update(grads, opt_state, stage_params)
+        stage_params = optax.apply_updates(stage_params, updates)
+        return stage_params, opt_state, loss
+
+    def param_shardings(stage_params):
+        return jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P(axis)), stage_params
+        )
+
+    def jit_for(stage_params):
+        sh = param_shardings(stage_params)
+        init_opt = jax.jit(tx.init, in_shardings=(sh,))
+        jitted = jax.jit(
+            step,
+            in_shardings=(sh, None, data_sharding, data_sharding),
+            out_shardings=(sh, None, NamedSharding(mesh, P())),
+        )
+        return jitted, init_opt, sh
+
+    return jit_for
