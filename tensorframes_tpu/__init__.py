@@ -80,6 +80,8 @@ from .ops.verbs import (  # noqa: E402,F401
     reduce_blocks,
     reduce_rows,
 )
+from .checkpoint import Checkpointer  # noqa: E402,F401
+from .utils import profiling  # noqa: E402,F401
 
 __version__ = "0.1.0"
 
@@ -105,6 +107,9 @@ __all__ = [
     "append_shape",
     "print_schema",
     "explain",
+    # aux subsystems
+    "Checkpointer",
+    "profiling",
     # dsl / placeholder helpers
     "Node",
     "block",

@@ -1,0 +1,177 @@
+"""Checkpoint / resume.
+
+The reference has none (SURVEY.md §5: "Checkpoint / resume: none" — it is
+stateless by construction, freezing variables to constants client-side,
+core.py:42-56). This framework adds training (optimizer state, sharded
+params), so checkpointing becomes first-class, the TPU-native way:
+
+* **orbax backend** (default when importable): async-capable, handles
+  sharded ``jax.Array`` pytrees natively — the standard JAX ecosystem
+  checkpoint format.
+* **npz backend** (fallback, zero extra deps): pytree flattened by
+  keypath into one compressed ``.npz`` plus a JSON manifest; atomic via
+  write-to-temp + ``os.replace``. Sharded arrays are gathered to host on
+  save and restored replicated (callers re-``device_put`` with their
+  shardings).
+
+Both sit behind one ``Checkpointer`` API: numbered steps under a root
+directory, ``latest_step``, ``save``, ``restore(like=...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from .utils import get_logger
+
+logger = get_logger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step}")
+
+
+class Checkpointer:
+    """Numbered-step checkpoint store for parameter/optimizer pytrees.
+
+    >>> ckpt = Checkpointer("/tmp/run1")
+    >>> ckpt.save(100, {"params": params, "opt": opt_state})
+    >>> state = ckpt.restore(like={"params": params0, "opt": opt0})
+    """
+
+    def __init__(self, root: str, backend: Optional[str] = None, keep: int = 0):
+        """``backend``: 'orbax' | 'npz' | None (auto: orbax if importable).
+        ``keep``: retain only the newest N step dirs (0 = keep all)."""
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.keep = keep
+        if backend is None:
+            try:
+                import orbax.checkpoint  # noqa: F401
+
+                backend = "orbax"
+            except ImportError:  # pragma: no cover
+                backend = "npz"
+        if backend not in ("orbax", "npz"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        self.backend = backend
+
+    # -- step bookkeeping ---------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _gc(self) -> None:
+        if self.keep <= 0:
+            return
+        steps = self.all_steps()
+        for s in steps[: -self.keep]:
+            shutil.rmtree(_step_dir(self.root, s), ignore_errors=True)
+
+    # -- save / restore -----------------------------------------------------
+
+    def save(self, step: int, state: Any) -> str:
+        """Write ``state`` (a pytree of arrays) as step ``step``. Atomic:
+        the step dir only appears once fully written."""
+        final = _step_dir(self.root, step)
+        tmp = final + f".tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            if self.backend == "orbax":
+                self._save_orbax(tmp, state)
+            else:
+                self._save_npz(tmp, state)
+            # the previous step dir is removed only after the new one is
+            # fully written, keeping the crash window to the rename itself
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        self._gc()
+        return final
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        """Read step ``step`` (default: latest). ``like`` is a template
+        pytree (same treedef; array leaves) — required for npz round-trips
+        of non-dict pytrees and for orbax sharding restoration."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self.root}")
+        path = _step_dir(self.root, step)
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint at {path}")
+        if self.backend == "orbax":
+            return self._restore_orbax(path, like)
+        return self._restore_npz(path, like)
+
+    # -- orbax backend ------------------------------------------------------
+
+    def _save_orbax(self, path: str, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "state"), state)
+
+    def _restore_orbax(self, path: str, like: Any) -> Any:
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            if like is not None:
+                target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, like)
+                return ckptr.restore(os.path.join(path, "state"), target)
+            return ckptr.restore(os.path.join(path, "state"))
+
+    # -- npz backend --------------------------------------------------------
+
+    def _save_npz(self, path: str, state: Any) -> None:
+        os.makedirs(path, exist_ok=True)
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        arrays = {}
+        manifest = []
+        for i, (keypath, leaf) in enumerate(flat):
+            arrays[f"a{i}"] = np.asarray(leaf)
+            manifest.append(jax.tree_util.keystr(keypath))
+        np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+
+    def _restore_npz(self, path: str, like: Any) -> Any:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        data = np.load(os.path.join(path, "arrays.npz"))
+        leaves = [data[f"a{i}"] for i in range(len(manifest))]
+        if like is None:
+            # reconstruct as a flat {keystr: array} dict
+            return dict(zip(manifest, leaves))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+        if len(flat) != len(leaves):
+            raise ValueError(
+                f"checkpoint has {len(leaves)} leaves, template has {len(flat)}"
+            )
+        for (keypath, _), name in zip(flat, manifest):
+            if jax.tree_util.keystr(keypath) != name:
+                raise ValueError(
+                    f"checkpoint leaf {name!r} does not match template "
+                    f"leaf {jax.tree_util.keystr(keypath)!r}"
+                )
+        return jax.tree_util.tree_unflatten(treedef, leaves)

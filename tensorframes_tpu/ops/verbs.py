@@ -29,6 +29,7 @@ StableHLO artifacts (see program.py).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -43,6 +44,7 @@ from ..program import Program, TensorSpec, analyze_program, program_from_functio
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, Unknown
 from ..utils import get_logger
+from ..utils import profiling
 from ..validation import (
     ValidationError,
     validate_map,
@@ -205,8 +207,11 @@ def map_blocks(
 
     def compute() -> List[Block]:
         out_blocks: List[Block] = []
+        t0 = time.perf_counter()
+        n_total = 0
         for b in parent.blocks():
             n = _block_num_rows(b)
+            n_total += n
             feeds = gather_feeds(b, input_names, program)
             # sharded frames keep outputs in HBM; XLA propagates the input
             # sharding through the program (SPMD), so chained maps run
@@ -227,6 +232,7 @@ def map_blocks(
             nb: Block = {i.name: outs[i.name] for i in out_infos}
             nb.update(b)
             out_blocks.append(nb)
+        profiling.record("map_blocks", time.perf_counter() - t0, n_total)
         return out_blocks
 
     result = TensorFrame(None, schema, pending=compute)
@@ -265,8 +271,11 @@ def map_rows(
 
     def compute() -> List[Block]:
         out_blocks: List[Block] = []
+        t0 = time.perf_counter()
+        n_total = 0
         for b in parent.blocks():
             n = _block_num_rows(b)
+            n_total += n
             if n == 0:
                 nb = {}
                 for i in out_infos:
@@ -302,6 +311,7 @@ def map_rows(
             nb: Block = {i.name: outs[i.name] for i in out_infos}
             nb.update(b)
             out_blocks.append(nb)
+        profiling.record("map_rows", time.perf_counter() - t0, n_total)
         return out_blocks
 
     result = TensorFrame(None, schema, pending=compute)
@@ -357,6 +367,7 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     validate_reduce_rows(program, frame.schema)
     out_names = [o.name for o in program.outputs]
     fold = make_pair_fold(program, out_names)
+    t0 = time.perf_counter()
 
     partials: List[Dict[str, np.ndarray]] = []
     for b in frame.blocks():
@@ -396,6 +407,7 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
         }
         res = fold(stacked)
         finals = {x: np.asarray(res[x]) for x in out_names}
+    profiling.record("reduce_rows", time.perf_counter() - t0, frame.num_rows)
     return _unpack_results(program, finals)
 
 
@@ -418,6 +430,7 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     validate_reduce_blocks(program, frame.schema)
     out_names = [o.name for o in program.outputs]
     compiled = program.compiled()
+    t0 = time.perf_counter()
 
     partials: List[Dict[str, np.ndarray]] = []
     for b in frame.blocks():
@@ -446,6 +459,7 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
             f"{x}_input": np.stack([p[x] for p in partials]) for x in out_names
         }
         finals = compiled.run_block(feeds)
+    profiling.record("reduce_blocks", time.perf_counter() - t0, frame.num_rows)
     return _unpack_results(program, finals)
 
 
@@ -478,6 +492,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
     """
     frame = grouped.frame
     keys = grouped.keys
+    t0 = time.perf_counter()
     program, seg_info = _normalize_program(
         fetches, frame.schema, block=True, reduce_mode="blocks"
     )
@@ -513,6 +528,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
                 empty[i.name] = np.empty((0,) + dims, dtype=i.dtype.np_dtype)
             else:
                 empty[i.name] = []
+        profiling.record("aggregate", time.perf_counter() - t0, 0)
         return TensorFrame([empty], Schema(infos))
     order = np.lexsort(tuple(np.asarray(key_cols[k]) for k in reversed(keys)))
     sorted_keys = {k: np.asarray(key_cols[k])[order] for k in keys}
@@ -604,4 +620,5 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
     block.update(out_key_cols)
     for o in program.outputs:
         block[o.name] = out_cols[o.name]
+    profiling.record("aggregate", time.perf_counter() - t0, n)
     return TensorFrame([block], Schema(infos))

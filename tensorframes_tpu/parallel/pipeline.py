@@ -28,6 +28,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ._shard_map import shard_map as _shard_map
+from ..utils import get_logger
+
+logger = get_logger(__name__)
 
 
 def pipeline_apply(
@@ -68,7 +71,13 @@ def pipeline_apply(
     mb = batch // m
     db = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     if db and mb % mesh.shape[db] != 0:
-        db = None  # microbatch not divisible by dp: fall back to replication
+        logger.warning(
+            "pipeline_apply: microbatch size %d not divisible by mesh axis "
+            "%r=%d — falling back to replicated batches (every %s replica "
+            "computes the full batch)",
+            mb, db, mesh.shape[db], db,
+        )
+        db = None
     xs = x.reshape(m, mb, *x.shape[1:])
 
     def shard_fn(params_local, xs_full):

@@ -1,0 +1,145 @@
+"""Tracing / profiling.
+
+The reference has nothing beyond log4j levels (SURVEY.md §5: manual timing
+only in ``ignore``-d perf suites); the survey's build note makes the
+TPU-native equivalent first-class: per-verb wall-clock metrics plus
+``jax.profiler`` device traces.
+
+* ``span(name, rows=...)`` — context manager accumulating wall-clock,
+  call count and row throughput per named operation. The five verbs wrap
+  their execution in spans automatically; user code can add its own.
+* ``metrics()`` / ``report()`` / ``reset_metrics()`` — inspect the
+  accumulated stats (``report()`` is the profiling sibling of
+  ``explain``).
+* ``trace(logdir)`` — context manager around ``jax.profiler.trace``:
+  captures a TensorBoard-viewable device trace (XLA ops, HBM transfers)
+  when the runtime supports it; a no-op (with a log line) otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class SpanStats:
+    calls: int = 0
+    seconds: float = 0.0
+    rows: int = 0
+
+    @property
+    def rows_per_sec(self) -> float:
+        return self.rows / self.seconds if self.seconds > 0 else 0.0
+
+
+_lock = threading.Lock()
+_stats: Dict[str, SpanStats] = {}
+
+
+@contextlib.contextmanager
+def span(name: str, rows: int = 0) -> Iterator[None]:
+    """Accumulate wall-clock (and optional row count) under ``name``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            s = _stats.setdefault(name, SpanStats())
+            s.calls += 1
+            s.seconds += dt
+            s.rows += rows
+
+
+def record(name: str, seconds: float, rows: int = 0) -> None:
+    """Directly accumulate one measurement (for code that times itself)."""
+    with _lock:
+        s = _stats.setdefault(name, SpanStats())
+        s.calls += 1
+        s.seconds += seconds
+        s.rows += rows
+
+
+def metrics() -> Dict[str, SpanStats]:
+    """Snapshot of accumulated span stats."""
+    with _lock:
+        return {k: dataclasses.replace(v) for k, v in _stats.items()}
+
+
+def reset_metrics() -> None:
+    with _lock:
+        _stats.clear()
+
+
+def report() -> str:
+    """Human-readable per-span table (the profiling ``explain``)."""
+    snap = metrics()
+    if not snap:
+        return "no spans recorded"
+    name_w = max(len(k) for k in snap) + 2
+    lines = [
+        f"{'span':<{name_w}}{'calls':>7}{'seconds':>12}{'rows':>12}{'rows/s':>14}"
+    ]
+    for name in sorted(snap):
+        s = snap[name]
+        rps = f"{s.rows_per_sec:,.0f}" if s.rows else "-"
+        rows = f"{s.rows:,}" if s.rows else "-"
+        lines.append(
+            f"{name:<{name_w}}{s.calls:>7}{s.seconds:>12.4f}{rows:>12}{rps:>14}"
+        )
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``logdir`` (TensorBoard
+    format). Degrades to a no-op where the backend can't trace."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # pragma: no cover — backend-dependent
+        logger.warning("jax.profiler trace unavailable: %s", e)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover
+                logger.warning("jax.profiler stop_trace failed: %s", e)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a region in the device trace (shows up in TensorBoard); also
+    accumulates a wall-clock span. Exceptions from the annotated body
+    propagate untouched — only TraceAnnotation setup failures are
+    swallowed."""
+    import jax
+
+    ann = None
+    try:
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    except Exception:  # pragma: no cover — backend-dependent
+        ann = None
+    with span(name):
+        try:
+            yield
+        finally:
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:  # pragma: no cover
+                    pass
