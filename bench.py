@@ -95,6 +95,30 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
     return _time_rows_per_sec(run_once, n_rows, iters)
 
 
+def _bench_bert_embed(n_rows: int = 1024, seq: int = 128, iters: int = 3,
+                      full_scale: bool = True):
+    """BERT-base embedding extraction via map_rows (BASELINE config 5)."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import transformer as tr
+
+    cfg = tr.bert_base() if full_scale else tr.tiny()
+    seq = min(seq, cfg.max_seq_len)
+    params = tr.init_params(cfg, seed=0)
+    tokens, _ = tr.synthetic_batch(cfg, n_rows, seq, seed=0)
+    frame = tfs.frame_from_arrays({"tokens": tokens}, num_blocks=1).to_device()
+    prog = tr.embed_row_program(cfg, params)
+    program = tfs.compile_program(
+        lambda tokens: prog(tokens), frame, block=False
+    )
+
+    def run_once():
+        out = tfs.map_rows(program, frame)
+        [b] = out.blocks()
+        _sync(b["embedding"])
+
+    return _time_rows_per_sec(run_once, n_rows, iters)
+
+
 def _bench_convert(n_rows: int = 1_000_000):
     """Row→columnar convert + back (re-enabled equivalents of the
     reference's disabled µbenches, ConvertPerformanceSuite/
@@ -151,6 +175,11 @@ def main():
         iters=4 if on_tpu else 1,
         channel_scale=1.0 if on_tpu else 0.125,
     )
+    bert_rps = _bench_bert_embed(
+        n_rows=1024 if on_tpu else 32,
+        iters=3 if on_tpu else 1,
+        full_scale=on_tpu,
+    )
 
     from tensorframes_tpu import native
 
@@ -164,6 +193,9 @@ def main():
     print(f"# reduce_blocks_1M_wall_s={reduce_s:.4f}")
     print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")
     print(f"# inception_v3_map_blocks_rows_per_sec={inception_rps:.0f}")
+    print(
+        f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"
+    )
 
     baseline = None
     # the published baseline is full-scale-on-TPU; a CPU fallback run uses a

@@ -120,9 +120,11 @@ class Checkpointer:
         path = _step_dir(self.root, step)
         if not os.path.isdir(path):
             raise FileNotFoundError(f"no checkpoint at {path}")
-        if self.backend == "orbax":
-            return self._restore_orbax(path, like)
-        return self._restore_npz(path, like)
+        # dispatch on the on-disk format, not the configured backend, so a
+        # checkpoint written where orbax was (un)available restores anywhere
+        if os.path.exists(os.path.join(path, "manifest.json")):
+            return self._restore_npz(path, like)
+        return self._restore_orbax(path, like)
 
     # -- orbax backend ------------------------------------------------------
 
@@ -144,34 +146,60 @@ class Checkpointer:
     # -- npz backend --------------------------------------------------------
 
     def _save_npz(self, path: str, state: Any) -> None:
+        # leaves are stored as raw bytes + (dtype, shape) in the manifest:
+        # numpy's npz loader cannot reconstruct ml_dtypes (bfloat16 etc.) —
+        # it silently returns void ('|V2') arrays — so round-tripping via
+        # bytes with the dtype recorded out-of-band is the portable form.
         os.makedirs(path, exist_ok=True)
         flat, _ = jax.tree_util.tree_flatten_with_path(state)
         arrays = {}
         manifest = []
         for i, (keypath, leaf) in enumerate(flat):
-            arrays[f"a{i}"] = np.asarray(leaf)
-            manifest.append(jax.tree_util.keystr(keypath))
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            arrays[f"a{i}"] = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+            manifest.append(
+                {
+                    "key": jax.tree_util.keystr(keypath),
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape),
+                }
+            )
         np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f)
 
+    @staticmethod
+    def _np_dtype(name: str) -> np.dtype:
+        try:
+            return np.dtype(name)
+        except TypeError:
+            import ml_dtypes  # jax dependency; owns bfloat16/float8 dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+
     def _restore_npz(self, path: str, like: Any) -> Any:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
-        data = np.load(os.path.join(path, "arrays.npz"))
-        leaves = [data[f"a{i}"] for i in range(len(manifest))]
+        with np.load(os.path.join(path, "arrays.npz")) as data:
+            leaves = []
+            for i, entry in enumerate(manifest):
+                raw = data[f"a{i}"]
+                arr = np.frombuffer(
+                    raw.tobytes(), dtype=self._np_dtype(entry["dtype"])
+                ).reshape(entry["shape"])
+                leaves.append(arr)
         if like is None:
             # reconstruct as a flat {keystr: array} dict
-            return dict(zip(manifest, leaves))
+            return {e["key"]: a for e, a in zip(manifest, leaves)}
         flat, treedef = jax.tree_util.tree_flatten_with_path(like)
         if len(flat) != len(leaves):
             raise ValueError(
                 f"checkpoint has {len(leaves)} leaves, template has {len(flat)}"
             )
-        for (keypath, _), name in zip(flat, manifest):
-            if jax.tree_util.keystr(keypath) != name:
+        for (keypath, _), entry in zip(flat, manifest):
+            if jax.tree_util.keystr(keypath) != entry["key"]:
                 raise ValueError(
-                    f"checkpoint leaf {name!r} does not match template "
-                    f"leaf {jax.tree_util.keystr(keypath)!r}"
+                    f"checkpoint leaf {entry['key']!r} does not match "
+                    f"template leaf {jax.tree_util.keystr(keypath)!r}"
                 )
         return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -195,7 +195,15 @@ def constant(value: ConstLike, name: Optional[str] = None) -> Node:
     Python floats become float64, ints int64 — matching frame inference."""
     arr = np.asarray(value)
     scalar = dt.from_numpy(arr.dtype)
-    val = jnp.asarray(arr)
+    if arr.ndim == 0 and isinstance(value, (int, float)) and not isinstance(
+        value, bool
+    ):
+        # plain Python scalars stay weak-typed literals, exactly as if the
+        # user had written ``x + 3.0`` in jnp directly: XLA inlines them
+        # (no hoisted constant buffer) and they adopt the operand's dtype
+        val = value
+    else:
+        val = jnp.asarray(arr)
     return Node(
         "constant",
         [],

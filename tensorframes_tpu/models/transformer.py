@@ -231,6 +231,21 @@ def embed_program(cfg: TransformerConfig, params: Dict):
     return program
 
 
+def embed_row_program(cfg: TransformerConfig, params: Dict):
+    """map_rows program: one token cell [s] → {"embedding": [h]}.
+
+    The per-row formulation of BASELINE config 5 ("BERT-base embedding
+    extraction: mapRows over a tokenized text column"); map_rows vmaps it
+    over the block, so the whole block still runs as one batched XLA
+    program on the MXU."""
+
+    def program(tokens):
+        hs = forward(cfg, params, tokens[None, :])
+        return {"embedding": hs[0].mean(axis=0).astype(jnp.float32)}
+
+    return program
+
+
 # ---------------------------------------------------------------------------
 # Training
 # ---------------------------------------------------------------------------

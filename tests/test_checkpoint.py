@@ -89,3 +89,32 @@ def test_sharded_state_roundtrip(tmp_path):
     ckpt.save(3, {"w": w})
     got = ckpt.restore(like={"w": jnp.zeros((16,), jnp.float32)})
     np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(16))
+
+
+def test_npz_bfloat16_roundtrip(tmp_path):
+    """bf16 leaves survive the npz byte-format (numpy's own npz loader
+    can't reconstruct ml_dtypes — regression guard)."""
+    ckpt = Checkpointer(str(tmp_path / "run"), backend="npz")
+    state = {"w": jnp.full((3, 2), 1.5, jnp.bfloat16)}
+    ckpt.save(1, state)
+    got = ckpt.restore(like={"w": jnp.zeros((3, 2), jnp.bfloat16)})
+    assert str(np.asarray(got["w"]).dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(got["w"]).astype(np.float32), np.full((3, 2), 1.5)
+    )
+
+
+def test_restore_sniffs_format_across_backends(tmp_path):
+    """A checkpoint written by one backend restores under the other (the
+    on-disk format, not the configured backend, decides)."""
+    w = jnp.arange(4, dtype=jnp.float32)
+    Checkpointer(str(tmp_path / "a"), backend="npz").save(1, {"w": w})
+    got = Checkpointer(str(tmp_path / "a"), backend="orbax").restore(
+        like={"w": jnp.zeros((4,), jnp.float32)}
+    )
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(4))
+    Checkpointer(str(tmp_path / "b"), backend="orbax").save(1, {"w": w})
+    got = Checkpointer(str(tmp_path / "b"), backend="npz").restore(
+        like={"w": jnp.zeros((4,), jnp.float32)}
+    )
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(4))

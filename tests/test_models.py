@@ -138,3 +138,21 @@ def test_sharded_matches_unsharded_loss():
     g = jax.device_put(targets, data_sharding)
     _, _, loss = step(p, opt_state, t, g)
     assert abs(float(loss) - ref_loss) < 5e-2  # bf16 tolerance
+
+
+def test_bert_embed_row_program_via_map_rows():
+    cfg = tr.tiny()
+    params = tr.init_params(cfg, seed=0)
+    tokens, _ = tr.synthetic_batch(cfg, 6, 8, seed=0)
+    df = tfs.frame_from_arrays({"tokens": tokens}, num_blocks=2)
+    prog = tr.embed_row_program(cfg, params)
+    out = tfs.map_rows(lambda tokens: prog(tokens), df)
+    emb = np.stack([r["embedding"] for r in out.collect()])
+    assert emb.shape == (6, cfg.hidden)
+    # per-row program equals the block program
+    block_prog = tr.embed_program(cfg, params)
+    import jax.numpy as jnp
+    want = np.asarray(block_prog(jnp.asarray(tokens))["embedding"])
+    # bf16 activations: different-but-valid fusion orders between the
+    # vmapped verb path and the block path round differently
+    np.testing.assert_allclose(emb, want, rtol=3e-2, atol=3e-2)
