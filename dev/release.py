@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Release helper (≙ dev/release.py:1-115 in the reference): bump the
+version in pyproject.toml and tensorframes_tpu/__init__.py, commit, and
+tag. Non-interactive; prints the commands it would run with --dry-run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+FILES = {
+    ROOT / "pyproject.toml": r'(version = ")([^"]+)(")',
+    ROOT / "tensorframes_tpu" / "__init__.py": r'(__version__ = ")([^"]+)(")',
+}
+
+
+def current_version() -> str:
+    text = (ROOT / "pyproject.toml").read_text()
+    m = re.search(FILES[ROOT / "pyproject.toml"], text)
+    if not m:
+        sys.exit("could not find version in pyproject.toml")
+    return m.group(2)
+
+
+def bump(version: str, part: str) -> str:
+    major, minor, patch = (int(x) for x in version.split("."))
+    if part == "major":
+        return f"{major + 1}.0.0"
+    if part == "minor":
+        return f"{major}.{minor + 1}.0"
+    return f"{major}.{minor}.{patch + 1}"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("part", choices=["major", "minor", "patch"])
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("--no-tag", action="store_true")
+    args = ap.parse_args()
+
+    old = current_version()
+    new = bump(old, args.part)
+    print(f"{old} -> {new}")
+    for path, pattern in FILES.items():
+        text = path.read_text()
+        updated, n = re.subn(pattern, rf"\g<1>{new}\g<3>", text)
+        if n != 1:
+            sys.exit(f"expected exactly one version in {path}, found {n}")
+        if args.dry_run:
+            print(f"would update {path}")
+        else:
+            path.write_text(updated)
+    cmds = [["git", "add"] + [str(p) for p in FILES]]
+    cmds.append(["git", "commit", "-m", f"release: v{new}"])
+    if not args.no_tag:
+        cmds.append(["git", "tag", f"v{new}"])
+    for cmd in cmds:
+        if args.dry_run:
+            print("would run:", " ".join(cmd))
+        else:
+            subprocess.run(cmd, check=True, cwd=ROOT)
+
+
+if __name__ == "__main__":
+    main()

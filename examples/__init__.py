@@ -1,0 +1,9 @@
+"""Demo workloads driven through the frame verbs.
+
+≙ the reference's non-packaged ``tensorframes_snippets`` (SURVEY.md §2.4):
+distributed k-means via map_blocks+aggregate (kmeans.py:85-162), harmonic
+and geometric means via aggregate (geom_mean.py:26-49), and model inference
+over an image frame (read_image.py's VGG sketch → Inception here). Each is
+a library function with tests, not just a script — but every one is also
+runnable as ``python -m examples.<name>``.
+"""

@@ -1,0 +1,61 @@
+"""Geometric and harmonic means through keyed ``aggregate``.
+
+≙ tensorframes_snippets/geom_mean.py:26-49: non-algebraic means become
+algebraic in transformed space — sum of logs (geometric) and sum of
+reciprocals (harmonic) — so a keyed aggregate covers them. The transform
+runs in the same XLA program as the block pass (fused elementwise), and
+the per-key sums ride the segment-reduction fast path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+import tensorframes_tpu as tfs
+
+
+def _keyed_mean(frame, key: str, col: str, fwd, inv):
+    """Per-key mean in ``fwd``-transformed space, mapped back with ``inv``."""
+    with tfs.with_graph():
+        x = tfs.block(frame, col)
+        t = tfs.apply_fn(fwd, x, name="t")
+        one = tfs.apply_fn(lambda v: v * 0 + 1.0, x, name="one")
+        transformed = tfs.map_blocks([t, one], frame)
+    agg = tfs.aggregate(
+        lambda t_input, one_input: {
+            "t": t_input.sum(axis=0),
+            "one": one_input.sum(axis=0),
+        },
+        transformed.group_by(key),
+    )
+    keys = np.asarray(agg.column_values(key))
+    means = inv(
+        np.asarray(agg.column_values("t")), np.asarray(agg.column_values("one"))
+    )
+    return dict(zip(keys.tolist(), np.asarray(means).tolist()))
+
+
+def geometric_mean_by_key(frame: "tfs.TensorFrame", key: str, col: str):
+    """Per-key geometric mean of ``col``: exp(mean(log x))."""
+    return _keyed_mean(
+        frame, key, col, jnp.log, lambda s, n: np.exp(s / n)
+    )
+
+
+def harmonic_mean_by_key(frame: "tfs.TensorFrame", key: str, col: str):
+    """Per-key harmonic mean of ``col``: n / sum(1/x)."""
+    return _keyed_mean(
+        frame, key, col, lambda v: 1.0 / v, lambda s, n: n / s
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    frame = tfs.frame_from_arrays(
+        {
+            "key": np.array([1, 1, 1, 2, 2]),
+            "x": np.array([1.0, 2.0, 4.0, 3.0, 27.0]),
+        }
+    )
+    print("geometric:", geometric_mean_by_key(frame, "key", "x"))
+    print("harmonic:", harmonic_mean_by_key(frame, "key", "x"))

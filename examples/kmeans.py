@@ -1,0 +1,91 @@
+"""Distributed k-means through the frame verbs.
+
+≙ tensorframes_snippets/kmeans.py:85-162 / kmeans_demo.py: the reference
+runs one TF graph per block to find each row's closest centroid and then
+aggregates per-centroid sums with a groupBy. Here the same two verbs do
+the same job, TPU-native: the assignment program is one XLA program per
+block (distance matrix on the MXU), and the centroid update is a keyed
+``aggregate`` (segment-sum fast path) instead of a Catalyst shuffle.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import tensorframes_tpu as tfs
+
+
+def assignment_program(centers: np.ndarray):
+    """map_blocks program: features [n, d] → closest-center index + a count
+    column (the aggregate's denominator)."""
+    c = jnp.asarray(centers)
+
+    def program(features):
+        # pairwise squared distances without materializing [n, k, d]:
+        # |x - c|^2 = |x|^2 - 2 x·c + |c|^2 — one MXU matmul
+        x2 = jnp.sum(features * features, axis=1, keepdims=True)
+        c2 = jnp.sum(c * c, axis=1)
+        d2 = x2 - 2.0 * (features @ c.T) + c2
+        return {
+            "cluster": jnp.argmin(d2, axis=1).astype(jnp.int64),
+            "one": jnp.ones(features.shape[0], features.dtype),
+        }
+
+    return program
+
+
+def kmeans_step(frame: "tfs.TensorFrame", centers: np.ndarray) -> np.ndarray:
+    """One Lloyd iteration: assign, then per-cluster mean via aggregate."""
+    assigned = tfs.map_blocks(assignment_program(centers), frame)
+    agg = tfs.aggregate(
+        lambda features_input, one_input: {
+            "features": features_input.sum(axis=0),
+            "one": one_input.sum(axis=0),
+        },
+        assigned.group_by("cluster"),
+    )
+    sums = np.asarray(agg.column_values("features"), dtype=np.float64)
+    counts = np.asarray(agg.column_values("one"), dtype=np.float64)
+    clusters = np.asarray(agg.column_values("cluster"))
+    new = centers.copy()
+    new[clusters] = (sums / counts[:, None]).astype(centers.dtype)
+    return new
+
+
+def kmeans(
+    frame: "tfs.TensorFrame",
+    k: int,
+    num_iters: int = 10,
+    seed: int = 0,
+    tol: float = 1e-6,
+) -> Tuple[np.ndarray, int]:
+    """Lloyd's k-means over the frame's ``features`` column.
+
+    Returns (centers [k, d], iterations actually run)."""
+    feats = np.asarray(frame.column_values("features"))
+    rng = np.random.default_rng(seed)
+    centers = feats[rng.choice(len(feats), size=k, replace=False)].copy()
+    for it in range(num_iters):
+        new = kmeans_step(frame, centers)
+        if np.max(np.abs(new - centers)) < tol:
+            return new, it + 1
+        centers = new
+    return centers, num_iters
+
+
+def _demo():  # pragma: no cover
+    rng = np.random.default_rng(0)
+    true = np.array([[0.0, 0.0], [5.0, 5.0], [-5.0, 5.0]], np.float32)
+    pts = np.concatenate(
+        [t + rng.standard_normal((200, 2)).astype(np.float32) * 0.5 for t in true]
+    )
+    frame = tfs.frame_from_arrays({"features": pts}, num_blocks=4)
+    centers, iters = kmeans(frame, k=3, num_iters=20, seed=1)
+    print(f"converged in {iters} iters:\n{np.sort(centers, axis=0)}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _demo()

@@ -156,7 +156,7 @@ class Checkpointer:
         manifest = []
         for i, (keypath, leaf) in enumerate(flat):
             arr = np.ascontiguousarray(np.asarray(leaf))
-            arrays[f"a{i}"] = np.frombuffer(arr.tobytes(), dtype=np.uint8)
+            arrays[f"a{i}"] = arr.reshape(-1).view(np.uint8)  # zero-copy view
             manifest.append(
                 {
                     "key": jax.tree_util.keystr(keypath),
@@ -180,26 +180,36 @@ class Checkpointer:
     def _restore_npz(self, path: str, like: Any) -> Any:
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
+        legacy = bool(manifest) and isinstance(manifest[0], str)
         with np.load(os.path.join(path, "arrays.npz")) as data:
             leaves = []
             for i, entry in enumerate(manifest):
                 raw = data[f"a{i}"]
-                arr = np.frombuffer(
-                    raw.tobytes(), dtype=self._np_dtype(entry["dtype"])
-                ).reshape(entry["shape"])
-                leaves.append(arr)
+                if legacy:
+                    # pre-byte-format checkpoints stored arrays directly
+                    # (native dtypes only); keep them restorable
+                    leaves.append(raw)
+                else:
+                    # np.load returns fresh writable arrays; view+reshape is
+                    # copy-free and stays writable
+                    leaves.append(
+                        raw.view(self._np_dtype(entry["dtype"])).reshape(
+                            entry["shape"]
+                        )
+                    )
+        keys = manifest if legacy else [e["key"] for e in manifest]
         if like is None:
             # reconstruct as a flat {keystr: array} dict
-            return {e["key"]: a for e, a in zip(manifest, leaves)}
+            return dict(zip(keys, leaves))
         flat, treedef = jax.tree_util.tree_flatten_with_path(like)
         if len(flat) != len(leaves):
             raise ValueError(
                 f"checkpoint has {len(leaves)} leaves, template has {len(flat)}"
             )
-        for (keypath, _), entry in zip(flat, manifest):
-            if jax.tree_util.keystr(keypath) != entry["key"]:
+        for (keypath, _), key in zip(flat, keys):
+            if jax.tree_util.keystr(keypath) != key:
                 raise ValueError(
-                    f"checkpoint leaf {entry['key']!r} does not match "
+                    f"checkpoint leaf {key!r} does not match "
                     f"template leaf {jax.tree_util.keystr(keypath)!r}"
                 )
         return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -192,7 +192,14 @@ def placeholder(
 
 def constant(value: ConstLike, name: Optional[str] = None) -> Node:
     """Embed a constant (≙ dsl/package.scala:53-58; DenseTensor constants).
-    Python floats become float64, ints int64 — matching frame inference."""
+
+    Plain Python scalars behave exactly like literals in jnp code
+    (``x + 3.0``): weak-typed, adopting the other operand's dtype, and
+    inlined by XLA. Typed values (numpy scalars/arrays, nested lists)
+    keep their exact dtype — floats default to float64, ints to int64,
+    matching frame inference. The node's declared dtype records the
+    default; weak literals may narrow to the operand's dtype at trace
+    time."""
     arr = np.asarray(value)
     scalar = dt.from_numpy(arr.dtype)
     if arr.ndim == 0 and isinstance(value, (int, float)) and not isinstance(

@@ -1,0 +1,72 @@
+"""Example-workload tests (≙ the reference's snippet demos, SURVEY.md §2.4,
+here exercised as real tested code): k-means, geometric/harmonic means,
+and batch image inference."""
+
+import sys
+import os
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import tensorframes_tpu as tfs  # noqa: E402
+from examples import geom_mean, kmeans  # noqa: E402
+
+
+def test_kmeans_recovers_separated_clusters():
+    rng = np.random.default_rng(0)
+    true = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]], np.float32)
+    pts = np.concatenate(
+        [t + rng.standard_normal((60, 2)).astype(np.float32) * 0.3 for t in true]
+    )
+    rng.shuffle(pts)
+    frame = tfs.frame_from_arrays({"features": pts}, num_blocks=3)
+    centers, iters = kmeans.kmeans(frame, k=3, num_iters=25, seed=1)
+    got = np.asarray(sorted(centers.tolist()))
+    want = np.asarray(sorted(true.tolist()))
+    np.testing.assert_allclose(got, want, atol=0.3)
+    assert iters <= 25
+
+
+def test_kmeans_step_moves_centers_toward_data():
+    pts = np.array([[0.0, 0.0], [0.2, 0.0], [10.0, 10.0], [10.2, 10.0]], np.float32)
+    frame = tfs.frame_from_arrays({"features": pts}, num_blocks=2)
+    centers = np.array([[1.0, 1.0], [9.0, 9.0]], np.float32)
+    new = kmeans.kmeans_step(frame, centers)
+    np.testing.assert_allclose(new[0], [0.1, 0.0], atol=1e-5)
+    np.testing.assert_allclose(new[1], [10.1, 10.0], atol=1e-5)
+
+
+def test_geometric_mean_by_key():
+    frame = tfs.frame_from_arrays(
+        {"key": np.array([1, 1, 1, 2, 2]), "x": np.array([1.0, 2.0, 4.0, 3.0, 27.0])}
+    )
+    got = geom_mean.geometric_mean_by_key(frame, "key", "x")
+    assert got[1] == pytest.approx(2.0)       # (1·2·4)^(1/3)
+    assert got[2] == pytest.approx(9.0)       # (3·27)^(1/2)
+
+
+def test_harmonic_mean_by_key():
+    frame = tfs.frame_from_arrays(
+        {"key": np.array([1, 1], dtype=np.int64), "x": np.array([1.0, 3.0])}
+    )
+    got = geom_mean.harmonic_mean_by_key(frame, "key", "x")
+    assert got[1] == pytest.approx(1.5)       # 2 / (1 + 1/3)
+
+
+def test_image_inference_example():
+    from examples import image_inference
+    from tensorframes_tpu.models import inception as inc
+
+    cfg = inc.tiny()
+    params = inc.init_params(cfg, seed=0)
+    images = inc.synthetic_images(cfg, 4, seed=0)
+    frame = tfs.frame_from_arrays({"pix": images}, num_blocks=2)
+    scored = image_inference.score_images(
+        frame, cfg, params, image_col="pix", to_device=False
+    )
+    rows = scored.collect()
+    assert len(rows) == 4
+    assert all(0 <= r["label"] < cfg.num_classes for r in rows)
+    assert all(abs(float(np.sum(r["scores"])) - 1.0) < 1e-4 for r in rows)
