@@ -347,6 +347,42 @@ class TensorFrame:
             frame = frame.repartition(num_blocks)
         return frame
 
+    # -- verb methods (≙ Implicits.RichDataFrame, dsl/Implicits.scala:25-100:
+    # the Scala API pimps DataFrame with the verbs; here they are plain
+    # methods delegating to the functional API) ----------------------------
+
+    def map_blocks(self, fetches, feed_dict=None, trim: bool = False):
+        from .ops.verbs import map_blocks
+
+        return map_blocks(fetches, self, feed_dict=feed_dict, trim=trim)
+
+    def map_blocks_trimmed(self, fetches, feed_dict=None):
+        """≙ ``mapBlocksTrimmed`` (dsl/Implicits.scala:49-55)."""
+        return self.map_blocks(fetches, feed_dict=feed_dict, trim=True)
+
+    def map_rows(self, fetches, feed_dict=None):
+        from .ops.verbs import map_rows
+
+        return map_rows(fetches, self, feed_dict=feed_dict)
+
+    def reduce_rows(self, fetches):
+        from .ops.verbs import reduce_rows
+
+        return reduce_rows(fetches, self)
+
+    def reduce_blocks(self, fetches):
+        from .ops.verbs import reduce_blocks
+
+        return reduce_blocks(fetches, self)
+
+    def analyze(self) -> "TensorFrame":
+        """≙ ``RichDataFrame.analyze`` (dsl/Implicits.scala:69-71)."""
+        return analyze(self)
+
+    def explain_tensors(self) -> str:
+        """≙ ``explainTensors`` (dsl/Implicits.scala:77-79)."""
+        return explain(self)
+
     def group_by(self, *keys: str) -> "GroupedData":
         """Group rows by key column(s) for keyed ``aggregate``
         (≙ ``df.groupBy("key")`` feeding ``tfs.aggregate``, core.py:401-419)."""
@@ -363,6 +399,13 @@ class GroupedData:
     def __init__(self, frame: "TensorFrame", keys: List[str]):
         self.frame = frame
         self.keys = keys
+
+    def aggregate(self, fetches) -> "TensorFrame":
+        """≙ ``RichRelationalGroupedDataset.aggregate``
+        (dsl/Implicits.scala:107-116)."""
+        from .ops.verbs import aggregate
+
+        return aggregate(fetches, self)
 
     def __repr__(self):
         return f"GroupedData(keys={self.keys}, {self.frame!r})"

@@ -40,9 +40,9 @@ class TransformerConfig:
     mlp_ratio: int = 4
     max_seq_len: int = 512
     dtype: Any = jnp.bfloat16  # activations/compute; params stay f32
-    # attention implementation: 'dense' | 'blockwise' | 'flash' | 'ring'
-    # (ring = sequence parallelism over the mesh 'sp' axis; see
-    # ops/attention.py)
+    # attention implementation: 'dense' | 'blockwise' | 'flash' | 'ring' |
+    # 'ulysses' (ring/ulysses = sequence parallelism over the mesh 'sp'
+    # axis — ppermute ring vs all-to-all head exchange; see ops/attention.py)
     attention_impl: str = "dense"
     causal: bool = False
 
@@ -167,14 +167,19 @@ def _attention(cfg: TransformerConfig, p, x, mask, mesh=None):
             f"attention_impl={impl!r} does not support a padding mask yet; "
             "use attention_impl='dense' for padded batches"
         )
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         if mesh is None or "sp" not in mesh.shape:
             raise ValueError(
-                "attention_impl='ring' requires a mesh with an 'sp' axis "
+                f"attention_impl={impl!r} requires a mesh with an 'sp' axis "
                 "passed to forward(...); got "
                 f"{None if mesh is None else dict(mesh.shape)}"
             )
-        ctx = att.ring_attention(q, k, v, mesh, axis="sp", causal=cfg.causal)
+        if impl == "ring":
+            ctx = att.ring_attention(q, k, v, mesh, axis="sp", causal=cfg.causal)
+        else:
+            ctx = att.ulysses_attention(
+                q, k, v, mesh, axis="sp", causal=cfg.causal
+            )
     elif impl == "blockwise":
         ctx = att.blockwise_attention(q, k, v, causal=cfg.causal)
     elif impl == "flash":

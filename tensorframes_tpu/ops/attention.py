@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -180,6 +181,15 @@ def _ring_attention_local(
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
+def _maybe_axis(mesh: Mesh, name: Optional[str], dim_size: int) -> Optional[str]:
+    """Use mesh axis ``name`` for a dim only when it exists and divides the
+    dim evenly; otherwise keep the dim replicated (shard_map would reject
+    an uneven split)."""
+    if not name or name not in mesh.shape:
+        return None
+    return name if dim_size % mesh.shape[name] == 0 else None
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -208,15 +218,72 @@ def ring_attention(
             f"ring_attention: seq {seq} not divisible by mesh axis "
             f"{axis!r} of size {sp}"
         )
-    db = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
-    ha = head_axis if (head_axis and head_axis in mesh.shape) else None
-    if ha is not None and q.shape[1] % mesh.shape[ha] != 0:
-        ha = None  # fewer heads than tp shards: keep heads replicated
+    db = _maybe_axis(mesh, batch_axis, q.shape[0])
+    ha = _maybe_axis(mesh, head_axis, q.shape[1])
     spec = P(db, ha, axis, None)
     fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis, causal=causal
         ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    batch_axis: Optional[str] = "dp",
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style) — the
+    complement of :func:`ring_attention`.
+
+    Inputs are [b, heads, seq, head_dim] with ``seq`` sharded over
+    ``axis``. Two ``all_to_all`` collectives re-shard: heads scatter
+    across the sp group while sequence gathers (each device then holds the
+    FULL sequence for heads/sp heads), standard blockwise attention runs
+    locally with no per-step communication, and the reverse exchange
+    restores sequence sharding. Versus the ring: 2 bulk a2a transfers
+    instead of sp ppermute rounds — better when ICI latency dominates and
+    heads divide evenly; the ring wins when heads < sp or memory for the
+    full sequence per head is tight.
+    """
+    from ..parallel._shard_map import shard_map
+
+    seq, heads = q.shape[2], q.shape[1]
+    sp = mesh.shape[axis]
+    if seq % sp != 0:
+        raise ValueError(
+            f"ulysses_attention: seq {seq} not divisible by mesh axis "
+            f"{axis!r} of size {sp}"
+        )
+    if heads % sp != 0:
+        raise ValueError(
+            f"ulysses_attention: heads {heads} not divisible by mesh axis "
+            f"{axis!r} of size {sp} (use ring_attention for heads < sp)"
+        )
+    db = _maybe_axis(mesh, batch_axis, q.shape[0])
+
+    def local(qs, ks, vs):
+        # one fused exchange for q/k/v (stacked on a lead axis): heads
+        # scatter (split dim 2), sequence gathers (concat dim 3)
+        # [3, b, h, s/sp, d] → [3, b, h/sp, s, d]
+        qkv = jnp.stack([qs, ks, vs])
+        qkv = lax.all_to_all(qkv, axis, split_axis=2, concat_axis=3, tiled=True)
+        ctx = blockwise_attention(qkv[0], qkv[1], qkv[2], causal=causal)
+        # reverse: sequence scatters, heads gather → [b, h, s/sp, d]
+        return lax.all_to_all(ctx, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    spec = P(db, None, axis, None)
+    fn = shard_map(
+        local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -1,0 +1,132 @@
+"""Segment reduction kernels for keyed ``aggregate``.
+
+The aggregate fast path (verbs.py) lowers algebraic fetches to segment
+reductions over key-sorted rows. On TPU, XLA implements
+``jax.ops.segment_sum`` as a scatter-add — a serialized, VPU-bound op.
+This module adds a **custom pallas kernel** that reformulates the sorted
+segment-sum as a one-hot contraction: for each row tile, build the
+``[tile, segments]`` membership one-hot and contract it against the value
+tile on the **MXU** (a dense matmul), accumulating into the output block
+across the grid. Dense MXU work replaces the scatter — the standard TPU
+trick for small-to-moderate segment counts.
+
+``segment_sum`` dispatches: pallas on TPU for f32/bf16 2-D values with a
+bounded segment count, XLA's segment_sum otherwise. The pallas path is
+also exercised on CPU in interpreter mode by the tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# rows per grid step (sublane-aligned); lanes carry the feature dim
+_TILE_ROWS = 256
+# above this many segments the one-hot matmul wastes more FLOPs than the
+# scatter costs; fall back to XLA
+_MAX_PALLAS_SEGMENTS = 4096
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _seg_kernel(num_segments: int, seg_ref, val_ref, out_ref):
+    """One grid step: out[s, d] += Σ_{rows r in tile with seg(r)=s} val[r, d].
+
+    seg_ref: [tile, 1] int32 (padded rows carry num_segments → no match);
+    val_ref: [tile, d]; out_ref: [segments_padded, d] (same block every
+    step — accumulates across the sequential TPU grid).
+    """
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    seg = seg_ref[:, 0]  # [tile]
+    tile = seg.shape[0]
+    s_pad = out_ref.shape[0]
+    # [tile, segments] membership one-hot; 2-D iota (TPU requires ≥2D)
+    seg_iota = lax.broadcasted_iota(jnp.int32, (tile, s_pad), 1)
+    onehot = (seg[:, None] == seg_iota).astype(jnp.float32)
+    vals = val_ref[:].astype(jnp.float32)
+    # [segments, tile] @ [tile, d] on the MXU
+    out_ref[:] += lax.dot_general(
+        onehot,
+        vals,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def segment_sum_pallas(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Sorted-or-not segment sum via the one-hot MXU kernel.
+
+    values [n, d] (f32/bf16), seg_ids [n] int32 in [0, num_segments).
+    Returns [num_segments, d] float32.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, d = values.shape
+    n_pad = _round_up(max(n, 1), _TILE_ROWS)
+    d_pad = _round_up(max(d, 1), 128)
+    s_pad = _round_up(num_segments, 8)
+
+    vals = jnp.zeros((n_pad, d_pad), values.dtype).at[:n, :d].set(values)
+    # padded rows point at segment id == num_segments → match nothing
+    segs = jnp.full((n_pad, 1), num_segments, jnp.int32).at[:n, 0].set(
+        seg_ids.astype(jnp.int32)
+    )
+
+    grid = (n_pad // _TILE_ROWS,)
+    out = pl.pallas_call(
+        functools.partial(_seg_kernel, num_segments),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_ROWS, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((s_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((s_pad, d_pad), jnp.float32),
+        interpret=interpret,
+    )(segs, vals)
+    return out[:num_segments, :d]
+
+
+def _pallas_eligible(values: jnp.ndarray, num_segments: int) -> bool:
+    return (
+        values.ndim == 2
+        and values.dtype in (jnp.float32, jnp.bfloat16)
+        and 0 < num_segments <= _MAX_PALLAS_SEGMENTS
+        and jax.default_backend() == "tpu"
+    )
+
+
+def segment_sum(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+) -> jnp.ndarray:
+    """Segment sum with automatic kernel dispatch: the pallas one-hot MXU
+    kernel on TPU (1-D/2-D f32/bf16 values, bounded segment count), XLA's
+    scatter-based ``jax.ops.segment_sum`` otherwise. Result dtype matches
+    ``values``."""
+    v2 = values[:, None] if values.ndim == 1 else values
+    if _pallas_eligible(v2, num_segments):
+        out = segment_sum_pallas(v2, seg_ids, num_segments)
+        if values.ndim == 1:
+            out = out[:, 0]
+        return out.astype(values.dtype)
+    return jax.ops.segment_sum(values, seg_ids, num_segments=num_segments)

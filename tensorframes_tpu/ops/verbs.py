@@ -472,8 +472,12 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
 # aggregate (keyed)
 # ---------------------------------------------------------------------------
 
+from .segment import segment_sum as _segment_sum
+
 _SEGMENT_OPS = {
-    "reduce_sum": jax.ops.segment_sum,
+    # sum rides the custom pallas one-hot MXU kernel on TPU (segment.py);
+    # min/max stay on XLA's segment scatter
+    "reduce_sum": _segment_sum,
     "reduce_min": jax.ops.segment_min,
     "reduce_max": jax.ops.segment_max,
 }
@@ -563,7 +567,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
             for out_name, op, _ in seg_info:
                 v = vals[out_name]
                 if op == "reduce_mean":
-                    s = jax.ops.segment_sum(v, sids, num_segments=num_groups)
+                    s = _segment_sum(v, sids, num_segments=num_groups)
                     c = jax.ops.segment_sum(
                         jnp.ones(v.shape[:1], v.dtype), sids, num_segments=num_groups
                     )

@@ -212,3 +212,62 @@ def test_dense_attention_padding_mask():
     ref = att.dense_attention(q[:, :, :, :], k[:, :, :6], v[:, :, :6])
     # queries attend only to the first 6 keys
     assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_ulysses_matches_dense():
+    from tensorframes_tpu.ops import attention as att
+    from tensorframes_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"sp": 4, "dp": 2})
+    rng = np.random.default_rng(5)
+    b, h, s, d = 2, 4, 16, 8
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        for _ in range(3)
+    )
+    got = att.ulysses_attention(q, k, v, mesh, axis="sp")
+    want = att.dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_causal_matches_dense():
+    from tensorframes_tpu.ops import attention as att
+    from tensorframes_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"sp": 4, "dp": 2})
+    rng = np.random.default_rng(6)
+    b, h, s, d = 1, 4, 32, 8
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        for _ in range(3)
+    )
+    got = att.ulysses_attention(q, k, v, mesh, axis="sp", causal=True)
+    want = att.dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_head_divisibility_error():
+    from tensorframes_tpu.ops import attention as att
+    from tensorframes_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"sp": 4, "dp": 2})
+    q = jnp.zeros((1, 3, 16, 8), jnp.float32)  # 3 heads, sp=4
+    with pytest.raises(ValueError, match="heads 3 not divisible"):
+        att.ulysses_attention(q, q, q, mesh, axis="sp")
+
+
+def test_transformer_ulysses_impl():
+    from tensorframes_tpu.models import transformer as tr
+    from tensorframes_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"sp": 4, "dp": 2})
+    cfg = tr.tiny(attention_impl="ulysses")
+    params = tr.init_params(cfg, seed=0)
+    tokens, _ = tr.synthetic_batch(cfg, 2, 16, seed=0)
+    hs = tr.forward(cfg, params, jnp.asarray(tokens), mesh=mesh)
+    dense_cfg = tr.tiny(attention_impl="dense")
+    want = tr.forward(dense_cfg, params, jnp.asarray(tokens))
+    np.testing.assert_allclose(
+        np.asarray(hs, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,  # bf16 activations
+    )

@@ -115,3 +115,27 @@ def test_block_placeholder_rejects_host_column():
     df = tfs.frame_from_rows([{"s": "a"}])
     with pytest.raises(TypeError):
         tfs.block(df, "s")
+
+
+def test_rich_frame_verb_methods():
+    """Verb methods on the frame (≙ Implicits.RichDataFrame) delegate to
+    the functional API."""
+    import numpy as np
+
+    df = tfs.frame_from_arrays({"x": np.arange(10.0)}, num_blocks=2)
+    out = df.map_blocks(lambda x: {"y": x * 2})
+    assert out.column_values("y").tolist() == (np.arange(10.0) * 2).tolist()
+    trimmed = df.map_blocks_trimmed(lambda x: {"m": x.max(keepdims=True)})
+    assert trimmed.num_rows == 2  # one row per block
+    rows = df.map_rows(lambda x: {"z": x + 1})
+    assert rows.column_values("z").tolist() == (np.arange(10.0) + 1).tolist()
+    assert float(df.reduce_blocks(lambda x_input: {"x": x_input.sum(0)})) == 45.0
+    assert float(
+        df.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2})
+    ) == 45.0
+    assert "x" in df.analyze().explain_tensors()
+    g = tfs.frame_from_arrays(
+        {"k": np.array([1, 1, 2]), "v": np.array([1.0, 2.0, 3.0])}
+    )
+    agg = g.group_by("k").aggregate(lambda v_input: {"v": v_input.sum(0)})
+    assert {r["k"]: r["v"] for r in agg.collect()} == {1: 3.0, 2: 3.0}
