@@ -81,6 +81,7 @@ from .ops.verbs import (  # noqa: E402,F401
     reduce_rows,
 )
 from .checkpoint import Checkpointer  # noqa: E402,F401
+from . import io  # noqa: E402,F401
 from .utils import profiling  # noqa: E402,F401
 
 __version__ = "0.1.0"
@@ -110,6 +111,7 @@ __all__ = [
     # aux subsystems
     "Checkpointer",
     "profiling",
+    "io",
     # dsl / placeholder helpers
     "Node",
     "block",

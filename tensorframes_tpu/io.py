@@ -1,0 +1,120 @@
+"""Data loading: batch iteration + host→device prefetch.
+
+The reference has no loader of its own — Spark's scan pipeline feeds
+partitions to executors while TF runs (implicit overlap). The TPU-native
+equivalent must be explicit: ``iterate_batches`` walks a frame's columns
+in minibatches on the host, and ``prefetch_to_device`` runs
+``jax.device_put`` on a background thread into a bounded buffer so the
+next batch's host→HBM transfer overlaps the current batch's compute —
+double buffering, the standard input-pipeline recipe.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Iterable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def iterate_batches(
+    frame,
+    columns: Optional[Sequence[str]] = None,
+    batch_size: int = 256,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = False,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield ``{col: array[batch, ...]}`` minibatches from a frame's dense
+    columns (host-side)."""
+    if columns is None:
+        columns = [c.name for c in frame.schema.device_columns]
+    else:
+        columns = list(columns)
+    if not columns:
+        raise ValueError(
+            "iterate_batches: no columns to batch (frame has no dense "
+            "device columns, or an empty selection was passed)"
+        )
+    cols = {c: np.asarray(frame.column_values(c)) for c in columns}
+    n = len(next(iter(cols.values())))
+    order = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(order)
+    stop = n - (n % batch_size) if drop_remainder else n
+    for lo in range(0, stop, batch_size):
+        idx = order[lo : lo + batch_size]
+        yield {c: v[idx] for c, v in cols.items()}
+
+
+_SENTINEL = object()
+
+
+def prefetch_to_device(
+    batches: Iterable,
+    size: int = 2,
+    sharding=None,
+) -> Iterator:
+    """Wrap a batch iterator with background ``jax.device_put``.
+
+    A worker thread stages up to ``size`` batches in HBM ahead of the
+    consumer (``sharding`` optionally places them on a mesh), so transfer
+    overlaps compute. Exceptions from the source iterator propagate to the
+    consumer at the point of ``next()``.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
+        return jax.device_put(batch)
+
+    def enqueue(item) -> bool:
+        # bounded put that aborts when the consumer is gone, so an
+        # abandoned iterator can't pin the worker (and its staged HBM
+        # buffers) forever
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for batch in batches:
+                if stop.is_set() or not enqueue(put(batch)):
+                    return
+        except Exception as e:  # propagate into the consumer thread
+            enqueue(e)
+            return
+        enqueue(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True, name="tfs-prefetch")
+    t.start()
+
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        # consumer finished or bailed early: release the worker and drop
+        # any staged batches
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
