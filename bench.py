@@ -139,6 +139,34 @@ def _bench_convert(n_rows: int = 1_000_000):
     return convert_s, convertback_s
 
 
+def _bench_aggregate(n_rows: int = 1_000_000, n_groups: int = 512):
+    """Keyed aggregate wall-clock over the segment fast path (pallas
+    one-hot MXU kernel on TPU, XLA segment scatter elsewhere)."""
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(0)
+    frame = tfs.frame_from_arrays(
+        {
+            "k": rng.integers(0, n_groups, n_rows),
+            "v": rng.standard_normal(n_rows).astype(np.float32),
+        },
+        num_blocks=1,
+    )
+    with tfs.with_graph():
+        v_input = tfs.block(frame, "v", tf_name="v_input")
+        fetch = tfs.reduce_sum(v_input, axis=0, name="v")
+        program = tfs.compile_program(fetch, frame, reduce_mode="blocks")
+
+    def run_once():
+        return tfs.aggregate(program, frame.group_by("k"))
+
+    run_once().blocks()  # warmup/compile
+    t0 = time.perf_counter()
+    out = run_once()
+    out.blocks()
+    return time.perf_counter() - t0
+
+
 def _bench_reduce_blocks(n_rows: int = 1_000_000):
     """reduce_blocks wall-clock (BASELINE config 2 analogue)."""
     import tensorframes_tpu as tfs
@@ -167,6 +195,7 @@ def main():
     logreg_rps = _bench_map_blocks_logreg()
     add3_rps = _bench_add3()
     reduce_s = _bench_reduce_blocks()
+    aggregate_s = _bench_aggregate()
     # full-scale Inception on the real chip; CPU fallback shrinks widths so
     # the harness stays runnable anywhere
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -191,6 +220,7 @@ def main():
     print(f"# convertback_1M_int_cells_s={convertback_s:.4f}")
     print(f"# add3_map_blocks_rows_per_sec={add3_rps:.0f}")
     print(f"# reduce_blocks_1M_wall_s={reduce_s:.4f}")
+    print(f"# aggregate_1M_512groups_wall_s={aggregate_s:.4f}")
     print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")
     print(f"# inception_v3_map_blocks_rows_per_sec={inception_rps:.0f}")
     print(

@@ -31,6 +31,7 @@ Shape discovery parity:
 from __future__ import annotations
 
 import math
+import threading
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -91,13 +92,20 @@ class TensorFrame:
         self._blocks = blocks
         self._pending = pending
         self.schema = schema
+        # serializes first materialization: concurrent consumers (e.g. the
+        # prefetch loader's worker + the main thread) force the pending
+        # computation exactly once (≙ the reference's thread-safety is
+        # Spark's task model; here it's the frame's own contract)
+        self._force_lock = threading.Lock()
 
     # -- materialization ----------------------------------------------------
     def blocks(self) -> List[Block]:
-        """Force and cache the frame's blocks."""
+        """Force and cache the frame's blocks (thread-safe, exactly once)."""
         if self._blocks is None:
-            self._blocks = self._pending()
-            self._pending = None
+            with self._force_lock:
+                if self._blocks is None:
+                    self._blocks = self._pending()
+                    self._pending = None
         return self._blocks
 
     @property
@@ -683,10 +691,35 @@ def append_shape(frame: TensorFrame, col: str, shape) -> TensorFrame:
     return TensorFrame(None, frame.schema.replace(new_info), pending=compute)
 
 
-def explain(frame: TensorFrame) -> str:
+def explain(frame: TensorFrame, detailed: bool = False) -> str:
     """Schema rendering with tensor metadata (≙ ``OperationsInterface.explain``,
-    DebugRowOps.scala:535-552)."""
-    return frame.schema.explain()
+    DebugRowOps.scala:535-552). With ``detailed=True`` adds the physical
+    layout — block row counts, storage kinds, device placement
+    (≙ ``explainDetailed``, ExperimentalOperations.scala:26-37) —
+    materializing the frame if needed."""
+    base = frame.schema.explain()
+    if not detailed:
+        return base
+    lines = [base, ""]
+    state = "materialized" if frame.is_materialized else "lazy (forcing)"
+    blocks = frame.blocks()
+    lines.append(
+        f"layout: {len(blocks)} block(s), {frame.num_rows} row(s), "
+        f"{'sharded over ' + str(dict(frame.mesh.shape)) if frame.is_sharded else 'host-resident'}"
+        f" [{state}]"
+    )
+    for i, b in enumerate(blocks):
+        kinds = []
+        for name in frame.schema.names:
+            v = b[name]
+            if isinstance(v, list):
+                kinds.append(f"{name}: list")
+            elif isinstance(v, np.ndarray):
+                kinds.append(f"{name}: np{list(v.shape)}")
+            else:
+                kinds.append(f"{name}: device{list(getattr(v, 'shape', []))}")
+        lines.append(f"  block {i}: {_block_num_rows(b)} rows  ({', '.join(kinds)})")
+    return "\n".join(lines)
 
 
 def print_schema(frame: TensorFrame) -> None:

@@ -139,3 +139,51 @@ def test_rich_frame_verb_methods():
     )
     agg = g.group_by("k").aggregate(lambda v_input: {"v": v_input.sum(0)})
     assert {r["k"]: r["v"] for r in agg.collect()} == {1: 3.0, 2: 3.0}
+
+
+def test_concurrent_materialization_runs_once():
+    """Threads forcing the same lazy frame at the same instant run the
+    pending computation exactly once (guards the _force_lock)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from tensorframes_tpu.frame import TensorFrame
+    from tensorframes_tpu.schema import ColumnInfo, Schema
+    from tensorframes_tpu.shape import Shape, Unknown
+
+    calls = []
+    n_threads = 4
+    barrier = threading.Barrier(n_threads)
+
+    def pending():
+        calls.append(1)
+        time.sleep(0.2)  # hold the critical section so racers overlap
+        return [{"x": np.arange(5.0)}]
+
+    schema = Schema([ColumnInfo("x", dt.float64, Shape((Unknown,)))])
+    frame = TensorFrame(None, schema, pending=pending)
+    results = [None] * n_threads
+
+    def force(i):
+        barrier.wait()  # all threads hit blocks() together
+        results[i] = frame.blocks()
+
+    ts = [threading.Thread(target=force, args=(i,)) for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(calls) == 1, f"pending ran {len(calls)} times"
+    assert all(r is results[0] for r in results)
+
+
+def test_explain_detailed_layout():
+    import numpy as np
+
+    df = tfs.frame_from_arrays({"x": np.arange(10.0)}, num_blocks=3)
+    text = tfs.explain(df, detailed=True)
+    assert "3 block(s), 10 row(s)" in text
+    assert "block 0" in text and "block 2" in text
+    assert "host-resident" in text
