@@ -46,6 +46,9 @@ class Config:
     # Per-verb timing metrics collection (upgrade over the reference's
     # log4j-only observability, SURVEY.md §5-tracing).
     collect_metrics: bool = _env_bool("TFTPU_METRICS", True)
+    # map_blocks keeps this many extra blocks in flight so transfer and
+    # compute overlap (0 = fully synchronous per block).
+    map_pipeline_depth: int = _env_int("TFTPU_MAP_PIPELINE_DEPTH", 2)
 
 
 _config = Config()

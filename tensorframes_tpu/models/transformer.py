@@ -45,6 +45,10 @@ class TransformerConfig:
     # axis — ppermute ring vs all-to-all head exchange; see ops/attention.py)
     attention_impl: str = "dense"
     causal: bool = False
+    # rematerialize each layer in the backward pass (jax.checkpoint):
+    # trades recompute FLOPs for activation HBM — the standard lever for
+    # long sequences / deep stacks
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -217,9 +221,17 @@ def forward(
     x = params["embed"]["tok"][tokens].astype(cfg.dtype)
     s = tokens.shape[1]
     x = x + params["embed"]["pos"][:s].astype(cfg.dtype)
-    for p in params["layers"]:
+
+    def layer(x, p):
         x = x + _attention(cfg, p["attn"], _layer_norm(x, **p["ln1"]), mask, mesh)
-        x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+        return x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+
+    if cfg.remat:
+        # recompute each layer's activations in the backward pass instead
+        # of keeping them resident: O(1) layers of activation HBM
+        layer = jax.checkpoint(layer)
+    for p in params["layers"]:
+        x = layer(x, p)
     return _layer_norm(x, **params["final_ln"])
 
 

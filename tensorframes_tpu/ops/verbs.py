@@ -206,20 +206,24 @@ def map_blocks(
     sharded = frame.is_sharded
 
     def compute() -> List[Block]:
+        from collections import deque
+
         out_blocks: List[Block] = []
         t0 = time.perf_counter()
         n_total = 0
-        for b in parent.blocks():
-            n = _block_num_rows(b)
-            n_total += n
-            feeds = gather_feeds(b, input_names, program)
-            # sharded frames keep outputs in HBM; XLA propagates the input
-            # sharding through the program (SPMD), so chained maps run
-            # entirely on-device with no host round-trip.
-            outs = compiled.run_block(feeds, to_numpy=not sharded)
+        # pipelined execution: keep up to `depth` blocks in flight so block
+        # k+1's host→HBM transfer and compute overlap block k's device→host
+        # readback (jax dispatch is async; only np.asarray synchronizes).
+        # Sharded frames skip the window — their outputs stay in HBM.
+        depth = 0 if sharded else max(0, get_config().map_pipeline_depth)
+        in_flight: deque = deque()
+
+        def finish(b: Block, n: int, outs) -> None:
+            if not sharded:
+                outs = {k: np.asarray(v) for k, v in outs.items()}
             if trim:
                 out_blocks.append({i.name: outs[i.name] for i in out_infos})
-                continue
+                return
             for o in program.outputs:
                 got = outs[o.name].shape[0] if outs[o.name].ndim > 0 else None
                 if got != n:
@@ -232,6 +236,17 @@ def map_blocks(
             nb: Block = {i.name: outs[i.name] for i in out_infos}
             nb.update(b)
             out_blocks.append(nb)
+
+        for b in parent.blocks():
+            n = _block_num_rows(b)
+            n_total += n
+            feeds = gather_feeds(b, input_names, program)
+            outs = compiled.run_block(feeds, to_numpy=False)
+            in_flight.append((b, n, outs))
+            if len(in_flight) > depth:
+                finish(*in_flight.popleft())
+        while in_flight:
+            finish(*in_flight.popleft())
         # device-resident outputs return before the TPU finishes (async
         # dispatch); label those spans distinctly so report() rows/s is
         # honest — only the host path measures completed execution
@@ -472,7 +487,31 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
 # aggregate (keyed)
 # ---------------------------------------------------------------------------
 
+from functools import partial
+
 from .segment import segment_sum as _segment_sum
+
+
+@partial(jax.jit, static_argnames=("ops", "num_groups"))
+def _seg_fast(vals, sids, ops, num_groups):
+    """Vectorized keyed reduction over key-sorted rows: one XLA program for
+    all fetches. ``ops`` is a static tuple of (output_name, reducer_op)."""
+    outs = {}
+    for out_name, op in ops:
+        v = vals[out_name]
+        if op == "reduce_mean":
+            s = _segment_sum(v, sids, num_segments=num_groups)
+            c = jax.ops.segment_sum(
+                jnp.ones(v.shape[:1], v.dtype), sids, num_segments=num_groups
+            )
+            c = c.reshape((-1,) + (1,) * (v.ndim - 1))
+            # cast back: fetch dtype == input dtype by contract
+            # (the generic path does this via _reducer's astype)
+            outs[out_name] = (s / c).astype(v.dtype)
+        else:
+            outs[out_name] = _SEGMENT_OPS[op](v, sids, num_segments=num_groups)
+    return outs
+
 
 _SEGMENT_OPS = {
     # sum rides the custom pallas one-hot MXU kernel on TPU (segment.py);
@@ -560,29 +599,12 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
     out_cols: Dict[str, np.ndarray] = {}
     if seg_info is not None and all(op in _SEGMENT_OPS or op == "reduce_mean" for _, op, _ in seg_info):
         # -- segment fast path ----------------------------------------------
-        sids = jnp.asarray(seg_ids)
-
-        def seg_prog(vals: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-            outs = {}
-            for out_name, op, _ in seg_info:
-                v = vals[out_name]
-                if op == "reduce_mean":
-                    s = _segment_sum(v, sids, num_segments=num_groups)
-                    c = jax.ops.segment_sum(
-                        jnp.ones(v.shape[:1], v.dtype), sids, num_segments=num_groups
-                    )
-                    c = c.reshape((-1,) + (1,) * (v.ndim - 1))
-                    # cast back: fetch dtype == input dtype by contract
-                    # (the generic path does this via _reducer's astype)
-                    outs[out_name] = (s / c).astype(v.dtype)
-                else:
-                    outs[out_name] = _SEGMENT_OPS[op](
-                        v, sids, num_segments=num_groups
-                    )
-            return outs
-
+        # the jitted program is module-level with (ops, num_groups) static
+        # and sids a real argument, so repeated aggregates with the same
+        # shapes reuse one XLA executable (no giant captured constants)
+        ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
         sorted_vals = {x: jnp.asarray(val_cols[x][order]) for x in out_names}
-        res = jax.jit(seg_prog)(sorted_vals)
+        res = _seg_fast(sorted_vals, jnp.asarray(seg_ids), ops_key, num_groups)
         out_cols = {x: np.asarray(res[x]) for x in out_names}
     else:
         # -- generic chunked-compaction path --------------------------------

@@ -156,3 +156,23 @@ def test_bert_embed_row_program_via_map_rows():
     # bf16 activations: different-but-valid fusion orders between the
     # vmapped verb path and the block path round differently
     np.testing.assert_allclose(emb, want, rtol=3e-2, atol=3e-2)
+
+
+def test_remat_matches_no_remat_gradients():
+    """jax.checkpoint rematerialization changes memory, not math."""
+    import jax
+    import jax.numpy as jnp
+
+    base = tr.tiny(dtype=jnp.float32)
+    remat = tr.tiny(dtype=jnp.float32, remat=True)
+    params = tr.init_params(base, seed=0)
+    tokens, targets = tr.synthetic_batch(base, 4, 8, seed=0)
+
+    def loss_of(cfg):
+        return lambda p: tr.loss_fn(cfg, p, jnp.asarray(tokens), jnp.asarray(targets))
+
+    l0, g0 = jax.value_and_grad(loss_of(base))(params)
+    l1, g1 = jax.value_and_grad(loss_of(remat))(params)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -379,3 +379,24 @@ def test_aggregate_mean_preserves_int_dtype():
     assert res.schema["x"].dtype is dt.int64
     vals = res.column_values("x")
     assert vals.dtype == np.int64
+
+
+def test_map_blocks_pipeline_depths_agree():
+    """The pipelined in-flight window produces identical results to the
+    synchronous path at every depth."""
+    import numpy as np
+
+    from tensorframes_tpu.config import configure, get_config
+
+    df = tfs.frame_from_arrays({"x": np.arange(1000.0)}, num_blocks=7)
+    old = get_config().map_pipeline_depth
+    results = {}
+    try:
+        for depth in (0, 1, 3):
+            configure(map_pipeline_depth=depth)
+            out = tfs.map_blocks(lambda x: {"y": x * 2.0 + 1.0}, df)
+            results[depth] = out.column_values("y")
+    finally:
+        configure(map_pipeline_depth=old)
+    for depth, got in results.items():
+        np.testing.assert_array_equal(got, np.arange(1000.0) * 2.0 + 1.0)
