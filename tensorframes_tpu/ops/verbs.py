@@ -487,30 +487,40 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
 # aggregate (keyed)
 # ---------------------------------------------------------------------------
 
-from functools import partial
+from functools import lru_cache
 
 from .segment import segment_sum as _segment_sum
 
 
-@partial(jax.jit, static_argnames=("ops", "num_groups"))
-def _seg_fast(vals, sids, ops, num_groups):
-    """Vectorized keyed reduction over key-sorted rows: one XLA program for
-    all fetches. ``ops`` is a static tuple of (output_name, reducer_op)."""
-    outs = {}
-    for out_name, op in ops:
-        v = vals[out_name]
-        if op == "reduce_mean":
-            s = _segment_sum(v, sids, num_segments=num_groups)
-            c = jax.ops.segment_sum(
-                jnp.ones(v.shape[:1], v.dtype), sids, num_segments=num_groups
-            )
-            c = c.reshape((-1,) + (1,) * (v.ndim - 1))
-            # cast back: fetch dtype == input dtype by contract
-            # (the generic path does this via _reducer's astype)
-            outs[out_name] = (s / c).astype(v.dtype)
-        else:
-            outs[out_name] = _SEGMENT_OPS[op](v, sids, num_segments=num_groups)
-    return outs
+@lru_cache(maxsize=32)
+def _seg_fast_for(ops, num_groups):
+    """Jitted keyed reduction over key-sorted rows: one XLA program for all
+    fetches. ``ops`` is a tuple of (output_name, reducer_op). The LRU keeps
+    repeated aggregates on one executable while bounding retained programs
+    when group counts vary per batch (evicted entries free their XLA
+    executables)."""
+
+    @jax.jit
+    def fn(vals, sids):
+        outs = {}
+        for out_name, op in ops:
+            v = vals[out_name]
+            if op == "reduce_mean":
+                s = _segment_sum(v, sids, num_segments=num_groups)
+                c = jax.ops.segment_sum(
+                    jnp.ones(v.shape[:1], v.dtype), sids, num_segments=num_groups
+                )
+                c = c.reshape((-1,) + (1,) * (v.ndim - 1))
+                # cast back: fetch dtype == input dtype by contract
+                # (the generic path does this via _reducer's astype)
+                outs[out_name] = (s / c).astype(v.dtype)
+            else:
+                outs[out_name] = _SEGMENT_OPS[op](
+                    v, sids, num_segments=num_groups
+                )
+        return outs
+
+    return fn
 
 
 _SEGMENT_OPS = {
@@ -604,7 +614,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         # shapes reuse one XLA executable (no giant captured constants)
         ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
         sorted_vals = {x: jnp.asarray(val_cols[x][order]) for x in out_names}
-        res = _seg_fast(sorted_vals, jnp.asarray(seg_ids), ops_key, num_groups)
+        res = _seg_fast_for(ops_key, num_groups)(sorted_vals, jnp.asarray(seg_ids))
         out_cols = {x: np.asarray(res[x]) for x in out_names}
     else:
         # -- generic chunked-compaction path --------------------------------

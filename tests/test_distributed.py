@@ -1,0 +1,90 @@
+"""Multi-process distributed backend test: two real OS processes, each
+owning one CPU device, coordinate through ``init_distributed``
+(jax.distributed) and run a psum across process boundaries.
+
+This is the test the reference never had (SURVEY §4: "no multi-node test
+infrastructure anywhere in the repo" — distribution was tested by
+partition count only). Here the control plane (coordinator service) and
+the collective path are exercised across actual process boundaries — the
+single-host analogue of multi-host DCN.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from tensorframes_tpu.parallel import init_distributed, is_multiprocess, process_index
+
+init_distributed(
+    coordinator_address={coord!r},
+    num_processes=2,
+    process_id=int(sys.argv[1]),
+)
+assert is_multiprocess(), f"process_count={{jax.process_count()}}"
+assert process_index() == int(sys.argv[1])
+assert len(jax.devices()) == 2, jax.devices()  # both processes' devices visible
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp",))
+# each process contributes its own shard; the jitted sum crosses the
+# process boundary through the collective
+arr = jax.make_array_from_callback(
+    (2,), NamedSharding(mesh, P("dp")),
+    lambda idx: jnp.asarray([float(process_index()) + 1.0]),
+)
+total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
+assert float(total) == 3.0, float(total)  # 1.0 (proc 0) + 2.0 (proc 1)
+print(f"proc {{sys.argv[1]}} OK total={{float(total)}}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_psum(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    coord = f"localhost:{_free_port()}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo, coord=coord))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=110)
+            outs.append(out)
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+            assert f"proc {i} OK total=3.0" in out, out[-2000:]
+    finally:
+        # a hung coordinator rendezvous must not orphan workers into CI
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()

@@ -40,3 +40,21 @@ def test_unsupported_rejected():
         dt.from_numpy(np.complex128)
     with pytest.raises(dt.UnsupportedTypeError):
         dt.by_name("float128")
+
+
+def test_bfloat16_column_end_to_end():
+    """bf16 (the TPU-native compute dtype) rides frames and verbs."""
+    import ml_dtypes
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+
+    x = np.arange(16, dtype=ml_dtypes.bfloat16)
+    df = tfs.frame_from_arrays({"x": x}, num_blocks=2)
+    assert df.schema["x"].dtype.name == "bfloat16"
+    out = df.map_blocks(lambda x: {"y": x * 2})
+    y = out.column_values("y")
+    assert y.dtype == ml_dtypes.bfloat16
+    assert y.astype(np.float32).tolist() == (np.arange(16) * 2.0).tolist()
+    s = df.reduce_blocks(lambda x_input: {"x": x_input.sum(0)})
+    assert float(s) == float(np.arange(16).sum())

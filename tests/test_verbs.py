@@ -400,3 +400,15 @@ def test_map_blocks_pipeline_depths_agree():
         configure(map_pipeline_depth=old)
     for depth, got in results.items():
         np.testing.assert_array_equal(got, np.arange(1000.0) * 2.0 + 1.0)
+
+
+def test_aggregate_string_keys():
+    """groupBy on a host string column (≙ Catalyst groupBy on strings —
+    keys never touch the device; values aggregate on it)."""
+    fr = tfs.frame_from_rows(
+        [{"k": ["a", "b", "a", "c", "b"][i], "v": float(i)} for i in range(5)]
+    )
+    agg = fr.group_by("k").aggregate(lambda v_input: {"v": v_input.sum(0)})
+    assert {r["k"]: r["v"] for r in agg.collect()} == {
+        "a": 2.0, "b": 5.0, "c": 3.0
+    }
