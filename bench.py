@@ -119,6 +119,28 @@ def _bench_bert_embed(n_rows: int = 1024, seq: int = 128, iters: int = 3,
     return _time_rows_per_sec(run_once, n_rows, iters)
 
 
+def _bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
+                     head_dim: int = 128, iters: int = 3):
+    """Long-context attention throughput (tokens/sec) for the flash
+    (pallas on TPU, blockwise fallback elsewhere) kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops import attention as att
+
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((batch, heads, seq, head_dim)), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+    fn = jax.jit(lambda q, k, v: att.flash_attention(q, k, v, causal=True))
+
+    def run_once():
+        _sync(fn(q, k, v))
+
+    return _time_rows_per_sec(run_once, batch * seq, iters)
+
+
 def _bench_convert(n_rows: int = 1_000_000):
     """Row→columnar convert + back (re-enabled equivalents of the
     reference's disabled µbenches, ConvertPerformanceSuite/
@@ -209,6 +231,8 @@ def main():
         iters=3 if on_tpu else 1,
         full_scale=on_tpu,
     )
+    attn_seq = 4096 if on_tpu else 512
+    attn_tps = _bench_attention(seq=attn_seq, iters=3 if on_tpu else 1)
 
     from tensorframes_tpu import native
 
@@ -226,6 +250,7 @@ def main():
     print(
         f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"
     )
+    print(f"# flash_attention_{attn_seq}seq_tokens_per_sec={attn_tps:.0f}")
 
     baseline = None
     # the published baseline is full-scale-on-TPU; a CPU fallback run uses a

@@ -81,6 +81,7 @@ from .ops.verbs import (  # noqa: E402,F401
     reduce_rows,
 )
 from .checkpoint import Checkpointer  # noqa: E402,F401
+from .training import run_resumable  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from .utils import profiling  # noqa: E402,F401
 
@@ -110,6 +111,7 @@ __all__ = [
     "explain",
     # aux subsystems
     "Checkpointer",
+    "run_resumable",
     "profiling",
     "io",
     # dsl / placeholder helpers

@@ -155,13 +155,17 @@ class Checkpointer:
         arrays = {}
         manifest = []
         for i, (keypath, leaf) in enumerate(flat):
-            arr = np.ascontiguousarray(np.asarray(leaf))
+            arr = np.asarray(leaf)
+            # record shape BEFORE ascontiguousarray: it promotes 0-d
+            # scalars to shape (1,), which must not leak into the manifest
+            shape = list(arr.shape)
+            arr = np.ascontiguousarray(arr)
             arrays[f"a{i}"] = arr.reshape(-1).view(np.uint8)  # zero-copy view
             manifest.append(
                 {
                     "key": jax.tree_util.keystr(keypath),
                     "dtype": str(arr.dtype),
-                    "shape": list(arr.shape),
+                    "shape": shape,
                 }
             )
         np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)

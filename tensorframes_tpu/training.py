@@ -1,0 +1,82 @@
+"""Resumable training driver — failure detection / elastic recovery.
+
+The reference has none of this (SURVEY.md §5: "Failure detection /
+elastic recovery: none in-repo; entirely delegated to Spark task
+retry/lineage"). On TPU the failure model is different: preemption kills
+the whole single-controller program, and recovery means *restart from the
+latest checkpoint* — so the recovery primitive is a checkpoint-integrated
+training loop, not per-task retry.
+
+``run_resumable`` wraps a jitted step function with periodic
+checkpointing (Checkpointer) and resume-on-restart: a relaunched process
+calls it with the same arguments and continues from the last saved step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+from .checkpoint import Checkpointer
+from .utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def run_resumable(
+    step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+    init_state: Any,
+    checkpointer: Checkpointer,
+    batches: Iterable,
+    num_steps: int,
+    save_every: int = 100,
+    on_step: Optional[Callable[[int, Any], None]] = None,
+) -> Tuple[Any, int]:
+    """Run up to ``num_steps`` of ``state, metrics = step_fn(state, batch)``,
+    checkpointing every ``save_every`` steps and resuming from the latest
+    checkpoint if one exists.
+
+    ``init_state`` doubles as the restore template (same pytree structure).
+    ``batches`` is consumed from the beginning on every (re)start; steps
+    already completed per the checkpoint are skipped so the data order
+    stays deterministic across preemptions. Returns (final_state,
+    steps_run_in_this_process).
+    """
+    start_step = 0
+    state = init_state
+    latest = checkpointer.latest_step()
+    if latest is not None:
+        state = checkpointer.restore(step=latest, like=init_state)
+        start_step = latest
+        logger.info("run_resumable: resuming from step %d", start_step)
+
+    ran = 0
+    step = start_step
+    it = iter(batches)
+    # skip batches consumed before the preemption (deterministic replay)
+    for _ in range(start_step):
+        next(it, None)
+    try:
+        while step < num_steps:
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            state, metrics = step_fn(state, batch)
+            step += 1
+            ran += 1
+            if on_step is not None:
+                on_step(step, metrics)
+            if save_every and step % save_every == 0:
+                checkpointer.save(step, state)
+    except BaseException:
+        # best-effort barrier checkpoint on the way down (preemption
+        # SIGTERM arrives as an exception in most launchers)
+        try:
+            checkpointer.save(step, state)
+            logger.warning("run_resumable: saved emergency checkpoint @ %d", step)
+        except Exception:  # pragma: no cover
+            logger.exception("run_resumable: emergency checkpoint failed")
+        raise
+    if save_every and step % save_every != 0 and ran:
+        checkpointer.save(step, state)
+    return state, ran

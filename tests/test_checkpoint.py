@@ -118,3 +118,15 @@ def test_restore_sniffs_format_across_backends(tmp_path):
         like={"w": jnp.zeros((4,), jnp.float32)}
     )
     np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(4))
+
+
+def test_npz_zero_dim_leaf_roundtrip(tmp_path):
+    """0-d scalars keep their rank (np.ascontiguousarray promotes 0-d to
+    1-d — regression guard for the manifest shape)."""
+    ckpt = Checkpointer(str(tmp_path / "run"), backend="npz")
+    ckpt.save(1, {"step": jnp.asarray(7, jnp.int32), "w": jnp.ones((2,))})
+    got = ckpt.restore(
+        like={"step": jnp.asarray(0, jnp.int32), "w": jnp.zeros((2,))}
+    )
+    assert np.shape(got["step"]) == ()
+    assert int(got["step"]) == 7

@@ -412,3 +412,21 @@ def test_aggregate_string_keys():
     assert {r["k"]: r["v"] for r in agg.collect()} == {
         "a": 2.0, "b": 5.0, "c": 3.0
     }
+
+
+def test_aggregate_multiple_keys():
+    """Composite group keys (≙ groupBy(col1, col2))."""
+    import numpy as np
+
+    fr = tfs.frame_from_arrays(
+        {
+            "a": np.array([1, 1, 1, 2, 2]),
+            "b": np.array([0, 0, 1, 0, 1]),
+            "v": np.array([1.0, 2.0, 4.0, 8.0, 16.0]),
+        }
+    )
+    agg = fr.group_by("a", "b").aggregate(
+        lambda v_input: {"v": v_input.sum(0)}
+    )
+    got = {(r["a"], r["b"]): r["v"] for r in agg.collect()}
+    assert got == {(1, 0): 3.0, (1, 1): 4.0, (2, 0): 8.0, (2, 1): 16.0}
