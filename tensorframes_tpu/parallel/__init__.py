@@ -1,4 +1,9 @@
-from .distributed import init_distributed, is_multiprocess, process_index
+from .distributed import (
+    frame_from_process_local,
+    init_distributed,
+    is_multiprocess,
+    process_index,
+)
 from .mesh import BATCH_AXIS, batch_sharding, device_count, make_mesh, replicated
 from .pipeline import make_pp_train_step, pipeline_apply
 
@@ -10,6 +15,7 @@ __all__ = [
     "device_count",
     "init_distributed",
     "is_multiprocess",
+    "frame_from_process_local",
     "make_mesh",
     "process_index",
     "replicated",

@@ -71,3 +71,57 @@ def is_multiprocess() -> bool:
 
 def process_index() -> int:
     return jax.process_index()
+
+
+def frame_from_process_local(data, mesh=None, axis: Optional[str] = None):
+    """Build a GLOBAL sharded frame from each process's local rows.
+
+    ≙ a Spark DataFrame whose partitions live on different executors: every
+    process passes its own ``{column: local_array}`` (equal schemas; row
+    counts may differ only as sharding allows) and receives a frame whose
+    device columns are global ``jax.Array``s spanning all hosts
+    (``jax.make_array_from_process_local_data``). Verbs on the result run
+    SPMD across processes — reductions cross host boundaries through the
+    compiler's collectives (ICI within a slice, DCN across slices), not a
+    driver round-trip. All processes must call every verb in lockstep
+    (single-controller SPMD), the multi-host contract jax programs share.
+    """
+    import numpy as np
+
+    from .. import dtypes as dt
+    from ..config import get_config
+    from ..frame import TensorFrame
+    from ..schema import ColumnInfo, Schema
+    from ..shape import Shape
+    from .mesh import batch_sharding, make_mesh
+
+    mesh = mesh or make_mesh()
+    axis = axis or get_config().batch_axis
+    block = {}
+    infos = []
+    n_local = None
+    for name, v in data.items():
+        v = np.asarray(v)
+        dtype = dt.from_numpy(v.dtype)
+        if not dtype.device:
+            raise TypeError(
+                f"Column {name!r}: host-only {dtype.name} columns cannot "
+                "span processes"
+            )
+        if n_local is None:
+            n_local = len(v)
+        elif len(v) != n_local:
+            raise ValueError(
+                f"Column {name!r} has {len(v)} rows, expected {n_local}"
+            )
+        arr = jax.make_array_from_process_local_data(
+            batch_sharding(mesh, v.ndim, axis), v
+        )
+        block[name] = arr
+        infos.append(
+            ColumnInfo(name, dtype, Shape(arr.shape).with_leading_unknown())
+        )
+    frame = TensorFrame([block], Schema(infos))
+    frame._mesh = mesh
+    frame._axis = axis
+    return frame

@@ -48,13 +48,24 @@ def run_resumable(
         state = checkpointer.restore(step=latest, like=init_state)
         start_step = latest
         logger.info("run_resumable: resuming from step %d", start_step)
+    if start_step >= num_steps:
+        return state, 0  # already complete: don't touch the iterator
 
     ran = 0
     step = start_step
     it = iter(batches)
-    # skip batches consumed before the preemption (deterministic replay)
-    for _ in range(start_step):
-        next(it, None)
+    # skip batches consumed before the preemption (deterministic replay);
+    # a dataset shorter than the checkpointed progress is a caller bug and
+    # must not be silently absorbed
+    for i in range(start_step):
+        try:
+            next(it)
+        except StopIteration:
+            raise ValueError(
+                f"run_resumable: dataset exhausted at batch {i} while "
+                f"skipping to checkpointed step {start_step} — the batches "
+                "passed on resume are shorter than the original run's"
+            ) from None
     try:
         while step < num_steps:
             try:

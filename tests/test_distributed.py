@@ -35,6 +35,7 @@ assert process_index() == int(sys.argv[1])
 assert len(jax.devices()) == 2, jax.devices()  # both processes' devices visible
 
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 mesh = Mesh(jax.devices(), ("dp",))
@@ -46,7 +47,20 @@ arr = jax.make_array_from_callback(
 )
 total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
 assert float(total) == 3.0, float(total)  # 1.0 (proc 0) + 2.0 (proc 1)
-print(f"proc {{sys.argv[1]}} OK total={{float(total)}}", flush=True)
+
+# frame-level: each process contributes local rows; verbs run SPMD and the
+# reduction crosses the host boundary (≙ partitions on two executors)
+import tensorframes_tpu as tfs
+from tensorframes_tpu.parallel import frame_from_process_local
+
+pid = process_index()
+local = np.asarray([10.0 * pid + 1.0, 10.0 * pid + 2.0])  # p0: 1,2; p1: 11,12
+frame = frame_from_process_local({{"v": local}}, mesh=mesh, axis="dp")
+assert frame.num_rows == 4  # global rows, both processes' shards
+doubled = tfs.map_blocks(lambda v: {{"w": v * 2.0}}, frame)
+s = tfs.reduce_blocks(lambda w_input: {{"w": w_input.sum(axis=0)}}, doubled)
+assert float(s) == 2.0 * (1 + 2 + 11 + 12), float(s)
+print(f"proc {{sys.argv[1]}} OK total={{float(total)}} frame_sum={{float(s)}}", flush=True)
 """
 
 

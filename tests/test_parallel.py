@@ -154,3 +154,27 @@ def test_precompiled_aggregate_keeps_segment_fast_path():
     res = tfs.aggregate(prog, df.group_by("key")).collect()
     for k in range(3):
         assert res[k]["v"] == sum(float(i) for i in range(24) if i % 3 == k)
+
+
+def test_frame_from_process_local_single_process():
+    """Single-process degenerate case: local rows == global rows; schema
+    validation matches frame_from_arrays' error contract."""
+    import numpy as np
+    import pytest
+
+    from tensorframes_tpu.parallel import frame_from_process_local, make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    fr = frame_from_process_local(
+        {"v": np.arange(16, dtype=np.float32)}, mesh=mesh, axis="dp"
+    )
+    assert fr.num_rows == 16 and fr.is_sharded
+    s = tfs.reduce_blocks(lambda v_input: {"v": v_input.sum(axis=0)}, fr)
+    assert float(s) == float(np.arange(16).sum())
+    with pytest.raises(ValueError, match="expected 16"):
+        frame_from_process_local(
+            {"a": np.arange(16, dtype=np.float32), "b": np.arange(8.0)},
+            mesh=mesh,
+        )
+    with pytest.raises(TypeError, match="host-only"):
+        frame_from_process_local({"s": np.array(["x", "y"])}, mesh=mesh)

@@ -82,3 +82,25 @@ def test_on_step_callback_sees_metrics(tmp_path):
         on_step=lambda s, m: seen.append((s, float(m["w_sum"]))),
     )
     assert [s for s, _ in seen] == [1, 2, 3]
+
+
+def test_resume_with_short_dataset_raises(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "run"), backend="npz")
+    run_resumable(_make_step(), _init(), ckpt, _batches(10), num_steps=6, save_every=3)
+    assert ckpt.latest_step() == 6
+    with pytest.raises(ValueError, match="shorter than the original"):
+        run_resumable(_make_step(), _init(), ckpt, _batches(4), num_steps=10, save_every=3)
+
+
+def test_already_complete_run_is_noop(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "run"), backend="npz")
+    run_resumable(_make_step(), _init(), ckpt, _batches(5), num_steps=5, save_every=5)
+
+    def exploding():
+        raise AssertionError("iterator must not be consumed")
+        yield  # pragma: no cover
+
+    state, ran = run_resumable(
+        _make_step(), _init(), ckpt, exploding(), num_steps=5, save_every=5
+    )
+    assert ran == 0 and int(state["count"]) == 5
