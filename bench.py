@@ -138,7 +138,24 @@ def _bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
     def run_once():
         _sync(fn(q, k, v))
 
-    return _time_rows_per_sec(run_once, batch * seq, iters)
+    try:
+        return _time_rows_per_sec(run_once, batch * seq, iters)
+    except Exception as e:
+        # pallas flash failed at kernel-compile time (Mosaic/toolchain);
+        # measure the pure-XLA blockwise kernel instead of dying — marked
+        # so the recorded number is never mistaken for the flash kernel's
+        print(
+            f"# flash_attention_fallback=blockwise ({type(e).__name__}: "
+            f"{str(e).splitlines()[0][:120]})"
+        )
+        fb = jax.jit(
+            lambda q, k, v: att.blockwise_attention(q, k, v, causal=True)
+        )
+
+        def run_fb():
+            _sync(fb(q, k, v))
+
+        return _time_rows_per_sec(run_fb, batch * seq, iters)
 
 
 def _bench_convert(n_rows: int = 1_000_000):
@@ -210,33 +227,57 @@ def _bench_reduce_blocks(n_rows: int = 1_000_000):
     return time.perf_counter() - t0
 
 
+def _try(name: str, fn, default=None):
+    """Run one sub-bench; a failure becomes a comment line, never a crash —
+    the driver must always receive the single JSON line."""
+    try:
+        return fn()
+    except Exception as e:
+        print(f"# {name}=ERROR {type(e).__name__}: {str(e).splitlines()[0][:200]}")
+        return default
+
+
 def main():
     import jax
 
     n_chips = max(1, len(jax.devices()))
-    logreg_rps = _bench_map_blocks_logreg()
-    add3_rps = _bench_add3()
-    reduce_s = _bench_reduce_blocks()
-    aggregate_s = _bench_aggregate()
+    logreg_rps = _try("logreg", _bench_map_blocks_logreg, 0.0)
+    add3_rps = _try("add3", _bench_add3, 0.0)
+    reduce_s = _try("reduce_blocks", _bench_reduce_blocks, float("nan"))
+    aggregate_s = _try("aggregate", _bench_aggregate, float("nan"))
     # full-scale Inception on the real chip; CPU fallback shrinks widths so
     # the harness stays runnable anywhere
     on_tpu = jax.devices()[0].platform != "cpu"
-    inception_rps = _bench_inception(
-        n_rows=512 if on_tpu else 16,
-        iters=4 if on_tpu else 1,
-        channel_scale=1.0 if on_tpu else 0.125,
+    inception_rps = _try(
+        "inception",
+        lambda: _bench_inception(
+            n_rows=512 if on_tpu else 16,
+            iters=4 if on_tpu else 1,
+            channel_scale=1.0 if on_tpu else 0.125,
+        ),
+        0.0,
     )
-    bert_rps = _bench_bert_embed(
-        n_rows=1024 if on_tpu else 32,
-        iters=3 if on_tpu else 1,
-        full_scale=on_tpu,
+    bert_rps = _try(
+        "bert",
+        lambda: _bench_bert_embed(
+            n_rows=1024 if on_tpu else 32,
+            iters=3 if on_tpu else 1,
+            full_scale=on_tpu,
+        ),
+        0.0,
     )
     attn_seq = 4096 if on_tpu else 512
-    attn_tps = _bench_attention(seq=attn_seq, iters=3 if on_tpu else 1)
+    attn_tps = _try(
+        "attention",
+        lambda: _bench_attention(seq=attn_seq, iters=3 if on_tpu else 1),
+        0.0,
+    )
 
     from tensorframes_tpu import native
 
-    convert_s, convertback_s = _bench_convert()
+    convert_s, convertback_s = _try(
+        "convert", _bench_convert, (float("nan"), float("nan"))
+    )
 
     print(f"# chips={n_chips} devices={jax.devices()}")
     print(f"# native_marshalling={'on' if native.available() else 'off'}")

@@ -1,0 +1,207 @@
+"""VGG-16 image classification (batch-inference workload).
+
+The reference's image-inference snippet is literally a VGG-16 sketch
+(tensorframes_snippets/read_image.py: slim ``vgg.vgg_16`` + central-crop
+preprocessing + softmax + top-5), run through map_blocks-style scoring.
+This is that workload re-designed TPU-first:
+
+* NHWC layout end-to-end; channel widths are already 64..512 — native
+  MXU lane sizes.
+* bfloat16 weights/activations with float32 accumulation
+  (``preferred_element_type``), the standard TPU inference recipe.
+* the two 4096-wide FC layers are expressed as matmuls over the flattened
+  7×7×512 feature map — pure MXU work (slim expresses them as 7×7 VALID
+  convs; same arithmetic, but the matmul form lets XLA pick the tiling).
+* preprocessing (resize-shorter-side + central crop + mean subtraction,
+  ≙ ``vgg_preprocessing.preprocess_image``) is a jittable device-side
+  function over a batch, not a per-image host loop.
+* scoring returns softmax scores plus top-k indices/values
+  (≙ read_image.py's ``top_predictions`` fetches), plugged into
+  ``map_blocks`` as a plain function program over an image column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+# channels-last ImageNet RGB means (vgg_preprocessing's _R_MEAN/_G_MEAN/_B_MEAN)
+_RGB_MEAN = (123.68, 116.779, 103.939)
+
+# the 13 conv layers of configuration "D" (Simonyan & Zisserman 2014):
+# (#convs in the block, out_channels) per pooling stage
+_VGG16_PLAN = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGConfig:
+    num_classes: int = 1000
+    image_size: int = 224
+    channel_scale: float = 1.0
+    fc_width: int = 4096
+    compute_dtype: str = "bfloat16"  # activations/weights; accum is f32
+
+    def ch(self, c: int) -> int:
+        """Scaled channel count, lane-aligned to a multiple of 8."""
+        return max(8, int(round(c * self.channel_scale / 8.0)) * 8)
+
+    @property
+    def fc(self) -> int:
+        return max(8, int(round(self.fc_width * self.channel_scale / 8.0)) * 8)
+
+
+def vgg_16(**kw) -> VGGConfig:
+    return VGGConfig(**kw)
+
+
+def tiny(**kw) -> VGGConfig:
+    kw.setdefault("num_classes", 10)
+    kw.setdefault("image_size", 32)
+    kw.setdefault("channel_scale", 0.125)
+    kw.setdefault("compute_dtype", "float32")
+    return VGGConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+class _KeyGen:
+    def __init__(self, seed: int):
+        self._key = jax.random.PRNGKey(seed)
+
+    def __call__(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+def _conv_init(key, cin: int, cout: int, dtype) -> Dict:
+    w = jax.random.normal(key, (3, 3, cin, cout), jnp.float32)
+    w = (w * np.sqrt(2.0 / (9 * cin))).astype(dtype)
+    return {"w": w, "b": jnp.zeros((cout,), dtype)}
+
+
+def _dense_init(key, cin: int, cout: int, dtype) -> Dict:
+    w = jax.random.normal(key, (cin, cout), jnp.float32)
+    w = (w * np.sqrt(2.0 / cin)).astype(dtype)
+    return {"w": w, "b": jnp.zeros((cout,), dtype)}
+
+
+def init_params(cfg: VGGConfig, seed: int = 0) -> Dict:
+    """Parameter tree keyed ``conv{stage}_{i}`` / ``fc6|fc7|fc8`` — the
+    slim checkpoint naming, so pretrained-weight import is a rename."""
+    kg = _KeyGen(seed)
+    dt_ = jnp.dtype(cfg.compute_dtype)
+    p: Dict = {}
+    cin = 3
+    for stage, (reps, width) in enumerate(_VGG16_PLAN, start=1):
+        cout = cfg.ch(width)
+        for i in range(1, reps + 1):
+            p[f"conv{stage}_{i}"] = _conv_init(kg(), cin, cout, dt_)
+            cin = cout
+    # feature map after 5 pools: (size/32)² × ch(512)
+    feat = (cfg.image_size // 32) ** 2 * cin
+    p["fc6"] = _dense_init(kg(), feat, cfg.fc, dt_)
+    p["fc7"] = _dense_init(kg(), cfg.fc, cfg.fc, dt_)
+    p["fc8"] = _dense_init(kg(), cfg.fc, cfg.num_classes, dt_)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _conv_relu(p, x):
+    y = lax.conv_general_dilated(
+        x, p["w"], (1, 1), "SAME", dimension_numbers=_DN,
+        preferred_element_type=jnp.float32,
+    )
+    return jax.nn.relu(y + p["b"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _maxpool2(x):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def forward(cfg: VGGConfig, params: Dict, images: jnp.ndarray) -> jnp.ndarray:
+    """images [n, S, S, 3] float → logits [n, num_classes] float32."""
+    x = images.astype(jnp.dtype(cfg.compute_dtype))
+    for stage, (reps, _) in enumerate(_VGG16_PLAN, start=1):
+        for i in range(1, reps + 1):
+            x = _conv_relu(params[f"conv{stage}_{i}"], x)
+        x = _maxpool2(x)
+    x = x.reshape(x.shape[0], -1)  # [n, (S/32)²·512]
+    for name in ("fc6", "fc7"):
+        p = params[name]
+        x = jax.nn.relu(
+            jnp.dot(x, p["w"], preferred_element_type=jnp.float32)
+            + p["b"].astype(jnp.float32)
+        ).astype(x.dtype)
+    p = params["fc8"]
+    return (
+        jnp.dot(x, p["w"], preferred_element_type=jnp.float32)
+        + p["b"].astype(jnp.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Preprocessing (≙ vgg_preprocessing.preprocess_image, inference branch)
+# ---------------------------------------------------------------------------
+
+def preprocess(images: jnp.ndarray, out_size: int) -> jnp.ndarray:
+    """Central-crop a [n, H, W, 3] batch to ``out_size`` and subtract the
+    ImageNet channel means. Jittable; runs on device as part of the same
+    XLA program as the network when composed in a scoring function."""
+    n, h, w, _ = images.shape
+    if h < out_size or w < out_size:
+        raise ValueError(
+            f"preprocess: input {h}x{w} smaller than crop {out_size}"
+        )
+    top = (h - out_size) // 2
+    left = (w - out_size) // 2
+    x = lax.slice(
+        images, (0, top, left, 0), (n, top + out_size, left + out_size, 3)
+    )
+    mean = jnp.asarray(_RGB_MEAN, images.dtype)
+    return x - mean
+
+
+# ---------------------------------------------------------------------------
+# map_blocks scoring program (≙ read_image.py's output_nodes:
+# probabilities + top-k indices + top-k values)
+# ---------------------------------------------------------------------------
+
+def scoring_program(cfg: VGGConfig, params: Dict, top_k: int = 5):
+    """Image block [n, S, S, 3] → {"scores", "top_idx", "top_val"}."""
+    k = min(top_k, cfg.num_classes)
+
+    def program(images):
+        logits = forward(cfg, params, images)
+        scores = jax.nn.softmax(logits, axis=-1).astype(jnp.float32)
+        top_val, top_idx = lax.top_k(scores, k)
+        return {
+            "scores": scores,
+            "top_idx": top_idx.astype(jnp.int32),
+            "top_val": top_val,
+        }
+
+    return program
+
+
+def synthetic_images(cfg: VGGConfig, n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    s = cfg.image_size
+    return rng.standard_normal((n, s, s, 3), dtype=np.float32)
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))

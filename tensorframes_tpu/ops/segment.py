@@ -17,7 +17,6 @@ also exercised on CPU in interpreter mode by the tests.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -36,7 +35,7 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _seg_kernel(num_segments: int, seg_ref, val_ref, out_ref):
+def _seg_kernel(seg_ref, val_ref, out_ref):
     """One grid step: out[s, d] += Σ_{rows r in tile with seg(r)=s} val[r, d].
 
     seg_ref: [tile, 1] int32 (padded rows carry num_segments → no match);
@@ -92,7 +91,7 @@ def segment_sum_pallas(
 
     grid = (n_pad // _TILE_ROWS,)
     out = pl.pallas_call(
-        functools.partial(_seg_kernel, num_segments),
+        _seg_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((_TILE_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -105,9 +104,32 @@ def segment_sum_pallas(
     return out[:num_segments, :d]
 
 
+# Mosaic kill-switch: a TPU-toolchain kernel-compile failure at runtime
+# must degrade to XLA's scatter path, never take down `aggregate`
+# (verbs.py catches the failure, calls disable_pallas(), and retries).
+_pallas_disabled = False
+
+
+def disable_pallas(reason: str = "") -> None:
+    global _pallas_disabled
+    if not _pallas_disabled:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "disabling pallas segment kernel (falling back to XLA "
+            "segment_sum)%s", f": {reason}" if reason else ""
+        )
+    _pallas_disabled = True
+
+
+def pallas_enabled() -> bool:
+    return not _pallas_disabled
+
+
 def _pallas_eligible(values: jnp.ndarray, num_segments: int) -> bool:
     return (
-        values.ndim == 2
+        not _pallas_disabled
+        and values.ndim == 2
         and values.dtype in (jnp.float32, jnp.bfloat16)
         and 0 < num_segments <= _MAX_PALLAS_SEGMENTS
         and jax.default_backend() == "tpu"

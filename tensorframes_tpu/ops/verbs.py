@@ -614,7 +614,20 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         # shapes reuse one XLA executable (no giant captured constants)
         ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
         sorted_vals = {x: jnp.asarray(val_cols[x][order]) for x in out_names}
-        res = _seg_fast_for(ops_key, num_groups)(sorted_vals, jnp.asarray(seg_ids))
+        sids = jnp.asarray(seg_ids)
+        try:
+            res = _seg_fast_for(ops_key, num_groups)(sorted_vals, sids)
+        except Exception as e:
+            from . import segment as _segment
+
+            # only a pallas kernel-compile failure (Mosaic) justifies the
+            # process-wide fallback; transient TPU errors (OOM etc.) and
+            # genuine program bugs re-raise untouched
+            if not _segment.pallas_enabled() or "Mosaic" not in str(e):
+                raise
+            _segment.disable_pallas(f"{type(e).__name__} in aggregate")
+            _seg_fast_for.cache_clear()  # drop executables traced w/ pallas
+            res = _seg_fast_for(ops_key, num_groups)(sorted_vals, sids)
         out_cols = {x: np.asarray(res[x]) for x in out_names}
     else:
         # -- generic chunked-compaction path --------------------------------

@@ -85,3 +85,76 @@ def test_aggregate_fast_path_still_correct():
     vs = np.asarray(frame.column_values("v"))
     for k in np.unique(ks):
         assert got[int(k)] == pytest.approx(float(vs[ks == k].sum()), rel=1e-5)
+
+
+def test_disable_pallas_kill_switch():
+    """A runtime Mosaic failure flips the kill-switch; segment_sum keeps
+    working through XLA's scatter path."""
+    was = segment._pallas_disabled
+    try:
+        segment.disable_pallas("test")
+        assert not segment.pallas_enabled()
+        values = jnp.asarray(
+            np.random.default_rng(0).standard_normal((32, 4)), jnp.float32
+        )
+        seg_ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, 5, 32), jnp.int32
+        )
+        got = segment.segment_sum(values, seg_ids, 5)
+        np.testing.assert_allclose(
+            np.asarray(got), _ref(values, seg_ids, 5), rtol=1e-6
+        )
+    finally:
+        segment._pallas_disabled = was
+
+
+def test_aggregate_retries_after_kernel_compile_failure(monkeypatch):
+    """aggregate's segment fast path must survive a first-call kernel
+    failure: disable pallas, re-trace, return the right answer."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.ops import verbs
+
+    real = verbs._seg_fast_for.__wrapped__
+    calls = {"n": 0}
+
+    def flaky(ops, num_groups):
+        fn = real(ops, num_groups)
+
+        def wrapper(vals, sids):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("Mosaic failed to compile TPU kernel")
+            return fn(vals, sids)
+
+        return wrapper
+
+    from functools import lru_cache
+
+    monkeypatch.setattr(verbs, "_seg_fast_for", lru_cache(maxsize=8)(flaky))
+    was = segment._pallas_disabled
+    try:
+        segment._pallas_disabled = False
+        rng = np.random.default_rng(3)
+        n = 100
+        frame = tfs.frame_from_arrays(
+            {
+                "k": rng.integers(0, 4, n),
+                "v": rng.standard_normal(n).astype(np.float32),
+            }
+        )
+        with tfs.with_graph():
+            v_input = tfs.block(frame, "v", tf_name="v_input")
+            agg = tfs.aggregate(
+                tfs.reduce_sum(v_input, axis=0, name="v"), frame.group_by("k")
+            )
+        got = {r["k"]: r["v"] for r in agg.collect()}
+        assert calls["n"] == 2  # failed once, retried once
+        assert not segment.pallas_enabled()
+        ks = np.asarray(frame.column_values("k"))
+        vs = np.asarray(frame.column_values("v"))
+        for k in np.unique(ks):
+            assert got[int(k)] == pytest.approx(
+                float(vs[ks == k].sum()), rel=1e-5
+            )
+    finally:
+        segment._pallas_disabled = was

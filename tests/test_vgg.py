@@ -1,0 +1,69 @@
+"""VGG-16 family tests (≙ the reference's read_image.py VGG snippet):
+forward shapes, preprocessing, top-k scoring through map_blocks."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.models import vgg
+
+
+def test_tiny_forward_shape():
+    cfg = vgg.tiny()
+    params = vgg.init_params(cfg, seed=0)
+    images = vgg.synthetic_images(cfg, 2, seed=0)
+    logits = vgg.forward(cfg, params, images)
+    assert logits.shape == (2, cfg.num_classes)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_preprocess_central_crop_and_mean():
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0, 255, (2, 40, 48, 3)).astype(np.float32)
+    out = np.asarray(vgg.preprocess(images, 32))
+    assert out.shape == (2, 32, 32, 3)
+    # crop is central: offsets (4, 8); mean subtracted per channel
+    expect = images[:, 4:36, 8:40, :] - np.asarray(vgg._RGB_MEAN, np.float32)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    with pytest.raises(ValueError, match="smaller than crop"):
+        vgg.preprocess(images, 64)
+
+
+def test_scoring_via_map_blocks_topk():
+    cfg = vgg.tiny()
+    params = vgg.init_params(cfg, seed=0)
+    images = vgg.synthetic_images(cfg, 6, seed=1)
+    df = tfs.frame_from_arrays({"images": images}, num_blocks=2)
+    prog = vgg.scoring_program(cfg, params, top_k=3)
+    out = tfs.map_blocks(lambda images: prog(images), df)
+    scores = np.stack([r["scores"] for r in out.collect()])
+    assert scores.shape == (6, cfg.num_classes)
+    np.testing.assert_allclose(scores.sum(axis=1), 1.0, atol=1e-4)
+    idx = np.stack([r["top_idx"] for r in out.collect()])
+    val = np.stack([r["top_val"] for r in out.collect()])
+    assert idx.shape == (6, 3) and val.shape == (6, 3)
+    # top-1 of top_k equals argmax of the full score vector, values sorted
+    np.testing.assert_array_equal(idx[:, 0], scores.argmax(axis=1))
+    assert (np.diff(val, axis=1) <= 1e-7).all()
+
+
+def test_param_naming_and_count():
+    cfg = vgg.tiny()
+    params = vgg.init_params(cfg, seed=0)
+    # slim checkpoint naming: conv{stage}_{i}, fc6/fc7/fc8
+    for name in ("conv1_1", "conv3_3", "conv5_3", "fc6", "fc7", "fc8"):
+        assert name in params
+    assert len([k for k in params if k.startswith("conv")]) == 13
+    assert vgg.param_count(params) > 10_000
+    # full-scale config matches the paper's channel plan
+    full = vgg.vgg_16()
+    assert full.ch(512) == 512 and full.fc == 4096
+
+
+def test_batch_invariance():
+    cfg = vgg.tiny()
+    params = vgg.init_params(cfg, seed=2)
+    images = vgg.synthetic_images(cfg, 3, seed=3)
+    all_logits = np.asarray(vgg.forward(cfg, params, images))
+    one = np.asarray(vgg.forward(cfg, params, images[1:2]))
+    np.testing.assert_allclose(all_logits[1:2], one, rtol=2e-4, atol=2e-4)
