@@ -1,0 +1,200 @@
+"""On-device keyed aggregation for sharded frames.
+
+The host `aggregate` path (verbs.py) gathers rows to the host and
+lexsorts by key — fine single-host, but it is still the reference's
+driver-shaped plan (Catalyst shuffle ≙ host sort,
+DebugRowOps.scala:583). For sharded frames with integer keys this module
+replaces the shuffle entirely with the TPU-native plan:
+
+    per-shard dense segment reduction  →  one ICI collective
+
+Each shard scatter-reduces its local rows into a dense ``[K, ...]``
+bucket table (K = the mixed-radix span of the key ranges), then a single
+``psum``/``pmin``/``pmax`` over the batch axis merges the tables — a
+log-depth hardware collective instead of a host round-trip. Empty
+buckets are dropped afterwards using the (psum-merged) per-bucket
+counts. Multi-host works by construction: the collective crosses
+process boundaries through ICI/DCN, and only the tiny dense table is
+ever host-materialized.
+
+Eligibility: algebraic fetches (sum/min/max/mean), integer key columns,
+and a key span small enough that the dense table is cheap
+(``K <= 1<<20`` buckets and ``K × feature-elems <= 1<<24``). Anything
+else falls back to the host path. The dense-table trick is the same
+reformulation the pallas segment kernel uses (scatter → dense compute):
+on TPU, bounded dense work beats data-dependent shuffles.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel._shard_map import shard_map
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+_KEY_LIMIT = 1 << 20          # max dense bucket count
+_TABLE_ELEM_LIMIT = 1 << 24   # max K × per-row feature elements
+
+
+@lru_cache(maxsize=32)
+def _agg_fn(mesh, axis: str, ops_key, K: int, strides: Tuple[int, ...]):
+    """Jitted shard_map program: local dense segment-reduce + one
+    collective per output. ``ops_key`` is a tuple of (name, op, ndim);
+    inputs are the offset key columns (min already subtracted) and the
+    value columns, all sharded over ``axis``."""
+
+    def local(keys, vals):
+        ids = keys[0] * strides[0]
+        for k, s in zip(keys[1:], strides[1:]):
+            ids = ids + k * s
+        out = {}
+        count = jax.ops.segment_sum(
+            jnp.ones(ids.shape, jnp.int32), ids, num_segments=K
+        )
+        out["__count__"] = lax.psum(count, axis)
+        for name, op, _ in ops_key:
+            v = vals[name]
+            if op in ("reduce_sum", "reduce_mean"):
+                t = jax.ops.segment_sum(v, ids, num_segments=K)
+                out[name] = lax.psum(t, axis)
+            elif op == "reduce_min":
+                t = jax.ops.segment_min(v, ids, num_segments=K)
+                out[name] = lax.pmin(t, axis)
+            elif op == "reduce_max":
+                t = jax.ops.segment_max(v, ids, num_segments=K)
+                out[name] = lax.pmax(t, axis)
+            else:  # pragma: no cover - guarded by caller
+                raise ValueError(f"unsupported op {op}")
+        return out
+
+    n_keys = len(strides)
+    in_specs = (
+        tuple(P(axis) for _ in range(n_keys)),
+        {name: P(axis, *([None] * (ndim - 1))) for name, _, ndim in ops_key},
+    )
+    out_specs = {name: P() for name, _, _ in ops_key}
+    out_specs["__count__"] = P()
+    return jax.jit(
+        shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    )
+
+
+@jax.jit
+def _stacked_minmax(*cols):
+    """[n_cols, 2] (min, max) in one device computation / one transfer."""
+    return jnp.stack(
+        [
+            jnp.stack([c.min().astype(jnp.int64), c.max().astype(jnp.int64)])
+            for c in cols
+        ]
+    )
+
+
+def try_aggregate_device(
+    frame,
+    keys: Sequence[str],
+    seg_info,
+    out_names: Sequence[str],
+) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]]:
+    """Attempt the sharded dense-bucket plan. Returns
+    ``(key_cols, out_cols)`` with groups in lexicographic key order (the
+    host path's ordering), or None when ineligible."""
+    if not frame.is_sharded or frame.num_rows == 0:
+        return None
+    ops = {name: op for name, op, _ in seg_info}
+    if any(ops[x] not in ("reduce_sum", "reduce_min", "reduce_max", "reduce_mean")
+           for x in out_names):
+        return None
+    for k in keys:
+        info = frame.schema[k]
+        if not info.is_device or not np.issubdtype(info.dtype.np_dtype, np.integer):
+            return None
+    blocks = frame.blocks()
+    main, tail = blocks[0], (blocks[1] if len(blocks) > 1 else None)
+    for x in out_names:
+        if isinstance(main[x], list):
+            return None
+    for k in keys:
+        if isinstance(main[k], list):
+            return None
+    main_rows = int(main[keys[0]].shape[0])
+    if main_rows == 0:
+        return None  # everything in the tail → host path is already optimal
+
+    # -- key ranges → mixed-radix bucket ids --------------------------------
+    mm = np.asarray(jax.device_get(_stacked_minmax(*(main[k] for k in keys))))
+    mins, ranges = [], []
+    for i, k in enumerate(keys):
+        lo, hi = int(mm[i, 0]), int(mm[i, 1])
+        if tail is not None and len(tail[k]):
+            t = np.asarray(tail[k])
+            lo, hi = min(lo, int(t.min())), max(hi, int(t.max()))
+        mins.append(lo)
+        ranges.append(int(hi - lo + 1))
+    # python ints: key spans near the int32/int64 limits must not wrap the
+    # product and sneak past the eligibility gate
+    K = math.prod(ranges)
+    feat = 0
+    for x in out_names:
+        cell = main[x].shape[1:]
+        feat = max(feat, int(np.prod(cell)) if cell else 1)
+    if K > _KEY_LIMIT or K * feat > _TABLE_ELEM_LIMIT:
+        logger.debug(
+            "device aggregate: key span %d (×%d feat) too large; host path",
+            K, feat,
+        )
+        return None
+    # keys[0] most significant → bucket order == lexicographic key order
+    strides = [1] * len(keys)
+    for i in range(len(keys) - 2, -1, -1):
+        strides[i] = strides[i + 1] * ranges[i + 1]
+
+    mesh = frame.mesh
+    axis = getattr(frame, "_axis", None) or "dp"
+    ops_key = tuple((x, ops[x], int(main[x].ndim)) for x in out_names)
+    fn = _agg_fn(mesh, axis, ops_key, K, tuple(strides))
+    keys_off = tuple(
+        (main[k] - mins[i]).astype(jnp.int32) for i, k in enumerate(keys)
+    )
+    res = fn(keys_off, {x: main[x] for x in out_names})
+    count = np.asarray(res["__count__"])
+    tables = {x: np.asarray(res[x]) for x in out_names}
+
+    # -- fold the host tail block in (≤ dp-1 rows) --------------------------
+    if tail is not None:
+        ids_t = np.zeros(len(tail[keys[0]]), np.int64)
+        for i, k in enumerate(keys):
+            ids_t += (np.asarray(tail[k]) - mins[i]) * strides[i]
+        np.add.at(count, ids_t, 1)
+        for x in out_names:
+            v = np.asarray(tail[x], dtype=tables[x].dtype)
+            if ops[x] in ("reduce_sum", "reduce_mean"):
+                np.add.at(tables[x], ids_t, v)
+            elif ops[x] == "reduce_min":
+                np.minimum.at(tables[x], ids_t, v)
+            else:
+                np.maximum.at(tables[x], ids_t, v)
+
+    sel = np.flatnonzero(count > 0)
+    out_cols: Dict[str, np.ndarray] = {}
+    for x in out_names:
+        t = tables[x][sel]
+        if ops[x] == "reduce_mean":
+            c = count[sel].reshape((-1,) + (1,) * (t.ndim - 1))
+            t = (t / c).astype(tables[x].dtype)
+        out_cols[x] = t
+    key_cols: Dict[str, np.ndarray] = {}
+    for i, k in enumerate(keys):
+        comp = (sel // strides[i]) % ranges[i] + mins[i]
+        key_cols[k] = comp.astype(frame.schema[k].dtype.np_dtype)
+    return key_cols, out_cols

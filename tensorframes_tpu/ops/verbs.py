@@ -557,6 +557,31 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
     validate_reduce_blocks(program, frame.schema)
     out_names = [o.name for o in program.outputs]
 
+    def _assemble(out_key_cols, out_cols, n_rows):
+        infos: List[ColumnInfo] = []
+        for k in keys:
+            infos.append(frame.schema[k].with_block_shape(
+                frame.schema[k].cell_shape.prepend(Unknown)
+            ))
+        for o in sorted(program.outputs, key=lambda s: s.name):
+            infos.append(ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown)))
+        block: Block = {}
+        block.update(out_key_cols)
+        for o in program.outputs:
+            block[o.name] = out_cols[o.name]
+        profiling.record("aggregate", time.perf_counter() - t0, n_rows)
+        return TensorFrame([block], Schema(infos))
+
+    # -- sharded fast path: per-shard dense segment reduce + one ICI
+    # collective (no host gather, no sort — see ops/device_agg.py) ----------
+    if seg_info is not None and frame.is_sharded:
+        from .device_agg import try_aggregate_device
+
+        dev = try_aggregate_device(frame, keys, seg_info, out_names)
+        if dev is not None:
+            key_cols_d, out_cols_d = dev
+            return _assemble(key_cols_d, out_cols_d, frame.num_rows)
+
     # -- gather rows to host, sort by key -----------------------------------
     key_cols = {k: frame.column_values(k) for k in keys}
     val_cols = {}
@@ -663,16 +688,4 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
 
     # -- assemble result frame: key cols + fetch cols -----------------------
     out_key_cols = {k: np.asarray(sorted_keys[k])[group_starts] for k in keys}
-    infos: List[ColumnInfo] = []
-    for k in keys:
-        infos.append(frame.schema[k].with_block_shape(
-            frame.schema[k].cell_shape.prepend(Unknown)
-        ))
-    for o in sorted(program.outputs, key=lambda s: s.name):
-        infos.append(ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown)))
-    block: Block = {}
-    block.update(out_key_cols)
-    for o in program.outputs:
-        block[o.name] = out_cols[o.name]
-    profiling.record("aggregate", time.perf_counter() - t0, n)
-    return TensorFrame([block], Schema(infos))
+    return _assemble(out_key_cols, out_cols, n)
