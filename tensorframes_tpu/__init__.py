@@ -83,6 +83,7 @@ from .ops.verbs import (  # noqa: E402,F401
 from .checkpoint import Checkpointer  # noqa: E402,F401
 from .training import run_resumable  # noqa: E402,F401
 from . import io  # noqa: E402,F401
+from .io import load_frame, save_frame  # noqa: E402,F401
 from .utils import profiling  # noqa: E402,F401
 
 __version__ = "0.1.0"
@@ -114,6 +115,8 @@ __all__ = [
     "run_resumable",
     "profiling",
     "io",
+    "save_frame",
+    "load_frame",
     # dsl / placeholder helpers
     "Node",
     "block",

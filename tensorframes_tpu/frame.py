@@ -285,6 +285,13 @@ class TensorFrame:
         self.blocks()
         return self
 
+    def save(self, path: str) -> "TensorFrame":
+        """Persist to ``path`` (see ``io.save_frame``); returns self."""
+        from .io import save_frame
+
+        save_frame(self, path)
+        return self
+
     # -- device placement ---------------------------------------------------
     @property
     def is_sharded(self) -> bool:

@@ -118,3 +118,150 @@ def prefetch_to_device(
                 q.get_nowait()
         except queue.Empty:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Frame persistence
+# ---------------------------------------------------------------------------
+#
+# The reference never persists frames itself — Spark's data sources own
+# storage. A standalone framework needs its own: a directory with a JSON
+# schema manifest, one compressed npz for the dense columns, and (only when
+# present) a pickle for host columns (strings / binaries / ragged cells).
+# Dense arrays are stored as raw bytes keyed c0, c1, … with the numpy
+# dtype/shape in the manifest: npz cannot reconstruct ml_dtypes (bfloat16
+# loads as void '|V2'), and npz keys must not collide with savez's own
+# parameter names (a column called "file" would) — same scheme as
+# checkpoint.py's npz backend.
+
+_MANIFEST = "frame.json"
+_DENSE = "columns.npz"
+_HOST = "host_columns.pkl"
+_FORMAT_VERSION = 1
+
+
+def save_frame(frame, path: str) -> None:
+    """Write a frame to ``path`` (a directory, created if needed).
+
+    Device columns are materialized to host numpy first; block structure
+    is not preserved (reload with any ``num_blocks``).
+    """
+    import json
+    import os
+    import pickle
+
+    # fail BEFORE touching the filesystem: a multi-host global array
+    # cannot be materialized by one process (and a partial directory
+    # would be worse than an error)
+    for b in frame.blocks():
+        for name, v in b.items():
+            if not getattr(v, "is_fully_addressable", True):
+                raise ValueError(
+                    f"save_frame: column {name!r} spans non-addressable "
+                    "devices (multi-host global array); gather per process "
+                    "or save process-local shards instead"
+                )
+
+    os.makedirs(path, exist_ok=True)
+    dense: Dict[str, np.ndarray] = {}
+    host: Dict[str, list] = {}
+    cols = []
+    for i, info in enumerate(frame.schema):
+        vals = [b[info.name] for b in frame.blocks()]
+        is_list = any(isinstance(v, list) for v in vals)
+        col = {
+            "name": info.name,
+            "dtype": info.dtype.name,
+            "block_shape": list(info.block_shape.dims),
+        }
+        if info.is_device and not is_list:
+            arr = np.concatenate([np.asarray(v) for v in vals], axis=0)
+            arr = np.ascontiguousarray(arr)
+            dense[f"c{i}"] = arr.reshape(-1).view(np.uint8)  # zero-copy
+            col["np_dtype"] = str(arr.dtype)
+            col["np_shape"] = list(arr.shape)
+        else:
+            flat: list = []
+            for v in vals:
+                flat.extend(list(v))
+            host[info.name] = flat
+        cols.append(col)
+    manifest = {
+        "format_version": _FORMAT_VERSION,
+        "num_rows": frame.num_rows,
+        "columns": cols,
+    }
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+    np.savez_compressed(os.path.join(path, _DENSE), **dense)
+    host_path = os.path.join(path, _HOST)
+    if host:
+        with open(host_path, "wb") as f:
+            pickle.dump(host, f)
+    elif os.path.exists(host_path):
+        os.remove(host_path)
+    logger.info(
+        "save_frame: %d rows, %d dense + %d host columns -> %s",
+        manifest["num_rows"], len(dense), len(host), path,
+    )
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; owns bfloat16/float8 dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def load_frame(path: str, num_blocks: Optional[int] = None):
+    """Load a frame written by :func:`save_frame`."""
+    import json
+    import os
+    import pickle
+
+    from . import dtypes as dt
+    from .frame import TensorFrame, _partition_bounds
+    from .schema import ColumnInfo, Schema
+    from .shape import Shape
+
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format_version", 0) > _FORMAT_VERSION:
+        raise ValueError(
+            f"frame at {path} has format_version "
+            f"{manifest['format_version']}; this build reads <= {_FORMAT_VERSION}"
+        )
+    raw = {}
+    npz = os.path.join(path, _DENSE)
+    if os.path.exists(npz):
+        with np.load(npz, allow_pickle=False) as z:
+            raw = {k: z[k] for k in z.files}
+    host = {}
+    pkl = os.path.join(path, _HOST)
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            host = pickle.load(f)
+
+    infos = []
+    data: Dict[str, object] = {}
+    for i, c in enumerate(manifest["columns"]):
+        infos.append(
+            ColumnInfo(c["name"], dt.by_name(c["dtype"]), Shape(c["block_shape"]))
+        )
+        if f"c{i}" in raw:  # dense: bytes → manifest dtype/shape
+            data[c["name"]] = (
+                raw[f"c{i}"].view(_np_dtype(c["np_dtype"])).reshape(c["np_shape"])
+            )
+        else:
+            data[c["name"]] = host[c["name"]]
+
+    n = manifest["num_rows"]
+    from .config import get_config
+
+    k = num_blocks or min(get_config().default_num_blocks, max(1, n))
+    blocks = []
+    for lo, hi in _partition_bounds(n, k):
+        blocks.append({name: v[lo:hi] for name, v in data.items()})
+    return TensorFrame(blocks, Schema(infos))
