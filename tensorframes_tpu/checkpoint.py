@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from .utils import get_logger
+from .utils.npz import decode_array, encode_array
 
 logger = get_logger(__name__)
 
@@ -146,40 +147,19 @@ class Checkpointer:
     # -- npz backend --------------------------------------------------------
 
     def _save_npz(self, path: str, state: Any) -> None:
-        # leaves are stored as raw bytes + (dtype, shape) in the manifest:
-        # numpy's npz loader cannot reconstruct ml_dtypes (bfloat16 etc.) —
-        # it silently returns void ('|V2') arrays — so round-tripping via
-        # bytes with the dtype recorded out-of-band is the portable form.
+        # leaves are stored as raw bytes + (dtype, shape) in the manifest
+        # (utils/npz.py): numpy's npz loader cannot reconstruct ml_dtypes.
         os.makedirs(path, exist_ok=True)
         flat, _ = jax.tree_util.tree_flatten_with_path(state)
         arrays = {}
         manifest = []
         for i, (keypath, leaf) in enumerate(flat):
-            arr = np.asarray(leaf)
-            # record shape BEFORE ascontiguousarray: it promotes 0-d
-            # scalars to shape (1,), which must not leak into the manifest
-            shape = list(arr.shape)
-            arr = np.ascontiguousarray(arr)
-            arrays[f"a{i}"] = arr.reshape(-1).view(np.uint8)  # zero-copy view
-            manifest.append(
-                {
-                    "key": jax.tree_util.keystr(keypath),
-                    "dtype": str(arr.dtype),
-                    "shape": shape,
-                }
-            )
+            arrays[f"a{i}"], entry = encode_array(leaf)
+            entry["key"] = jax.tree_util.keystr(keypath)
+            manifest.append(entry)
         np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f)
-
-    @staticmethod
-    def _np_dtype(name: str) -> np.dtype:
-        try:
-            return np.dtype(name)
-        except TypeError:
-            import ml_dtypes  # jax dependency; owns bfloat16/float8 dtypes
-
-            return np.dtype(getattr(ml_dtypes, name))
 
     def _restore_npz(self, path: str, like: Any) -> Any:
         with open(os.path.join(path, "manifest.json")) as f:
@@ -194,13 +174,7 @@ class Checkpointer:
                     # (native dtypes only); keep them restorable
                     leaves.append(raw)
                 else:
-                    # np.load returns fresh writable arrays; view+reshape is
-                    # copy-free and stays writable
-                    leaves.append(
-                        raw.view(self._np_dtype(entry["dtype"])).reshape(
-                            entry["shape"]
-                        )
-                    )
+                    leaves.append(decode_array(raw, entry))
         keys = manifest if legacy else [e["key"] for e in manifest]
         if like is None:
             # reconstruct as a flat {keystr: array} dict

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from .utils import get_logger
+from .utils.npz import decode_array, encode_array
 
 logger = get_logger(__name__)
 
@@ -149,6 +150,7 @@ def save_frame(frame, path: str) -> None:
     import json
     import os
     import pickle
+    import shutil
 
     # fail BEFORE touching the filesystem: a multi-host global array
     # cannot be materialized by one process (and a partial directory
@@ -162,7 +164,6 @@ def save_frame(frame, path: str) -> None:
                     "or save process-local shards instead"
                 )
 
-    os.makedirs(path, exist_ok=True)
     dense: Dict[str, np.ndarray] = {}
     host: Dict[str, list] = {}
     cols = []
@@ -176,10 +177,9 @@ def save_frame(frame, path: str) -> None:
         }
         if info.is_device and not is_list:
             arr = np.concatenate([np.asarray(v) for v in vals], axis=0)
-            arr = np.ascontiguousarray(arr)
-            dense[f"c{i}"] = arr.reshape(-1).view(np.uint8)  # zero-copy
-            col["np_dtype"] = str(arr.dtype)
-            col["np_shape"] = list(arr.shape)
+            dense[f"c{i}"], entry = encode_array(arr)
+            col["np_dtype"] = entry["dtype"]
+            col["np_shape"] = entry["shape"]
         else:
             flat: list = []
             for v in vals:
@@ -191,28 +191,26 @@ def save_frame(frame, path: str) -> None:
         "num_rows": frame.num_rows,
         "columns": cols,
     }
-    with open(os.path.join(path, _MANIFEST), "w") as f:
+    # atomic save: build the whole directory aside, then swap it in — a
+    # crash mid-write must never pair a new manifest with stale columns
+    tmp = f"{path}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
         json.dump(manifest, f, indent=2)
-    np.savez_compressed(os.path.join(path, _DENSE), **dense)
-    host_path = os.path.join(path, _HOST)
+    np.savez_compressed(os.path.join(tmp, _DENSE), **dense)
     if host:
-        with open(host_path, "wb") as f:
+        with open(os.path.join(tmp, _HOST), "wb") as f:
             pickle.dump(host, f)
-    elif os.path.exists(host_path):
-        os.remove(host_path)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
     logger.info(
         "save_frame: %d rows, %d dense + %d host columns -> %s",
         manifest["num_rows"], len(dense), len(host), path,
     )
 
 
-def _np_dtype(name: str) -> np.dtype:
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes  # jax dependency; owns bfloat16/float8 dtypes
-
-        return np.dtype(getattr(ml_dtypes, name))
 
 
 def load_frame(path: str, num_blocks: Optional[int] = None):
@@ -251,8 +249,8 @@ def load_frame(path: str, num_blocks: Optional[int] = None):
             ColumnInfo(c["name"], dt.by_name(c["dtype"]), Shape(c["block_shape"]))
         )
         if f"c{i}" in raw:  # dense: bytes → manifest dtype/shape
-            data[c["name"]] = (
-                raw[f"c{i}"].view(_np_dtype(c["np_dtype"])).reshape(c["np_shape"])
+            data[c["name"]] = decode_array(
+                raw[f"c{i}"], {"dtype": c["np_dtype"], "shape": c["np_shape"]}
             )
         else:
             data[c["name"]] = host[c["name"]]

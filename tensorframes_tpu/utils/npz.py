@@ -1,0 +1,44 @@
+"""Shared raw-bytes ndarray codec for npz storage.
+
+numpy's npz loader cannot reconstruct ml_dtypes (bfloat16 loads as void
+'|V2' arrays), so both the checkpoint backend (checkpoint.py) and frame
+persistence (io.py) store arrays as flat uint8 bytes with the dtype and
+shape recorded out-of-band in a JSON manifest. This module is the single
+copy of that encode/decode pair.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def np_dtype_from_name(name: str) -> np.dtype:
+    """Resolve a dtype string, falling back to ml_dtypes (bfloat16, float8…)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; owns bfloat16/float8 dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_array(arr) -> Tuple[np.ndarray, Dict]:
+    """array → (flat uint8 view, {"dtype", "shape"} manifest entry).
+
+    The byte view is zero-copy when the input is already contiguous. The
+    shape is recorded BEFORE ascontiguousarray, which promotes 0-d
+    scalars to shape (1,) — that promotion must not leak into the
+    manifest.
+    """
+    arr = np.asarray(arr)
+    shape = list(arr.shape)
+    arr = np.ascontiguousarray(arr)
+    return arr.reshape(-1).view(np.uint8), {"dtype": str(arr.dtype), "shape": shape}
+
+
+def decode_array(raw: np.ndarray, entry: Dict) -> np.ndarray:
+    """Inverse of :func:`encode_array`. np.load returns fresh writable
+    arrays, so the view+reshape stays copy-free and writable."""
+    return raw.view(np_dtype_from_name(entry["dtype"])).reshape(entry["shape"])

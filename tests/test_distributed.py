@@ -60,6 +60,21 @@ assert frame.num_rows == 4  # global rows, both processes' shards
 doubled = tfs.map_blocks(lambda v: {{"w": v * 2.0}}, frame)
 s = tfs.reduce_blocks(lambda w_input: {{"w": w_input.sum(axis=0)}}, doubled)
 assert float(s) == 2.0 * (1 + 2 + 11 + 12), float(s)
+# keyed aggregate across processes: the sharded dense-bucket plan
+# (ops/device_agg.py) reduces per shard and merges with one psum over the
+# process boundary; only the tiny replicated bucket table reaches numpy,
+# so the non-addressable input columns are never host-gathered
+kf = frame_from_process_local(
+    {{"k": np.asarray([pid, pid + 1]), "v": local}}, mesh=mesh, axis="dp"
+)
+with tfs.with_graph():
+    v_input = tfs.block(kf, "v", tf_name="v_input")
+    agg = tfs.aggregate(
+        tfs.reduce_sum(v_input, axis=0, name="v"), kf.group_by("k")
+    )
+got = {{r["k"]: r["v"] for r in agg.collect()}}
+# p0 contributes k=0:1.0, k=1:2.0; p1 contributes k=1:11.0, k=2:12.0
+assert got == {{0: 1.0, 1: 13.0, 2: 12.0}}, got
 print(f"proc {{sys.argv[1]}} OK total={{float(total)}} frame_sum={{float(s)}}", flush=True)
 """
 

@@ -190,3 +190,16 @@ def test_save_load_bf16_and_hazard_names(tmp_path):
     assert got.dtype == ml_dtypes.bfloat16
     np.testing.assert_array_equal(got.astype(np.float32), np.arange(8, dtype=np.float32))
     np.testing.assert_array_equal(back.column_values("file"), d["file"])
+
+
+def test_resave_over_existing(tmp_path):
+    """Atomic swap: re-saving a different frame over an existing directory
+    fully replaces it (no stale columns from the first save)."""
+    p = str(tmp_path / "fr")
+    tfs.frame_from_rows([{"s": "host", "x": 1.0}]).save(p)  # has host pickle
+    tfs.frame_from_arrays({"y": np.arange(6, dtype=np.float32)}).save(p)
+    back = tfs.load_frame(p)
+    assert back.columns == ["y"]
+    import os
+    assert not os.path.exists(os.path.join(p, "host_columns.pkl"))
+    np.testing.assert_array_equal(back.column_values("y"), np.arange(6, dtype=np.float32))
