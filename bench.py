@@ -158,6 +158,28 @@ def _bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
         return _time_rows_per_sec(run_fb, batch * seq, iters)
 
 
+def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
+                    iters: int = 3, full_scale: bool = True):
+    """Causal-LM decode throughput (generated tokens/sec): KV-cache
+    lax.scan decode as ONE jitted XLA program (models/generation.py)."""
+    import jax
+
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+
+    cfg = gen.gpt_small() if full_scale else gen.gpt_tiny()
+    prompt = min(prompt, cfg.max_seq_len - new - 1)
+    params = tr.init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    fn = jax.jit(lambda p: gen.generate(cfg, params, p, new))
+
+    def run_once():
+        _sync(fn(prompts))
+
+    return _time_rows_per_sec(run_once, batch * new, iters)
+
+
 def _bench_convert(n_rows: int = 1_000_000):
     """Row→columnar convert + back (re-enabled equivalents of the
     reference's disabled µbenches, ConvertPerformanceSuite/
@@ -272,6 +294,15 @@ def main():
         lambda: _bench_attention(seq=attn_seq, iters=3 if on_tpu else 1),
         0.0,
     )
+    gen_tps = _try(
+        "generate",
+        lambda: _bench_generate(
+            new=64 if on_tpu else 8,
+            iters=3 if on_tpu else 1,
+            full_scale=on_tpu,
+        ),
+        0.0,
+    )
 
     from tensorframes_tpu import native
 
@@ -292,6 +323,9 @@ def main():
         f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"
     )
     print(f"# flash_attention_{attn_seq}seq_tokens_per_sec={attn_tps:.0f}")
+    print(
+        f"# gpt_{'small' if on_tpu else 'tiny'}_decode_tokens_per_sec={gen_tps:.0f}"
+    )
 
     baseline = None
     # the published baseline is full-scale-on-TPU; a CPU fallback run uses a

@@ -192,19 +192,25 @@ def save_frame(frame, path: str) -> None:
         "columns": cols,
     }
     # atomic save: build the whole directory aside, then swap it in — a
-    # crash mid-write must never pair a new manifest with stale columns
+    # crash mid-write must never pair a new manifest with stale columns.
+    # normpath first: with a trailing slash the tmp dir would land INSIDE
+    # the target and be destroyed by the pre-swap rmtree.
+    path = os.path.normpath(path)
     tmp = f"{path}.tmp.{os.getpid()}"
     shutil.rmtree(tmp, ignore_errors=True)
-    os.makedirs(tmp)
-    with open(os.path.join(tmp, _MANIFEST), "w") as f:
-        json.dump(manifest, f, indent=2)
-    np.savez_compressed(os.path.join(tmp, _DENSE), **dense)
-    if host:
-        with open(os.path.join(tmp, _HOST), "wb") as f:
-            pickle.dump(host, f)
-    if os.path.isdir(path):
-        shutil.rmtree(path)
-    os.rename(tmp, path)
+    try:
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+        np.savez_compressed(os.path.join(tmp, _DENSE), **dense)
+        if host:
+            with open(os.path.join(tmp, _HOST), "wb") as f:
+                pickle.dump(host, f)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.rename(tmp, path)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
     logger.info(
         "save_frame: %d rows, %d dense + %d host columns -> %s",
         manifest["num_rows"], len(dense), len(host), path,

@@ -12,4 +12,4 @@ jax functions + params) that plug into ``map_blocks``/``map_rows`` like any
 user program, plus sharded training steps for the multi-chip path.
 """
 
-from . import inception, logreg, vgg  # noqa: F401
+from . import generation, inception, logreg, vgg  # noqa: F401

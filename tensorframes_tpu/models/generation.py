@@ -1,0 +1,220 @@
+"""Autoregressive decoding for the transformer family (causal LM).
+
+The reference is inference-only over frozen graphs; its model ceiling is
+one Session.run per block. A causal decoder is the workload that shows
+why the TPU formulation matters: generation is a ``lax.scan`` over
+single-token steps against a **static-shape KV cache**, so the whole
+decode loop is ONE compiled XLA program — no per-token dispatch, no
+dynamic shapes, cache updates as ``dynamic_update_slice`` in HBM.
+
+Reuses the transformer parameter tree (transformer.init_params) with
+``causal=True``; logits tie to the token embedding (no separate LM head).
+``generate_program`` plugs batched generation into ``map_blocks`` like
+any other program: a frame of prompt rows in, a column of continuations
+out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .transformer import TransformerConfig, _layer_norm, _mlp
+
+
+def gpt_tiny(**kw) -> TransformerConfig:
+    """A small causal config for tests/demos."""
+    kw.setdefault("vocab_size", 97)
+    kw.setdefault("hidden", 32)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("causal", True)
+    return TransformerConfig(**kw)
+
+
+def gpt_small(**kw) -> TransformerConfig:
+    """GPT-2-small-shaped causal config (bench workload)."""
+    kw.setdefault("vocab_size", 32_000)
+    kw.setdefault("hidden", 768)
+    kw.setdefault("num_heads", 12)
+    kw.setdefault("num_layers", 12)
+    kw.setdefault("max_seq_len", 1024)
+    kw.setdefault("causal", True)
+    return TransformerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: TransformerConfig, batch: int) -> Dict:
+    """Static-shape cache: k/v per layer, [b, heads, max_seq_len, head_dim]."""
+    shape = (cfg.num_layers, batch, cfg.num_heads, cfg.max_seq_len, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _forward_cached(
+    cfg: TransformerConfig,
+    params: Dict,
+    tokens: jnp.ndarray,   # [b, t] chunk (prompt prefill or one decode step)
+    cache: Dict,
+    offset,                # scalar: positions [offset, offset+t) being written
+) -> Tuple[jnp.ndarray, Dict]:
+    """Run a chunk through the decoder, reading/writing the KV cache.
+
+    Returns (hidden states [b, t, h], updated cache). Attention is dense
+    over the cache's static max_seq_len with a validity mask (j <= offset
+    + local position) — the standard static-shape decode formulation.
+    """
+    b, t = tokens.shape
+    h, nh, hd, S = cfg.hidden, cfg.num_heads, cfg.head_dim, cfg.max_seq_len
+    x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+    pos = offset + jnp.arange(t)
+    x = x + params["embed"]["pos"][pos].astype(cfg.dtype)
+
+    # mask [t, S]: chunk position i may attend cache slot j iff j <= offset+i
+    valid = jnp.arange(S)[None, :] <= (offset + jnp.arange(t))[:, None]
+    neg = jnp.asarray(-1e30, jnp.float32)
+
+    new_cache = {"k": cache["k"], "v": cache["v"]}
+    for li, p in enumerate(params["layers"]):
+        y = _layer_norm(x, **p["ln1"])
+        qkv = (y @ p["attn"]["qkv"].astype(y.dtype)).reshape(b, t, 3, nh, hd)
+        q = qkv[:, :, 0].transpose(0, 2, 1, 3)           # [b, nh, t, hd]
+        k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+        ck = lax.dynamic_update_slice(
+            new_cache["k"][li], k, (0, 0, offset, 0)
+        )
+        cv = lax.dynamic_update_slice(
+            new_cache["v"][li], v, (0, 0, offset, 0)
+        )
+        new_cache["k"] = new_cache["k"].at[li].set(ck)
+        new_cache["v"] = new_cache["v"].at[li].set(cv)
+        # attend q against the whole (static) cache, masked to valid slots
+        scores = jnp.einsum(
+            "bntd,bnsd->bnts", q, ck, preferred_element_type=jnp.float32
+        ) / np.sqrt(hd)
+        scores = jnp.where(valid[None, None], scores, neg)
+        w = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum("bnts,bnsd->bntd", w, cv)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, h)
+        x = x + ctx @ p["attn"]["out"].astype(x.dtype)
+        x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+    return _layer_norm(x, **params["final_ln"]), new_cache
+
+
+def _logits(cfg: TransformerConfig, params: Dict, hs: jnp.ndarray) -> jnp.ndarray:
+    """Weight-tied LM head: hidden [.., h] → logits [.., vocab] (f32)."""
+    emb = params["embed"]["tok"].astype(jnp.float32)
+    return hs.astype(jnp.float32) @ emb.T
+
+
+# ---------------------------------------------------------------------------
+# Generation
+# ---------------------------------------------------------------------------
+
+def generate(
+    cfg: TransformerConfig,
+    params: Dict,
+    prompts: jnp.ndarray,   # [b, prompt_len] int tokens
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations. Greedy when
+    ``temperature == 0``, else categorical sampling.
+
+    Prefill runs the prompt as one chunk; the decode loop is a
+    ``lax.scan`` of single-token cached steps — one XLA program end to
+    end. Returns [b, max_new_tokens] int32.
+    """
+    prompts = jnp.asarray(prompts)
+    b, plen = prompts.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if plen + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len({plen}) + max_new_tokens({max_new_tokens}) exceeds "
+            f"max_seq_len({cfg.max_seq_len})"
+        )
+    cache = init_kv_cache(cfg, b)
+    hs, cache = _forward_cached(cfg, params, prompts, cache, 0)
+    first = _pick(cfg, params, hs[:, -1], temperature, jax.random.PRNGKey(seed))
+
+    def step(carry, rng):
+        tok, pos, cache = carry
+        hs, cache = _forward_cached(cfg, params, tok[:, None], cache, pos)
+        nxt = _pick(cfg, params, hs[:, -1], temperature, rng)
+        return (nxt, pos + 1, cache), nxt
+
+    rngs = jax.random.split(jax.random.PRNGKey(seed + 1), max_new_tokens - 1)
+    (_, _, _), rest = lax.scan(step, (first, plen, cache), rngs)
+    return jnp.concatenate([first[:, None], rest.T], axis=1).astype(jnp.int32)
+
+
+def _pick(cfg, params, h_last, temperature, rng):
+    logits = _logits(cfg, params, h_last)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def generate_naive(
+    cfg: TransformerConfig,
+    params: Dict,
+    prompts: jnp.ndarray,
+    max_new_tokens: int,
+) -> jnp.ndarray:
+    """Cache-free greedy reference: re-run the full forward per token.
+
+    O(n²) per token — exists as the correctness oracle for the cached
+    path (tests assert identical outputs), mirroring the reference's
+    slow-but-obviously-correct execution stance (DebugRowOps.scala:277-280).
+    """
+    from . import transformer as tr
+
+    toks = jnp.asarray(prompts)
+    for _ in range(max_new_tokens):
+        hs = tr.forward(cfg, params, toks)
+        nxt = jnp.argmax(_logits(cfg, params, hs[:, -1]), axis=-1)
+        toks = jnp.concatenate([toks, nxt[:, None].astype(toks.dtype)], axis=1)
+    return toks[:, prompts.shape[1]:].astype(jnp.int32)
+
+
+def generate_program(
+    cfg: TransformerConfig,
+    params: Dict,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+):
+    """map_blocks program: prompt block [n, plen] → {"generated": [n, new]}.
+
+    When sampling (``temperature > 0``), a content-derived salt folds
+    into the seed so different blocks of a multi-block frame draw
+    different noise (a pure program cannot see its block index — identical
+    blocks still sample identically, which is at least deterministic)."""
+
+    def program(prompts):
+        salt = (
+            prompts.astype(jnp.uint32).sum() if temperature > 0.0 else 0
+        )
+        return {
+            "generated": generate(
+                cfg, params, prompts, max_new_tokens, temperature, seed + salt
+            )
+        }
+
+    return program

@@ -203,3 +203,12 @@ def test_resave_over_existing(tmp_path):
     import os
     assert not os.path.exists(os.path.join(p, "host_columns.pkl"))
     np.testing.assert_array_equal(back.column_values("y"), np.arange(6, dtype=np.float32))
+
+
+def test_save_trailing_slash(tmp_path):
+    p = str(tmp_path / "fr")
+    tfs.frame_from_arrays({"x": np.arange(4, dtype=np.float32)}).save(p)
+    # re-save through a trailing-slash alias must not destroy the frame
+    tfs.frame_from_arrays({"x": np.arange(5, dtype=np.float32)}).save(p + "/")
+    back = tfs.load_frame(p)
+    np.testing.assert_array_equal(back.column_values("x"), np.arange(5, dtype=np.float32))
