@@ -53,9 +53,14 @@ def gpt_small(**kw) -> TransformerConfig:
 # KV cache
 # ---------------------------------------------------------------------------
 
-def init_kv_cache(cfg: TransformerConfig, batch: int) -> Dict:
-    """Static-shape cache: k/v per layer, [b, heads, max_seq_len, head_dim]."""
-    shape = (cfg.num_layers, batch, cfg.num_heads, cfg.max_seq_len, cfg.head_dim)
+def init_kv_cache(cfg: TransformerConfig, batch: int, length: Optional[int] = None) -> Dict:
+    """Static-shape cache: k/v per layer, [b, heads, length, head_dim].
+
+    ``length`` defaults to ``cfg.max_seq_len`` but callers that know the
+    exact decode horizon (prompt + new tokens — ``generate`` does) should
+    pass it: cache HBM and per-step attention FLOPs scale with it."""
+    S = length or cfg.max_seq_len
+    shape = (cfg.num_layers, batch, cfg.num_heads, S, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -76,7 +81,8 @@ def _forward_cached(
     + local position) — the standard static-shape decode formulation.
     """
     b, t = tokens.shape
-    h, nh, hd, S = cfg.hidden, cfg.num_heads, cfg.head_dim, cfg.max_seq_len
+    h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
+    S = cache["k"].shape[3]  # cache horizon (≤ cfg.max_seq_len)
     x = params["embed"]["tok"][tokens].astype(cfg.dtype)
     pos = offset + jnp.arange(t)
     x = x + params["embed"]["pos"][pos].astype(cfg.dtype)
@@ -147,7 +153,9 @@ def generate(
             f"prompt_len({plen}) + max_new_tokens({max_new_tokens}) exceeds "
             f"max_seq_len({cfg.max_seq_len})"
         )
-    cache = init_kv_cache(cfg, b)
+    # size the cache to the actual decode horizon: HBM and per-step
+    # attention FLOPs scale with it, and both lengths are static here
+    cache = init_kv_cache(cfg, b, length=plen + max_new_tokens)
     hs, cache = _forward_cached(cfg, params, prompts, cache, 0)
     first = _pick(cfg, params, hs[:, -1], temperature, jax.random.PRNGKey(seed))
 

@@ -70,3 +70,28 @@ def test_image_inference_example():
     assert len(rows) == 4
     assert all(0 <= r["label"] < cfg.num_classes for r in rows)
     assert all(abs(float(np.sum(r["scores"])) - 1.0) < 1e-4 for r in rows)
+
+
+def test_text_generation_example():
+    from examples import text_generation as tg
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+
+    cfg = gen.gpt_tiny()
+    params = tr.init_params(cfg, seed=0)
+    prompts = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (4, 6)
+    ).astype(np.int32)
+    frame = tfs.frame_from_arrays(
+        {"p": prompts, "doc_id": np.arange(4)}, num_blocks=2
+    )
+    out = tg.generate_over_frame(frame, cfg, params, 5, prompt_col="p")
+    rows = out.collect()
+    assert len(rows) == 4
+    assert all(len(r["generated"]) == 5 for r in rows)
+    assert sorted(r["doc_id"] for r in rows) == [0, 1, 2, 3]
+    # matches direct generation on the same rows
+    want = np.asarray(gen.generate(cfg, params, prompts[:2], 5))
+    np.testing.assert_array_equal(
+        np.stack([rows[0]["generated"], rows[1]["generated"]]), want
+    )

@@ -187,3 +187,41 @@ def test_explain_detailed_layout():
     assert "3 block(s), 10 row(s)" in text
     assert "block 0" in text and "block 2" in text
     assert "host-resident" in text
+
+
+def test_concurrent_verbs_on_one_frame():
+    """Thread-safety stress (SURVEY §5: the reference delegates this to
+    Spark's task model; here it's the frame's own contract): many threads
+    force the same lazy frame and run verbs concurrently — one
+    materialization, consistent results, no torn blocks."""
+    import threading
+
+    import tensorframes_tpu as tfs
+
+    n = 10_000
+    base = tfs.frame_from_arrays(
+        {"x": np.arange(n, dtype=np.float64)}, num_blocks=8
+    )
+    lazy = tfs.map_blocks(lambda x: {"y": x * 2.0}, base)  # shared, unforced
+    results, errors = [], []
+
+    def worker(i):
+        try:
+            if i % 2 == 0:
+                s = tfs.reduce_blocks(
+                    lambda y_input: {"y": y_input.sum(axis=0)}, lazy
+                )
+                results.append(float(s))
+            else:
+                results.append(float(lazy.column_values("y").sum()))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    expect = float(np.arange(n, dtype=np.float64).sum() * 2)
+    assert all(abs(r - expect) < 1e-3 for r in results), results
