@@ -259,7 +259,41 @@ def _try(name: str, fn, default=None):
         return default
 
 
+def _probe_backend(timeout_s: float = 150.0) -> bool:
+    """Check the accelerator backend from a THROWAWAY subprocess.
+
+    The axon TPU tunnel can wedge in a state where ``jax.devices()``
+    blocks forever (observed after a remote-compile helper crash). A hung
+    backend must degrade the bench to CPU, not hang the driver — and the
+    probe must burn a subprocess, not this process, because backend init
+    is uninterruptible C++.
+    """
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    import os
+
+    # probe unless explicitly pinned to cpu: an unset JAX_PLATFORMS still
+    # auto-detects accelerators, which is exactly where a wedged backend
+    # would hang jax.devices() forever
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu" and not _probe_backend():
+        print("# accelerator backend unresponsive; falling back to cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
 
     n_chips = max(1, len(jax.devices()))

@@ -27,10 +27,11 @@ def generate_over_frame(
     prompt_col: str = "prompts",
 ) -> "tfs.TensorFrame":
     """Append a ``generated`` int32 column of shape [max_new_tokens]."""
-    if prompt_col != "prompts":
-        frame = frame.with_column_renamed(prompt_col, "prompts")
+    feed = {"prompts": prompt_col} if prompt_col != "prompts" else None
     return tfs.map_blocks(
-        gen.generate_program(cfg, params, max_new_tokens, temperature), frame
+        gen.generate_program(cfg, params, max_new_tokens, temperature),
+        frame,
+        feed_dict=feed,
     )
 
 

@@ -77,8 +77,10 @@ def _forward_cached(
     """Run a chunk through the decoder, reading/writing the KV cache.
 
     Returns (hidden states [b, t, h], updated cache). Attention is dense
-    over the cache's static max_seq_len with a validity mask (j <= offset
-    + local position) — the standard static-shape decode formulation.
+    over the cache's static horizon S = cache["k"].shape[3] (the decode
+    horizon ``generate`` sizes it to, ≤ cfg.max_seq_len) with a validity
+    mask (j <= offset + local position) — the standard static-shape
+    decode formulation.
     """
     b, t = tokens.shape
     h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim

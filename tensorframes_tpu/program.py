@@ -147,6 +147,20 @@ class Program:
         outs = ", ".join(s.pretty() for s in self.outputs)
         return f"Program(inputs=[{ins}], outputs=[{outs}])"
 
+    def cost_analysis(self, probe: int = 8) -> Dict[str, float]:
+        """XLA's compiled cost model for this program: flops, bytes
+        accessed, peak memory (keys as XLA reports them). Unknown dims are
+        probed at ``probe`` rows. Observability upgrade over the
+        reference's log4j-only tracing (SURVEY §5): the reference could
+        not ask its runtime what a graph costs without running it."""
+        compiled = jax.jit(self.fn).lower(
+            _abstract_inputs(self.inputs, probe)
+        ).compile()
+        costs = compiled.cost_analysis()
+        if isinstance(costs, (list, tuple)):  # older jax returns [dict]
+            costs = costs[0] if costs else {}
+        return dict(costs or {})
+
 
 def _abstract_inputs(
     inputs: Sequence[TensorSpec], probe: int

@@ -137,3 +137,13 @@ def test_loaded_program_drives_map_blocks(tmp_path):
     df = tfs.frame_from_rows([{"x": float(i)} for i in range(4)])
     out = tfs.map_blocks(loaded, df).collect()
     assert [r["z"] for r in out] == [10.0 + i for i in range(4)]
+
+
+def test_cost_analysis():
+    import tensorframes_tpu as tfs
+
+    frame = tfs.frame_from_arrays({"x": np.arange(16, dtype=np.float32)})
+    program = tfs.compile_program(lambda x: {"y": x @ x * 2.0 + x}, frame)
+    costs = program.cost_analysis(probe=16)
+    assert isinstance(costs, dict) and costs
+    assert any("flops" in k for k in costs), sorted(costs)[:10]
