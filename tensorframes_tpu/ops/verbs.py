@@ -80,6 +80,7 @@ def _normalize_program(
     schema: Schema,
     block: bool,
     reduce_mode: Optional[str] = None,
+    feed_dict: Optional[Dict[str, str]] = None,
 ) -> Tuple[Program, Optional[List[Tuple[str, str, str]]]]:
     """Accept DSL nodes / a python function / a Program; return an analyzed
     Program plus (for DSL reducer fetches) segment-lowering info.
@@ -87,6 +88,9 @@ def _normalize_program(
     ``reduce_mode`` ('rows' | 'blocks') extends the input-spec namespace for
     plain-function fetches so parameters may follow the reduce naming
     contracts (``x_1``/``x_2``, ``x_input``) in addition to column names.
+    ``feed_dict`` (placeholder → column) extends it with the renamed
+    placeholders, so a function parameter may name a placeholder that a
+    feed_dict maps onto a differently-named column (core.py:128-142).
     """
     seg_info = None
     if isinstance(fetches, Program):
@@ -106,6 +110,9 @@ def _normalize_program(
         seg_info = segment_reduce_info(nodes)
     elif callable(fetches):
         specs = _input_specs_from_schema(schema, block)
+        for ph, col in (feed_dict or {}).items():
+            if col in specs and ph not in specs:
+                specs[ph] = TensorSpec(ph, specs[col].dtype, specs[col].shape)
         if reduce_mode == "rows":
             for c in schema.device_columns:
                 specs[f"{c.name}_1"] = TensorSpec(f"{c.name}_1", c.dtype, c.cell_shape)
@@ -192,7 +199,9 @@ def map_blocks(
     """
     if _is_pandas(frame):
         return _map_pandas(fetches, frame, feed_dict, block=True)
-    program, _ = _normalize_program(fetches, frame.schema, block=True)
+    program, _ = _normalize_program(
+        fetches, frame.schema, block=True, feed_dict=feed_dict
+    )
     program = _apply_feed_dict(program, feed_dict)
     validate_map(program, frame.schema, block=True, trim=trim)
     compiled = program.compiled()
@@ -279,7 +288,9 @@ def map_rows(
     """
     if _is_pandas(frame):
         return _map_pandas(fetches, frame, feed_dict, block=False)
-    program, _ = _normalize_program(fetches, frame.schema, block=False)
+    program, _ = _normalize_program(
+        fetches, frame.schema, block=False, feed_dict=feed_dict
+    )
     program = _apply_feed_dict(program, feed_dict)
     validate_map(program, frame.schema, block=False)
     compiled = program.compiled()
