@@ -108,11 +108,11 @@ def block_is_ragged(block: Dict[str, object], input_names: Sequence[str]) -> boo
 # block shape instead of one Session.run per row pair)
 # ---------------------------------------------------------------------------
 
-def make_pair_fold(program: Program, out_names: Sequence[str]) -> Callable:
-    """Build a jitted fold over the leading axis of per-output arrays.
-
-    Input: dict x -> [n, ...cell] arrays (n >= 1). Output: dict x -> cell.
-    """
+def pair_fold_body(program: Program, out_names: Sequence[str]) -> Callable:
+    """The (unjitted) pairwise fold over the leading axis of per-output
+    arrays: dict x -> [n, ...cell] (n >= 1) → dict x -> cell. Shared by
+    the host fold (below) and the sharded reduce_rows program
+    (verbs._sharded_reduce_rows_fn), so fold semantics cannot diverge."""
 
     def fold(cols: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         init = {x: cols[x][0] for x in out_names}
@@ -129,4 +129,9 @@ def make_pair_fold(program: Program, out_names: Sequence[str]) -> Callable:
         carry, _ = jax.lax.scan(step, init, rest)
         return carry
 
-    return jax.jit(fold)
+    return fold
+
+
+def make_pair_fold(program: Program, out_names: Sequence[str]) -> Callable:
+    """Jitted form of :func:`pair_fold_body`."""
+    return jax.jit(pair_fold_body(program, out_names))

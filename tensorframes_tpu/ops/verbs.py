@@ -51,7 +51,7 @@ from ..validation import (
     validate_reduce_blocks,
     validate_reduce_rows,
 )
-from .executor import block_is_ragged, gather_feeds, make_pair_fold
+from .executor import block_is_ragged, gather_feeds, make_pair_fold, pair_fold_body
 
 logger = get_logger(__name__)
 
@@ -382,15 +382,47 @@ def _unpack_results(program: Program, finals: Dict[str, np.ndarray]):
     return out[0] if len(out) == 1 else out
 
 
+def _sharded_reduce_rows_fn(program: Program, out_names, mesh, axis):
+    """One XLA program for reduce_rows over a sharded frame: each shard
+    folds its local rows with ``lax.scan``, the per-shard partials
+    ``all_gather`` over the batch axis, and a second scan folds them —
+    no host round-trip (≙ replacing performReducePairwise + driver fold,
+    DebugRowOps.scala:939-979, with on-device collectives)."""
+    from ..parallel._shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    pair_scan = pair_fold_body(program, out_names)
+
+    def local(vals):
+        carry = pair_scan(vals)
+        gathered = {
+            x: jax.lax.all_gather(carry[x], axis) for x in out_names
+        }
+        return pair_scan(gathered)
+
+    in_specs = (
+        {
+            x: P(axis, *([None] * (program.input(f"{x}_1").shape.rank)))
+            for x in out_names
+        },
+    )
+    out_specs = {x: P() for x in out_names}
+    return jax.jit(
+        shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    )
+
+
 def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     """Pairwise-reduce all rows to a single row. Each fetch ``x`` consumes
     placeholders ``x_1``/``x_2`` (Operations.scala:83-96). Eager
     (core.py:197 "not lazy").
 
     Execution: within each block, a sequential ``lax.scan`` fold under one
-    jit; block partials are folded the same way. Reduction order is
-    unspecified by contract (core.py:186-187), so the block split does not
-    change the result class the reference supports (associative programs).
+    jit; block partials are folded the same way. On sharded frames the
+    fold runs per shard with an ``all_gather`` merge — one XLA program,
+    no host gather. Reduction order is unspecified by contract
+    (core.py:186-187), so the split does not change the result class the
+    reference supports (associative programs).
     """
     program, _ = _normalize_program(
         fetches, frame.schema, block=False, reduce_mode="rows"
@@ -401,7 +433,29 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     t0 = time.perf_counter()
 
     partials: List[Dict[str, np.ndarray]] = []
-    for b in frame.blocks():
+    blocks = frame.blocks()
+    if frame.is_sharded and blocks:
+        main = blocks[0]
+        main_ok = all(
+            not isinstance(main.get(x), list)
+            and getattr(main.get(x), "ndim", 0) >= 1
+            and main[x].shape[0] >= 1
+            for x in out_names
+        )
+        if main_ok:
+            axis = getattr(frame, "_axis", None) or get_config().batch_axis
+            cache = getattr(program, "_sharded_rr", None)
+            if cache is None or cache[0] != (frame.mesh, axis):
+                fn = _sharded_reduce_rows_fn(
+                    program, out_names, frame.mesh, axis
+                )
+                program._sharded_rr = ((frame.mesh, axis), fn)
+            fn = program._sharded_rr[1]
+            res = fn({x: main[x] for x in out_names})
+            partials.append({x: np.asarray(res[x]) for x in out_names})
+            blocks = blocks[1:]  # tail (if any) folds in below
+
+    for b in blocks:
         n = _block_num_rows(b)
         if n == 0:
             continue
