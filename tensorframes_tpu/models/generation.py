@@ -93,10 +93,12 @@ def _forward_cached(
     valid = jnp.arange(S)[None, :] <= (offset + jnp.arange(t))[:, None]
     neg = jnp.asarray(-1e30, jnp.float32)
 
+    from ..ops.quantize import asarray as _w
+
     new_cache = {"k": cache["k"], "v": cache["v"]}
     for li, p in enumerate(params["layers"]):
         y = _layer_norm(x, **p["ln1"])
-        qkv = (y @ p["attn"]["qkv"].astype(y.dtype)).reshape(b, t, 3, nh, hd)
+        qkv = (y @ _w(p["attn"]["qkv"], y.dtype)).reshape(b, t, 3, nh, hd)
         q = qkv[:, :, 0].transpose(0, 2, 1, 3)           # [b, nh, t, hd]
         k = qkv[:, :, 1].transpose(0, 2, 1, 3)
         v = qkv[:, :, 2].transpose(0, 2, 1, 3)
@@ -116,7 +118,7 @@ def _forward_cached(
         w = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         ctx = jnp.einsum("bnts,bnsd->bntd", w, cv)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, h)
-        x = x + ctx @ p["attn"]["out"].astype(x.dtype)
+        x = x + ctx @ _w(p["attn"]["out"], x.dtype)
         x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
     return _layer_norm(x, **params["final_ln"]), new_cache
 

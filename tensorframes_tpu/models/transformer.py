@@ -157,9 +157,10 @@ def _layer_norm(x, scale, bias, eps=1e-6):
 
 def _attention(cfg: TransformerConfig, p, x, mask, mesh=None):
     from ..ops import attention as att
+    from ..ops.quantize import asarray as _w
 
     b, s, h = x.shape
-    qkv = (x @ p["qkv"].astype(x.dtype)).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+    qkv = (x @ _w(p["qkv"], x.dtype)).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     # [b, heads, s, d]
     q = q.transpose(0, 2, 1, 3)
@@ -195,13 +196,15 @@ def _attention(cfg: TransformerConfig, p, x, mask, mesh=None):
     else:
         raise ValueError(f"Unknown attention_impl {impl!r}")
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
-    return ctx @ p["out"].astype(x.dtype)
+    return ctx @ _w(p["out"], x.dtype)
 
 
 def _mlp(p, x):
-    y = x @ p["in"].astype(x.dtype) + p["in_bias"].astype(x.dtype)
+    from ..ops.quantize import asarray as _w
+
+    y = x @ _w(p["in"], x.dtype) + p["in_bias"].astype(x.dtype)
     y = jax.nn.gelu(y)
-    return y @ p["out"].astype(x.dtype) + p["out_bias"].astype(x.dtype)
+    return y @ _w(p["out"], x.dtype) + p["out_bias"].astype(x.dtype)
 
 
 def forward(
@@ -340,3 +343,18 @@ def synthetic_batch(cfg: TransformerConfig, batch: int, seq: int, seed: int = 0)
     tokens = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
     targets = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
     return tokens, targets
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Weight-only int8 quantization of the layer weights (attn qkv/out,
+    mlp in/out). Embeddings, norms, and biases stay full precision —
+    they are gathered/broadcast, not matmul'd, so quantizing them saves
+    little and costs accuracy. The returned tree runs through the same
+    ``forward`` (ops/quantize.asarray dequantizes at the matmul, which
+    XLA fuses into the MXU op), at ~4x less weight HBM traffic."""
+    from ..ops.quantize import quantize_tree
+
+    return quantize_tree(
+        params,
+        predicate=lambda path, _: "embed" not in jax.tree_util.keystr(path),
+    )

@@ -1,0 +1,125 @@
+"""Weight-only int8 quantization for inference.
+
+Model scoring through the verbs is frozen-graph inference (params are
+closure-captured constants ≙ variables-to-constants freezing,
+core.py:42-56). On TPU those frozen weights live in HBM, and HBM
+bandwidth — not MXU FLOPs — bounds small-batch serving. Symmetric
+per-channel int8 storage cuts weight traffic 4× vs f32 (2× vs bf16);
+XLA fuses the dequantize-convert into the consuming matmul/conv, so the
+compute still runs in bf16/f32 on the MXU with full-precision scales.
+
+``QuantizedTensor`` is a pytree, so quantized parameter trees flow
+through ``jax.jit``, shardings, and checkpoints like any other params.
+``quantize_tree`` converts a whole parameter tree (floating arrays with
+rank >= min_rank); ``asarray`` is the read-side accessor models use so
+one forward pass serves both plain and quantized trees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """Symmetric per-channel int8 weight: ``q * scale ≈ w``.
+
+    ``scale`` broadcasts against ``q`` (kept with singleton dims), so
+    dequantization is one fused multiply."""
+
+    q: jnp.ndarray        # int8
+    scale: jnp.ndarray    # f32, broadcastable to q's shape
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.q.shape)) + 4 * int(np.prod(self.scale.shape))
+
+    def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def quantize(w, channel_axis: int = -1) -> QuantizedTensor:
+    """Symmetric per-channel int8: scales are per-slice max/127 along
+    every axis EXCEPT ``channel_axis`` (the output-feature axis, whose
+    per-channel dynamic range is what matters for matmul accuracy)."""
+    w = jnp.asarray(w)
+    if not jnp.issubdtype(w.dtype, jnp.floating):
+        raise TypeError(f"quantize expects a floating array, got {w.dtype}")
+    axis = channel_axis % w.ndim
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale)
+
+
+def asarray(w, dtype=jnp.float32) -> jnp.ndarray:
+    """Read-side accessor: dequantize if quantized, else cast. Models use
+    this so one forward serves plain and quantized parameter trees."""
+    if isinstance(w, QuantizedTensor):
+        return w.dequantize(dtype)
+    return jnp.asarray(w).astype(dtype)
+
+
+def quantize_tree(
+    params: Any,
+    min_rank: int = 2,
+    predicate: Optional[Callable[[tuple, jnp.ndarray], bool]] = None,
+    channel_axis: int = -1,
+) -> Any:
+    """Quantize every floating leaf with rank >= ``min_rank`` (weights;
+    biases/norms stay full precision). ``predicate(path, leaf)`` can veto
+    individual leaves (e.g. keep embeddings full precision)."""
+
+    def maybe_q(path, leaf):
+        if isinstance(leaf, QuantizedTensor):
+            return leaf  # idempotent on already-quantized trees
+        arr = jnp.asarray(leaf)
+        if not jnp.issubdtype(arr.dtype, jnp.floating) or arr.ndim < min_rank:
+            return leaf
+        if predicate is not None and not predicate(path, arr):
+            return leaf
+        return quantize(arr, channel_axis)
+
+    # is_leaf stops tree_map from descending INTO QuantizedTensor (a
+    # registered pytree) and re-quantizing its scale array
+    return jax.tree_util.tree_map_with_path(
+        maybe_q, params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+
+
+def tree_nbytes(params: Any) -> int:
+    """Total parameter bytes (QuantizedTensor-aware) — the HBM footprint
+    the quantization exists to shrink."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    ):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.nbytes
+        else:
+            arr = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+            total += int(np.prod(arr.shape)) * arr.dtype.itemsize
+    return total

@@ -436,10 +436,16 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     blocks = frame.blocks()
     if frame.is_sharded and blocks:
         main = blocks[0]
+        dp = frame.mesh.shape.get(
+            getattr(frame, "_axis", None) or get_config().batch_axis, 1
+        )
         main_ok = all(
             not isinstance(main.get(x), list)
             and getattr(main.get(x), "ndim", 0) >= 1
             and main[x].shape[0] >= 1
+            # a trimmed map can leave a sharded frame with a row count the
+            # mesh no longer divides; shard_map would reject it — host path
+            and main[x].shape[0] % dp == 0
             for x in out_names
         )
         if main_ok:

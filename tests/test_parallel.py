@@ -215,3 +215,14 @@ def test_sharded_reduce_rows_vector_cells():
     dev = tfs.frame_from_arrays({"x": vals}).to_device()
     got = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, dev)
     np.testing.assert_allclose(np.asarray(got), vals.sum(axis=0))
+
+
+def test_sharded_reduce_rows_after_trim_falls_back():
+    """A trimmed map can leave a sharded frame with a row count the mesh
+    no longer divides; reduce_rows must fall back to the host fold."""
+    import tensorframes_tpu as tfs
+
+    dev = tfs.frame_from_arrays({"x": np.arange(4000, dtype=np.float64)}).to_device()
+    trimmed = tfs.map_blocks(lambda x: {"x": x[:5]}, dev, trim=True)
+    got = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, trimmed)
+    assert float(got) == float(np.arange(5).sum())

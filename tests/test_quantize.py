@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization: round-trip accuracy, pytree behavior,
+transformer integration (embedding quality + generation), HBM accounting."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.ops import quantize as qt
+from tensorframes_tpu.models import generation as gen
+from tensorframes_tpu.models import transformer as tr
+
+
+def test_quantize_roundtrip_accuracy():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    q = qt.quantize(w)
+    assert q.q.dtype == jnp.int8 and q.q.shape == w.shape
+    assert q.scale.shape == (1, 32)  # per output channel
+    back = np.asarray(q.dequantize())
+    # symmetric int8: worst-case error is scale/2 per element
+    err = np.abs(back - w)
+    bound = np.asarray(q.scale)[0] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_quantize_zero_and_outlier_channels():
+    w = np.zeros((16, 4), np.float32)
+    w[:, 1] = 1000.0  # outlier channel must not poison others
+    w[:, 2] = 0.001
+    q = qt.quantize(w)
+    back = np.asarray(q.dequantize())
+    np.testing.assert_allclose(back[:, 0], 0.0)
+    np.testing.assert_allclose(back[:, 1], 1000.0, rtol=1e-2)
+    np.testing.assert_allclose(back[:, 2], 0.001, rtol=1e-2)
+
+
+def test_quantized_tensor_is_pytree_and_jits():
+    w = np.random.default_rng(1).standard_normal((8, 8)).astype(np.float32)
+    q = qt.quantize(w)
+    fn = jax.jit(lambda x, q: x @ qt.asarray(q, x.dtype))
+    x = jnp.ones((2, 8), jnp.float32)
+    out = fn(x, q)  # QuantizedTensor crosses the jit boundary as a pytree
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), atol=0.2)
+
+
+def test_quantize_tree_skips_small_and_int_leaves():
+    params = {
+        "w": np.random.default_rng(2).standard_normal((16, 16)).astype(np.float32),
+        "b": np.zeros((16,), np.float32),
+        "steps": np.asarray(3),
+    }
+    out = qt.quantize_tree(params)
+    assert isinstance(out["w"], qt.QuantizedTensor)
+    assert not isinstance(out["b"], qt.QuantizedTensor)
+    assert not isinstance(out["steps"], qt.QuantizedTensor)
+
+
+def test_transformer_quantized_embeddings_close():
+    cfg = tr.tiny()
+    params = tr.init_params(cfg, seed=0)
+    qparams = tr.quantize_params(params)
+    tokens, _ = tr.synthetic_batch(cfg, 4, 16, seed=0)
+    full = np.asarray(tr.forward(cfg, params, tokens), np.float32)
+    quant = np.asarray(tr.forward(cfg, qparams, tokens), np.float32)
+    # int8 weights: embeddings stay close in cosine similarity per row
+    a = full.reshape(4, -1)
+    b = quant.reshape(4, -1)
+    cos = (a * b).sum(1) / (np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1))
+    assert (cos > 0.99).all(), cos
+    # ~4x weight compression on the quantized leaves
+    assert qt.tree_nbytes(qparams) < 0.65 * qt.tree_nbytes(params)
+
+
+def test_quantized_generation_runs():
+    cfg = gen.gpt_tiny()
+    params = tr.init_params(cfg, seed=0)
+    qparams = tr.quantize_params(params)
+    prompts = np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    toks = np.asarray(gen.generate(cfg, qparams, prompts, 5))
+    assert toks.shape == (2, 5)
+    assert (toks >= 0).all() and (toks < cfg.vocab_size).all()
+
+
+def test_quantized_scoring_via_map_blocks():
+    cfg = tr.tiny()
+    params = tr.quantize_params(tr.init_params(cfg, seed=0))
+    tokens, _ = tr.synthetic_batch(cfg, 6, 12, seed=1)
+    df = tfs.frame_from_arrays({"tokens": tokens}, num_blocks=2)
+    prog = tr.embed_program(cfg, params)
+    out = tfs.map_blocks(lambda tokens: prog(tokens), df)
+    emb = np.stack([r["embedding"] for r in out.collect()])
+    assert emb.shape == (6, cfg.hidden)
+    assert np.isfinite(emb).all()
+
+
+def test_quantize_tree_idempotent():
+    """Re-quantizing an already-quantized tree passes leaves through
+    untouched (tree_map must not descend into QuantizedTensor and
+    quantize its scale array)."""
+    params = {"w": np.random.default_rng(4).standard_normal((16, 16)).astype(np.float32)}
+    q1 = qt.quantize_tree(params)
+    q2 = qt.quantize_tree(q1)
+    assert isinstance(q2["w"], qt.QuantizedTensor)
+    assert not isinstance(q2["w"].scale, qt.QuantizedTensor)
+    np.testing.assert_array_equal(
+        np.asarray(q2["w"].dequantize()), np.asarray(q1["w"].dequantize())
+    )
