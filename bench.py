@@ -159,9 +159,12 @@ def _bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
 
 
 def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
-                    iters: int = 3, full_scale: bool = True):
+                    iters: int = 3, full_scale: bool = True,
+                    int8: bool = False):
     """Causal-LM decode throughput (generated tokens/sec): KV-cache
-    lax.scan decode as ONE jitted XLA program (models/generation.py)."""
+    lax.scan decode as ONE jitted XLA program (models/generation.py).
+    ``int8=True`` measures the weight-only quantized tree (decode is
+    weight-HBM-bound, so this is where int8 pays)."""
     import jax
 
     from tensorframes_tpu.models import generation as gen
@@ -170,6 +173,8 @@ def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
     cfg = gen.gpt_small() if full_scale else gen.gpt_tiny()
     prompt = min(prompt, cfg.max_seq_len - new - 1)
     params = tr.init_params(cfg, seed=0)
+    if int8:
+        params = tr.quantize_params(params)
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     fn = jax.jit(lambda p: gen.generate(cfg, params, p, new))
@@ -337,6 +342,16 @@ def main():
         ),
         0.0,
     )
+    gen_tps_q = _try(
+        "generate_int8",
+        lambda: _bench_generate(
+            new=64 if on_tpu else 8,
+            iters=3 if on_tpu else 1,
+            full_scale=on_tpu,
+            int8=True,
+        ),
+        0.0,
+    )
 
     from tensorframes_tpu import native
 
@@ -357,9 +372,9 @@ def main():
         f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"
     )
     print(f"# flash_attention_{attn_seq}seq_tokens_per_sec={attn_tps:.0f}")
-    print(
-        f"# gpt_{'small' if on_tpu else 'tiny'}_decode_tokens_per_sec={gen_tps:.0f}"
-    )
+    size = "small" if on_tpu else "tiny"
+    print(f"# gpt_{size}_decode_tokens_per_sec={gen_tps:.0f}")
+    print(f"# gpt_{size}_int8_decode_tokens_per_sec={gen_tps_q:.0f}")
 
     baseline = None
     # the published baseline is full-scale-on-TPU; a CPU fallback run uses a

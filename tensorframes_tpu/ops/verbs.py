@@ -436,9 +436,8 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
     blocks = frame.blocks()
     if frame.is_sharded and blocks:
         main = blocks[0]
-        dp = frame.mesh.shape.get(
-            getattr(frame, "_axis", None) or get_config().batch_axis, 1
-        )
+        axis = getattr(frame, "_axis", None) or get_config().batch_axis
+        dp = frame.mesh.shape.get(axis, 1)
         main_ok = all(
             not isinstance(main.get(x), list)
             and getattr(main.get(x), "ndim", 0) >= 1
@@ -449,7 +448,6 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
             for x in out_names
         )
         if main_ok:
-            axis = getattr(frame, "_axis", None) or get_config().batch_axis
             cache = getattr(program, "_sharded_rr", None)
             if cache is None or cache[0] != (frame.mesh, axis):
                 fn = _sharded_reduce_rows_fn(

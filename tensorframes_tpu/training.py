@@ -91,3 +91,57 @@ def run_resumable(
     if save_every and step % save_every != 0 and ran:
         checkpointer.save(step, state)
     return state, ran
+
+
+def make_grad_accum_step(
+    loss_fn: Callable,
+    tx,
+    accum_steps: int,
+) -> Callable:
+    """Gradient accumulation: one optimizer update from ``accum_steps``
+    microbatches, averaged — the standard lever when the global batch
+    doesn't fit HBM (complements ``jax.checkpoint`` rematerialization).
+
+    ``loss_fn(params, batch) -> scalar``; the returned
+    ``step(params, opt_state, batch)`` expects ``batch`` pytree leaves
+    with a leading dim divisible by ``accum_steps`` and scans over the
+    microbatch splits — one compiled program, O(1) activation memory in
+    the number of microbatches.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def step(params, opt_state, batch):
+        def to_micro(x):
+            n = x.shape[0]
+            if n % accum_steps:
+                raise ValueError(
+                    f"batch dim {n} not divisible by accum_steps={accum_steps}"
+                )
+            return x.reshape((accum_steps, n // accum_steps) + x.shape[1:])
+
+        micro = jax.tree_util.tree_map(to_micro, batch)
+        zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def accum(carry, mb):
+            g_sum, l_sum = carry
+            loss, g = jax.value_and_grad(loss_fn)(params, mb)
+            g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
+            # cast into the f32 carry: under the package's default x64 a
+            # float64 loss must not change the scan carry dtype
+            return (g_sum, l_sum + loss.astype(jnp.float32)), None
+
+        (g_sum, l_sum), _ = jax.lax.scan(
+            accum, (zero, jnp.zeros((), jnp.float32)), micro
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, g_sum)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, l_sum / accum_steps
+
+    return step

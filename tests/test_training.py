@@ -104,3 +104,64 @@ def test_already_complete_run_is_noop(tmp_path):
         _make_step(), _init(), ckpt, exploding(), num_steps=5, save_every=5
     )
     assert ran == 0 and int(state["count"]) == 5
+
+
+def test_grad_accum_matches_full_batch():
+    """accum_steps microbatches must produce the same update as the full
+    batch (linear model + SGD → exact up to float assoc)."""
+    import optax
+
+    import tensorframes_tpu.training as tn
+
+    rng = np.random.default_rng(0)
+    w0 = {"w": jnp.asarray(rng.standard_normal(4), jnp.float32)}
+    x = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(8), jnp.float32)
+
+    def loss_fn(params, batch):
+        bx, by = batch
+        pred = bx @ params["w"]
+        return jnp.mean((pred - by) ** 2)
+
+    tx = optax.sgd(0.1)
+
+    full_step = tn.make_grad_accum_step(loss_fn, tx, 1)
+    accum_step = tn.make_grad_accum_step(loss_fn, tx, 4)
+    p1, _, l1 = full_step(w0, tx.init(w0), (x, y))
+    p4, _, l4 = accum_step(w0, tx.init(w0), (x, y))
+    # mean-of-microbatch-means == full-batch mean for equal splits
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l4), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(p1["w"]), np.asarray(p4["w"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_grad_accum_validates():
+    import optax
+
+    import tensorframes_tpu.training as tn
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        tn.make_grad_accum_step(lambda p, b: 0.0, optax.sgd(0.1), 0)
+    step = tn.make_grad_accum_step(
+        lambda p, b: jnp.mean(b[0]) * p["w"].sum(), optax.sgd(0.1), 3
+    )
+    w = {"w": jnp.ones((2,), jnp.float32)}
+    with pytest.raises(ValueError, match="not divisible"):
+        step(w, optax.sgd(0.1).init(w), (jnp.ones((8, 2)),))
+
+
+def test_grad_accum_float64_loss():
+    """x64 is on by default in this package; a float64 loss must not
+    break the scan carry."""
+    import optax
+
+    import tensorframes_tpu.training as tn
+
+    w = {"w": jnp.asarray(np.ones(3), jnp.float64)}
+    x = jnp.asarray(np.ones((4, 3)), jnp.float64)
+    step = tn.make_grad_accum_step(
+        lambda p, b: jnp.mean((b[0] @ p["w"]) ** 2), optax.sgd(0.01), 2
+    )
+    p, _, loss = step(w, optax.sgd(0.01).init(w), (x,))
+    assert np.isfinite(float(loss))
