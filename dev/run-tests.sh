@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Test runner (≙ the reference's python/run-tests.sh): full suite on the
+# virtual 8-device CPU mesh, then the multi-chip dry-run and a bench
+# smoke. conftest.py pins the platform; no env needed for pytest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest tests/ -x -q "$@"
+
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py 8
+
+echo "run-tests: all green"

@@ -110,6 +110,7 @@ def make_grad_accum_step(
     """
     import jax
     import jax.numpy as jnp
+    import optax
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -139,9 +140,7 @@ def make_grad_accum_step(
         )
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, g_sum)
         updates, opt_state = tx.update(grads, opt_state, params)
-        import optax
-
         params = optax.apply_updates(params, updates)
         return params, opt_state, l_sum / accum_steps
 
-    return step
+    return jax.jit(step)
