@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Test runner (≙ the reference's python/run-tests.sh): full suite on the
-# virtual 8-device CPU mesh, then the multi-chip dry-run and a bench
-# smoke. conftest.py pins the platform; no env needed for pytest.
+# virtual 8-device CPU mesh, then the multi-chip dry-run.
+# conftest.py pins the platform; no env needed for pytest.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -108,3 +108,26 @@ def test_quantize_tree_idempotent():
     np.testing.assert_array_equal(
         np.asarray(q2["w"].dequantize()), np.asarray(q1["w"].dequantize())
     )
+
+
+def test_quantized_tree_checkpoints(tmp_path):
+    """QuantizedTensor trees ride the npz checkpoint backend like any
+    other params (int8 q + f32 scale are just pytree leaves)."""
+    from tensorframes_tpu.checkpoint import Checkpointer
+
+    cfg = tr.tiny()
+    qparams = tr.quantize_params(tr.init_params(cfg, seed=0))
+    ck = Checkpointer(str(tmp_path), backend="npz")
+    ck.save(1, qparams)
+    back = ck.restore(step=1, like=qparams)
+    lq = qparams["layers"][0]["attn"]["qkv"]
+    lb = back["layers"][0]["attn"]["qkv"]
+    assert isinstance(lb, qt.QuantizedTensor)
+    np.testing.assert_array_equal(np.asarray(lb.q), np.asarray(lq.q))
+    np.testing.assert_array_equal(np.asarray(lb.scale), np.asarray(lq.scale))
+    # restored tree scores identically
+    tokens, _ = tr.synthetic_batch(cfg, 2, 8, seed=0)
+    np.testing.assert_array_equal(
+        np.asarray(tr.forward(cfg, qparams, tokens), np.float32),
+        np.asarray(tr.forward(cfg, back, tokens), np.float32),
+    )
