@@ -74,7 +74,8 @@ def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
     return _time_rows_per_sec(run_once, n_rows, iters)
 
 
-def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0):
+def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0,
+                     int8: bool = False):
     """Inception-v3 batch inference via map_blocks (BASELINE config 4) —
     the headline metric named in BASELINE.json."""
     import tensorframes_tpu as tfs
@@ -82,6 +83,8 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
 
     cfg = inc.inception_v3(channel_scale=channel_scale)
     params = inc.init_params(cfg, seed=0)
+    if int8:
+        params = inc.quantize_params(params)
     images = inc.synthetic_images(cfg, n_rows, seed=0)
     frame = tfs.frame_from_arrays({"images": images}, num_blocks=1).to_device()
     prog = inc.scoring_program(cfg, params)
@@ -318,6 +321,16 @@ def main():
         ),
         0.0,
     )
+    inception_rps_q = _try(
+        "inception_int8",
+        lambda: _bench_inception(
+            n_rows=512 if on_tpu else 16,
+            iters=4 if on_tpu else 1,
+            channel_scale=1.0 if on_tpu else 0.125,
+            int8=True,
+        ),
+        0.0,
+    )
     bert_rps = _try(
         "bert",
         lambda: _bench_bert_embed(
@@ -368,6 +381,7 @@ def main():
     print(f"# aggregate_1M_512groups_wall_s={aggregate_s:.4f}")
     print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")
     print(f"# inception_v3_map_blocks_rows_per_sec={inception_rps:.0f}")
+    print(f"# inception_v3_int8_map_blocks_rows_per_sec={inception_rps_q:.0f}")
     print(
         f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"
     )

@@ -187,9 +187,11 @@ def init_params(cfg: InceptionConfig, seed: int = 0) -> Dict:
 
 def _conv2d(p, x, stride: int = 1, padding="SAME"):
     """conv + folded-BN affine + relu; f32 accumulation on the MXU."""
+    from ..ops.quantize import asarray as _qw
+
     y = lax.conv_general_dilated(
         x,
-        p["w"],
+        _qw(p["w"], x.dtype),
         (stride, stride),
         padding,
         dimension_numbers=_DN,
@@ -294,8 +296,10 @@ def forward(cfg: InceptionConfig, params: Dict, images: jnp.ndarray) -> jnp.ndar
     for i in range(2):
         x = _block_e(params[f"mixed_e{i}"], x)
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
+    from ..ops.quantize import asarray as _qw
+
     fc = params["fc"]
-    return x @ fc["w"].astype(jnp.float32) + fc["b"].astype(jnp.float32)
+    return x @ _qw(fc["w"], jnp.float32) + fc["b"].astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -329,4 +333,20 @@ def synthetic_images(
 
 
 def param_count(params) -> int:
-    return sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
+    from ..ops.quantize import QuantizedTensor
+
+    total = 0
+    for v in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    ):
+        shape = v.q.shape if isinstance(v, QuantizedTensor) else v.shape
+        total += int(np.prod(shape))
+    return total
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Weight-only int8 for conv/dense weights; the folded-BN scale/bias
+    and fc bias stay full precision (rank < 2)."""
+    from ..ops.quantize import quantize_tree
+
+    return quantize_tree(params)

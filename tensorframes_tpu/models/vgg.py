@@ -119,8 +119,10 @@ def init_params(cfg: VGGConfig, seed: int = 0) -> Dict:
 # ---------------------------------------------------------------------------
 
 def _conv_relu(p, x):
+    from ..ops.quantize import asarray as _qw
+
     y = lax.conv_general_dilated(
-        x, p["w"], (1, 1), "SAME", dimension_numbers=_DN,
+        x, _qw(p["w"], x.dtype), (1, 1), "SAME", dimension_numbers=_DN,
         preferred_element_type=jnp.float32,
     )
     return jax.nn.relu(y + p["b"].astype(jnp.float32)).astype(x.dtype)
@@ -140,15 +142,17 @@ def forward(cfg: VGGConfig, params: Dict, images: jnp.ndarray) -> jnp.ndarray:
             x = _conv_relu(params[f"conv{stage}_{i}"], x)
         x = _maxpool2(x)
     x = x.reshape(x.shape[0], -1)  # [n, (S/32)²·512]
+    from ..ops.quantize import asarray as _qw
+
     for name in ("fc6", "fc7"):
         p = params[name]
         x = jax.nn.relu(
-            jnp.dot(x, p["w"], preferred_element_type=jnp.float32)
+            jnp.dot(x, _qw(p["w"], x.dtype), preferred_element_type=jnp.float32)
             + p["b"].astype(jnp.float32)
         ).astype(x.dtype)
     p = params["fc8"]
     return (
-        jnp.dot(x, p["w"], preferred_element_type=jnp.float32)
+        jnp.dot(x, _qw(p["w"], x.dtype), preferred_element_type=jnp.float32)
         + p["b"].astype(jnp.float32)
     )
 
@@ -204,4 +208,20 @@ def synthetic_images(cfg: VGGConfig, n: int, seed: int = 0) -> np.ndarray:
 
 
 def param_count(params) -> int:
-    return sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
+    from ..ops.quantize import QuantizedTensor
+
+    total = 0
+    for v in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    ):
+        shape = v.q.shape if isinstance(v, QuantizedTensor) else v.shape
+        total += int(np.prod(shape))
+    return total
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Weight-only int8 for every conv/dense weight (per output channel);
+    biases stay full precision (min_rank=2 excludes them)."""
+    from ..ops.quantize import quantize_tree
+
+    return quantize_tree(params)

@@ -131,3 +131,20 @@ def test_quantized_tree_checkpoints(tmp_path):
         np.asarray(tr.forward(cfg, qparams, tokens), np.float32),
         np.asarray(tr.forward(cfg, back, tokens), np.float32),
     )
+
+
+def test_quantized_conv_models_close():
+    """VGG/Inception int8 trees: same scoring path, close logits."""
+    from tensorframes_tpu.models import inception as inc
+    from tensorframes_tpu.models import vgg
+
+    for mod in (vgg, inc):
+        cfg = mod.tiny()
+        params = mod.init_params(cfg, seed=0)
+        qparams = mod.quantize_params(params)
+        imgs = mod.synthetic_images(cfg, 2, seed=0)
+        a = np.asarray(mod.forward(cfg, params, imgs), np.float32)
+        b = np.asarray(mod.forward(cfg, qparams, imgs), np.float32)
+        cos = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+        assert cos > 0.98, (mod.__name__, cos)
+        assert qt.tree_nbytes(qparams) < 0.5 * qt.tree_nbytes(params)
