@@ -724,7 +724,11 @@ def explain(frame: TensorFrame, detailed: bool = False) -> str:
             elif isinstance(v, np.ndarray):
                 kinds.append(f"{name}: np{list(v.shape)}")
             else:
-                kinds.append(f"{name}: device{list(getattr(v, 'shape', []))}")
+                spec = getattr(getattr(v, "sharding", None), "spec", None)
+                at = f"@{tuple(spec)}" if spec is not None else ""
+                kinds.append(
+                    f"{name}: device{list(getattr(v, 'shape', []))}{at}"
+                )
         lines.append(f"  block {i}: {_block_num_rows(b)} rows  ({', '.join(kinds)})")
     return "\n".join(lines)
 

@@ -1,0 +1,88 @@
+"""Deterministic property sweep: the five verbs against a numpy oracle
+across dtypes × cell shapes × block counts × residency — the shotgun
+counterpart of the dtype-parity suite (≙ the reference's type-
+parameterized CommonOperationsSuite replayed over a config grid)."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64]
+CELLS = [(), (3,)]
+BLOCKS = [1, 3, 8]
+
+
+def _mk(rng, n, cell, dtype):
+    if np.issubdtype(dtype, np.integer):
+        return rng.integers(-50, 50, (n, *cell)).astype(dtype)
+    return rng.standard_normal((n, *cell)).astype(dtype)
+
+
+@pytest.mark.parametrize(
+    "dtype,cell,nb",
+    list(itertools.product(DTYPES, CELLS, BLOCKS)),
+    ids=lambda v: str(getattr(v, "__name__", v)),
+)
+def test_map_and_reduce_sweep(dtype, cell, nb):
+    rng = np.random.default_rng(hash((str(dtype), cell, nb)) % 2**32)
+    n = 25
+    x = _mk(rng, n, cell, dtype)
+    frame = tfs.frame_from_arrays({"x": x}, num_blocks=nb)
+
+    # map_blocks: elementwise double, dtype preserved
+    out = tfs.map_blocks(lambda x: {"y": x + x}, frame)
+    y = out.column_values("y")
+    assert y.dtype == dtype
+    np.testing.assert_array_equal(y, x + x)
+
+    # map_rows: per-row sum cell → scalar
+    if cell:
+        rsum = tfs.map_rows(lambda x: {"s": x.sum()}, frame)
+        np.testing.assert_allclose(
+            rsum.column_values("s"), x.sum(axis=1), rtol=1e-5
+        )
+
+    # reduce_blocks: total sum via the x_input contract. jnp.sum promotes
+    # int32 → int64 under x64, and the fetch/input dtype contract (no
+    # implicit casting, ≙ datatypes.scala:155-161) rightly rejects that —
+    # reducers must state their accumulation dtype.
+    tot = tfs.reduce_blocks(
+        lambda x_input: {"x": x_input.sum(axis=0, dtype=x_input.dtype)}, frame
+    )
+    np.testing.assert_allclose(np.asarray(tot), x.sum(axis=0), rtol=1e-5)
+
+    # reduce_rows: pairwise max
+    mx = tfs.reduce_rows(
+        lambda x_1, x_2: {"x": jnp.maximum(x_1, x_2)}, frame
+    )
+    np.testing.assert_array_equal(np.asarray(mx), x.max(axis=0))
+
+
+@pytest.mark.parametrize("nb", BLOCKS)
+def test_aggregate_sweep(nb):
+    rng = np.random.default_rng(nb)
+    n = 60
+    k = rng.integers(0, 7, n)
+    v = rng.standard_normal(n).astype(np.float32)
+    frame = tfs.frame_from_arrays({"k": k, "v": v}, num_blocks=nb)
+    agg = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(axis=0)}, frame.group_by("k")
+    )
+    got = {r["k"]: r["v"] for r in agg.collect()}
+    for key in np.unique(k):
+        assert got[int(key)] == pytest.approx(float(v[k == key].sum()), rel=1e-5)
+
+
+def test_sweep_device_residency():
+    """The same oracle holds for device frames (sharded over the mesh)."""
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal(64).astype(np.float32)
+    frame = tfs.frame_from_arrays({"x": x}).to_device()
+    out = tfs.map_blocks(lambda x: {"y": x * 3.0}, frame)
+    np.testing.assert_allclose(out.column_values("y"), x * 3.0, rtol=1e-6)
+    tot = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, frame)
+    assert float(tot) == pytest.approx(float(x.sum()), rel=1e-5)
