@@ -90,14 +90,25 @@ def segment_sum_pallas(
     )
 
     grid = (n_pad // _TILE_ROWS,)
+    # index maps derive EVERY component from the grid index: this package
+    # enables jax x64 at import, under which a literal ``0`` traces as an
+    # i64 constant next to the i32 grid index — Mosaic then fails to
+    # legalize the index map's mixed-type func.return
+    # ("(i32, i64) -> ()", observed on v5e). ``i - i`` is an i32 zero.
     out = pl.pallas_call(
         _seg_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TILE_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_TILE_ROWS, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (_TILE_ROWS, 1), lambda i: (i, i - i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (_TILE_ROWS, d_pad), lambda i: (i, i - i), memory_space=pltpu.VMEM
+            ),
         ],
-        out_specs=pl.BlockSpec((s_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec(
+            (s_pad, d_pad), lambda i: (i - i, i - i), memory_space=pltpu.VMEM
+        ),
         out_shape=jax.ShapeDtypeStruct((s_pad, d_pad), jnp.float32),
         interpret=interpret,
     )(segs, vals)
