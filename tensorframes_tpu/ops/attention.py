@@ -121,14 +121,21 @@ def flash_attention(
     """TPU pallas flash kernel when available, else blockwise fallback."""
     if jax.default_backend() in ("tpu", "axon"):
         try:
+            from jax.experimental import enable_x64
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as pallas_flash,
             )
 
             d = q.shape[-1]
-            return pallas_flash(
-                q, k, v, causal=causal, sm_scale=1.0 / np.sqrt(d)
-            )
+            # trace the kernel with x64 OFF: this package enables x64
+            # globally, under which integer literals in the upstream
+            # kernel's index maps trace as i64 beside i32 grid indices —
+            # the same Mosaic func.return legalization failure the
+            # segment kernel hit (see ops/segment.py)
+            with enable_x64(False):
+                return pallas_flash(
+                    q, k, v, causal=causal, sm_scale=1.0 / np.sqrt(d)
+                )
         except Exception:  # pragma: no cover - kernel/backend mismatch
             pass
     return blockwise_attention(q, k, v, causal=causal, block_size=block_size)
