@@ -31,6 +31,12 @@ def score_images(
     return tfs.map_blocks(program, frame)
 
 
+def score_images_int8(frame, cfg, params, **kw):
+    """Same scoring with weight-only int8 params (4× less weight HBM
+    traffic; see ops/quantize.py)."""
+    return score_images(frame, cfg, inc.quantize_params(params), **kw)
+
+
 def _demo():  # pragma: no cover
     cfg = inc.tiny()
     params = inc.init_params(cfg, seed=0)

@@ -95,3 +95,18 @@ def test_text_generation_example():
     np.testing.assert_array_equal(
         np.stack([rows[0]["generated"], rows[1]["generated"]]), want
     )
+
+
+def test_image_inference_int8_example():
+    from examples import image_inference
+    from tensorframes_tpu.models import inception as inc
+
+    cfg = inc.tiny()
+    params = inc.init_params(cfg, seed=0)
+    images = inc.synthetic_images(cfg, 4, seed=0)
+    frame = tfs.frame_from_arrays({"images": images}, num_blocks=2)
+    out = image_inference.score_images_int8(frame, cfg, params, to_device=False)
+    rows = out.collect()
+    assert len(rows) == 4
+    scores = np.stack([r["scores"] for r in rows])
+    np.testing.assert_allclose(scores.sum(axis=1), 1.0, atol=1e-3)
