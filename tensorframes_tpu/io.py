@@ -160,8 +160,8 @@ def save_frame(frame, path: str) -> None:
             if not getattr(v, "is_fully_addressable", True):
                 raise ValueError(
                     f"save_frame: column {name!r} spans non-addressable "
-                    "devices (multi-host global array); gather per process "
-                    "or save process-local shards instead"
+                    "devices (multi-host global array); use "
+                    "save_frame_sharded/load_frame_sharded instead"
                 )
 
     dense: Dict[str, np.ndarray] = {}
@@ -269,3 +269,108 @@ def load_frame(path: str, num_blocks: Optional[int] = None):
     for lo, hi in _partition_bounds(n, k):
         blocks.append({name: v[lo:hi] for name, v in data.items()})
     return TensorFrame(blocks, Schema(infos))
+
+
+def save_frame_sharded(frame, path: str) -> str:
+    """Multi-host frame persistence: every process writes ITS OWN rows.
+
+    A global sharded frame spans processes, so no single process can
+    materialize it (``save_frame`` refuses). Instead each process writes
+    the rows of its addressable shards to ``path/part-<process_index>``
+    (atomic per part, via save_frame) and the set of parts reassembles
+    with :func:`load_frame_sharded`. Single-process frames degrade to
+    one part. Returns this process's part directory.
+
+    All processes must call this in lockstep (standard SPMD contract);
+    ``path`` is usually shared storage (NFS/GCS-fuse) in a real fleet.
+    """
+    import os
+
+    import jax
+
+    from .frame import TensorFrame
+    from .schema import Schema
+
+    pid = jax.process_index()
+    local_block: Dict[str, object] = {}
+    infos = []
+    for info in frame.schema:
+        parts = []
+        for b in frame.blocks():
+            v = b[info.name]
+            if isinstance(v, (list, np.ndarray)):
+                parts.append(v)
+            elif getattr(v, "is_fully_addressable", True):
+                parts.append(np.asarray(v))
+            else:
+                # concat this process's shards in row order, keeping ONE
+                # replica per row-range: meshes with non-batch axes
+                # replicate each row-shard across them (same index,
+                # replica_id > 0) and must not duplicate rows
+                shards = sorted(
+                    (s for s in v.addressable_shards if s.replica_id == 0),
+                    key=lambda s: s.index[0].start or 0,
+                )
+                parts.append(
+                    np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+                )
+        if isinstance(parts[0], list):
+            flat: list = []
+            for p in parts:
+                flat.extend(list(p))
+            local_block[info.name] = flat
+        else:
+            local_block[info.name] = np.concatenate(
+                [np.asarray(p) for p in parts], axis=0
+            )
+        infos.append(info)
+    part = os.path.join(path, f"part-{pid}")
+    os.makedirs(path, exist_ok=True)
+    save_frame(TensorFrame([local_block], Schema(infos)), part)
+    # every process writes the identical meta (benign race) so a reload
+    # under a different process count fails loudly instead of dropping parts
+    import json
+
+    with open(os.path.join(path, "parts.json"), "w") as f:
+        json.dump({"num_parts": jax.process_count()}, f)
+    return part
+
+
+def load_frame_sharded(path: str, mesh=None, axis: Optional[str] = None):
+    """Load this process's ``part-<process_index>`` written by
+    :func:`save_frame_sharded` and reassemble the GLOBAL sharded frame
+    (``parallel.frame_from_process_local``). Host-only columns are not
+    supported across processes (same rule as frame_from_process_local)."""
+    import os
+
+    import jax
+
+    from .parallel.distributed import frame_from_process_local
+
+    import json
+
+    meta_path = os.path.join(path, "parts.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            num_parts = json.load(f)["num_parts"]
+        if num_parts != jax.process_count():
+            raise ValueError(
+                f"load_frame_sharded: saved with {num_parts} process(es) "
+                f"but loading with {jax.process_count()}; part counts must "
+                "match (repartition via a single-process load_frame of "
+                "each part instead)"
+            )
+    part = os.path.join(path, f"part-{jax.process_index()}")
+    local = load_frame(part, num_blocks=1)
+    [block] = local.blocks()
+    data = {}
+    for info in local.schema:
+        v = block[info.name]
+        if isinstance(v, list):
+            raise TypeError(
+                f"Column {info.name!r}: host-only columns cannot span "
+                "processes; drop them before save_frame_sharded or load "
+                "the part directly with load_frame"
+            )
+        data[info.name] = v
+    return frame_from_process_local(data, mesh=mesh, axis=axis)

@@ -75,6 +75,13 @@ with tfs.with_graph():
 got = {{r["k"]: r["v"] for r in agg.collect()}}
 # p0 contributes k=0:1.0, k=1:2.0; p1 contributes k=1:11.0, k=2:12.0
 assert got == {{0: 1.0, 1: 13.0, 2: 12.0}}, got
+# sharded persistence: each process writes its part, reloads, and the
+# reassembled global frame reduces to the same total across hosts
+sf_dir = {sf_dir!r}
+tfs.io.save_frame_sharded(frame, sf_dir)
+back = tfs.io.load_frame_sharded(sf_dir, mesh=mesh, axis="dp")
+s2 = tfs.reduce_blocks(lambda v_input: {{"v": v_input.sum(axis=0)}}, back)
+assert float(s2) == (1 + 2 + 11 + 12), float(s2)
 print(f"proc {{sys.argv[1]}} OK total={{float(total)}} frame_sum={{float(s)}}", flush=True)
 """
 
@@ -89,7 +96,9 @@ def test_two_process_psum(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     coord = f"localhost:{_free_port()}"
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER.format(repo=repo, coord=coord))
+    script.write_text(
+        _WORKER.format(repo=repo, coord=coord, sf_dir=str(tmp_path / "sf"))
+    )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"

@@ -212,3 +212,17 @@ def test_save_trailing_slash(tmp_path):
     tfs.frame_from_arrays({"x": np.arange(5, dtype=np.float32)}).save(p + "/")
     back = tfs.load_frame(p)
     np.testing.assert_array_equal(back.column_values("x"), np.arange(5, dtype=np.float32))
+
+
+def test_sharded_save_load_single_process(tmp_path):
+    """save_frame_sharded/load_frame_sharded degrade to one part on a
+    single process and round-trip through the verbs."""
+    x = np.arange(64, dtype=np.float32)
+    fr = tfs.frame_from_arrays({"x": x}).to_device()
+    part = tfs.io.save_frame_sharded(fr, str(tmp_path / "sf"))
+    assert part.endswith("part-0")
+    back = tfs.io.load_frame_sharded(str(tmp_path / "sf"))
+    assert back.is_sharded
+    np.testing.assert_array_equal(np.asarray(back.column_values("x")), x)
+    tot = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, back)
+    assert float(tot) == float(x.sum())
