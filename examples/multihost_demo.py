@@ -1,0 +1,89 @@
+"""Multi-process (multi-host) demo: launch N OS processes, build a global
+sharded frame from per-process rows, and run verbs whose reductions cross
+process boundaries through compiler collectives.
+
+This is the user-facing shape of what a Spark user did with a cluster:
+one process per host (here: per local process, each pinned to one CPU
+device), `init_distributed` as the cluster join, `frame_from_process_local`
+as "my partition lives on this executor", sharded persistence as the
+output sink.
+
+Run: ``python -m examples.multihost_demo`` (spawns 2 worker processes).
+On a real TPU fleet the launcher is your orchestrator (GKE/xmanager);
+each worker runs ``worker_main`` with the coordinator address set.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+
+def worker_main(coordinator: str, num_processes: int, process_id: int) -> None:
+    """What each host runs. On TPU pods, jax.distributed picks up the
+    topology automatically; args are explicit here for the local demo."""
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import parallel
+
+    parallel.init_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(jax.devices(), ("dp",))
+    pid = parallel.process_index()
+
+    # each process contributes ITS rows; the frame is global
+    local_rows = np.asarray([100.0 * pid + r for r in range(4)])
+    frame = parallel.frame_from_process_local(
+        {"v": local_rows}, mesh=mesh, axis="dp"
+    )
+
+    doubled = tfs.map_blocks(lambda v: {"w": v * 2.0}, frame)
+    total = tfs.reduce_blocks(
+        lambda w_input: {"w": w_input.sum(axis=0)}, doubled
+    )
+    print(f"[proc {pid}] global rows={frame.num_rows} total(w)={float(total)}")
+
+
+def main() -> None:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    n = 2
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import sys; sys.path.insert(0, {root!r});"
+        "from examples.multihost_demo import worker_main;"
+        "worker_main({coord!r}, {n}, int(sys.argv[1]))"
+    ).format(root=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             coord=coord, n=n)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, str(i)], env=env)
+        for i in range(n)
+    ]
+    try:
+        codes = [p.wait(timeout=120) for p in procs]
+        if any(codes):
+            raise SystemExit(f"worker exit codes: {codes}")
+    finally:
+        # a hung coordinator rendezvous must not orphan workers
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -110,3 +110,19 @@ def test_image_inference_int8_example():
     assert len(rows) == 4
     scores = np.stack([r["scores"] for r in rows])
     np.testing.assert_allclose(scores.sum(axis=1), 1.0, atol=1e-3)
+
+
+def test_multihost_demo_end_to_end():
+    """Run the demo launcher for real: two OS processes rendezvous and
+    print the same cross-process total."""
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, "-m", "examples.multihost_demo"],
+        capture_output=True,
+        text=True,
+        timeout=150,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert r.stdout.count("total(w)=824.0") == 2, r.stdout
