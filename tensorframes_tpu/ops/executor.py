@@ -61,6 +61,18 @@ class CompiledProgram:
         out = self.jit_block({k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in out.items()}
 
+    def cache_sizes(self) -> Dict[str, int]:
+        """Honest recompile accounting (SURVEY §7 hard-part 1): how many
+        distinct shapes each entrypoint has compiled for. Ragged map_rows
+        grows the block cache by one per distinct cell shape."""
+        def size(fn) -> int:
+            try:
+                return int(fn._cache_size())
+            except Exception:  # pragma: no cover - jax internals moved
+                return -1
+
+        return {"block": size(self.jit_block), "vmap": size(self.jit_vmap)}
+
 
 def gather_feeds(
     block: Dict[str, object],

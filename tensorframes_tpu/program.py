@@ -145,7 +145,14 @@ class Program:
     def explain(self) -> str:
         ins = ", ".join(s.pretty() for s in self.inputs)
         outs = ", ".join(s.pretty() for s in self.outputs)
-        return f"Program(inputs=[{ins}], outputs=[{outs}])"
+        extra = ""
+        if self._compiled is not None:
+            sizes = self._compiled.cache_sizes()
+            extra = (
+                f", compiled_shapes={{block: {sizes['block']}, "
+                f"vmap: {sizes['vmap']}}}"
+            )
+        return f"Program(inputs=[{ins}], outputs=[{outs}]{extra})"
 
     def cost_analysis(self, probe: int = 8) -> Dict[str, float]:
         """XLA's compiled cost model for this program: flops, bytes

@@ -226,3 +226,16 @@ def test_sharded_reduce_rows_after_trim_falls_back():
     trimmed = tfs.map_blocks(lambda x: {"x": x[:5]}, dev, trim=True)
     got = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, trimmed)
     assert float(got) == float(np.arange(5).sum())
+
+
+def test_tiny_frame_to_device_all_tail():
+    """Fewer rows than devices: the sharded main block is empty and all
+    rows live in the host tail; every verb must still answer."""
+    import tensorframes_tpu as tfs
+
+    fr = tfs.frame_from_arrays({"x": np.arange(3, dtype=np.float32)}).to_device()
+    assert fr.num_rows == 3
+    out = tfs.map_blocks(lambda x: {"y": x * 2.0}, fr)
+    assert [r["y"] for r in out.collect()] == [0.0, 2.0, 4.0]
+    assert float(tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, fr)) == 3.0
+    assert float(tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, fr)) == 3.0

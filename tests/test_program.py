@@ -147,3 +147,20 @@ def test_cost_analysis():
     costs = program.cost_analysis(probe=16)
     assert isinstance(costs, dict) and costs
     assert any("flops" in k for k in costs), sorted(costs)[:10]
+
+
+def test_recompile_accounting():
+    """Ragged map_rows compiles once per distinct cell shape; the cache
+    sizes are queryable (honest recompile accounting, SURVEY §7)."""
+    import tensorframes_tpu as tfs
+
+    rows = [{"v": [1.0, 2.0]}, {"v": [3.0]}, {"v": [4.0, 5.0, 6.0]},
+            {"v": [7.0]}]
+    frame = tfs.frame_from_rows(rows, num_blocks=1)
+    program = tfs.compile_program(
+        lambda v: {"s": v.sum()}, frame, block=False
+    )
+    tfs.map_rows(program, frame).collect()
+    sizes = program.compiled().cache_sizes()
+    assert sizes["block"] == 3  # cell shapes (2,), (1,), (3,)
+    assert "compiled_shapes" in program.explain()
