@@ -188,6 +188,30 @@ def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
     return _time_rows_per_sec(run_once, batch * new, iters)
 
 
+def _bench_read_csv(n_rows: int = 1_000_000):
+    """CSV → frame ingestion (native C++ single-pass parser), s/call."""
+    import os
+    import tempfile
+
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1000, n_rows)
+    b = rng.standard_normal(n_rows)
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write("a,b\n")
+            f.write("\n".join(f"{x},{y:.6f}" for x, y in zip(a, b)))
+        t0 = time.perf_counter()
+        frame = tfs.read_csv(path)
+        dt = time.perf_counter() - t0
+        assert frame.num_rows == n_rows
+        return dt
+    finally:
+        os.remove(path)
+
+
 def _bench_convert(n_rows: int = 1_000_000):
     """Row→columnar convert + back (re-enabled equivalents of the
     reference's disabled µbenches, ConvertPerformanceSuite/
@@ -371,11 +395,13 @@ def main():
     convert_s, convertback_s = _try(
         "convert", _bench_convert, (float("nan"), float("nan"))
     )
+    read_csv_s = _try("read_csv", _bench_read_csv, float("nan"))
 
     print(f"# chips={n_chips} devices={jax.devices()}")
     print(f"# native_marshalling={'on' if native.available() else 'off'}")
     print(f"# convert_1M_int_rows_s={convert_s:.4f}")
     print(f"# convertback_1M_int_cells_s={convertback_s:.4f}")
+    print(f"# read_csv_1M_rows_s={read_csv_s:.4f}")
     print(f"# add3_map_blocks_rows_per_sec={add3_rps:.0f}")
     print(f"# reduce_blocks_1M_wall_s={reduce_s:.4f}")
     print(f"# aggregate_1M_512groups_wall_s={aggregate_s:.4f}")

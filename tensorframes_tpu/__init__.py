@@ -83,7 +83,7 @@ from .ops.verbs import (  # noqa: E402,F401
 from .checkpoint import Checkpointer  # noqa: E402,F401
 from .training import run_resumable  # noqa: E402,F401
 from . import io  # noqa: E402,F401
-from .io import load_frame, save_frame  # noqa: E402,F401
+from .io import load_frame, read_csv, save_frame  # noqa: E402,F401
 from .utils import profiling  # noqa: E402,F401
 
 __version__ = "0.1.0"
@@ -117,6 +117,7 @@ __all__ = [
     "io",
     "save_frame",
     "load_frame",
+    "read_csv",
     # dsl / placeholder helpers
     "Node",
     "block",

@@ -16,7 +16,9 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 namespace {
@@ -214,11 +216,170 @@ done:
   return result;  // nullptr on error (exception set)
 }
 
+// parse_csv(data: bytes, delim: byte, codes: tuple[int]) -> tuple
+//
+// One native pass over unquoted CSV bytes (the Python wrapper detects
+// quoting and takes the csv-module path instead). Per column code:
+// F64 -> bytearray of packed doubles (empty field = NaN), I64 ->
+// bytearray of packed int64, STR(4) -> list[str]. Rows end at '\n'
+// (optional '\r' stripped); a missing trailing newline is fine.
+constexpr int STR_CODE = 4;
+
+PyObject* parse_csv(PyObject*, PyObject* args) {
+  Py_buffer data;
+  int delim_i;
+  PyObject* codes_obj;
+  if (!PyArg_ParseTuple(args, "y*iO", &data, &delim_i, &codes_obj)) {
+    return nullptr;
+  }
+  const char delim = static_cast<char>(delim_i);
+  PyObject* codes_fast =
+      PySequence_Fast(codes_obj, "codes must be a sequence");
+  if (codes_fast == nullptr) {
+    PyBuffer_Release(&data);
+    return nullptr;
+  }
+  const Py_ssize_t ncols = PySequence_Fast_GET_SIZE(codes_fast);
+  int* codes = new int[ncols];
+  for (Py_ssize_t j = 0; j < ncols; ++j) {
+    codes[j] =
+        static_cast<int>(PyLong_AsLong(PySequence_Fast_GET_ITEM(codes_fast, j)));
+  }
+  Py_DECREF(codes_fast);
+
+  // estimate rows (newline count + a possible unterminated last line) so
+  // numeric buffers allocate once instead of O(rows) reallocs
+  const char* scan = static_cast<const char*>(data.buf);
+  const char* scan_end = scan + data.len;
+  Py_ssize_t est = (data.len > 0 && scan_end[-1] != '\n') ? 1 : 0;
+  for (const char* q = scan; q < scan_end; ++q) {
+    if (*q == '\n') ++est;
+  }
+
+  // column outputs
+  PyObject** outs = new PyObject*[ncols]();
+  bool ok = true;
+  for (Py_ssize_t j = 0; j < ncols && ok; ++j) {
+    outs[j] = (codes[j] == STR_CODE)
+                  ? PyList_New(0)
+                  : PyByteArray_FromStringAndSize(nullptr, est * 8);
+    if (outs[j] == nullptr) ok = false;
+  }
+
+  const char* p = static_cast<const char*>(data.buf);
+  const char* end = p + data.len;
+  char numbuf[64];
+  long long nrow = 0;
+  while (ok && p < end) {
+    // skip blank lines, LF or CRLF (the csv-module fallback drops them)
+    if (*p == '\n') { ++p; continue; }
+    if (*p == '\r' && p + 1 < end && p[1] == '\n') { p += 2; continue; }
+    for (Py_ssize_t j = 0; j < ncols && ok; ++j) {
+      const char* f = p;
+      while (p < end && *p != delim && *p != '\n') ++p;
+      const char* fe = p;
+      if (fe > f && fe[-1] == '\r') --fe;
+      const size_t flen = static_cast<size_t>(fe - f);
+      if (codes[j] == STR_CODE) {
+        PyObject* s = PyUnicode_DecodeUTF8(f, static_cast<Py_ssize_t>(flen),
+                                           "replace");
+        if (s == nullptr || PyList_Append(outs[j], s) != 0) {
+          Py_XDECREF(s);
+          ok = false;
+          break;
+        }
+        Py_DECREF(s);
+      } else {
+        if (flen >= sizeof(numbuf)) {
+          PyErr_Format(PyExc_ValueError,
+                       "csv row %lld col %zd: field too long", nrow, j);
+          ok = false;
+          break;
+        }
+        std::memcpy(numbuf, f, flen);
+        numbuf[flen] = '\0';
+        if (codes[j] == F64) {
+          double v;
+          if (flen == 0) {
+            v = __builtin_nan("");
+          } else {
+            char* ep = nullptr;
+            v = strtod(numbuf, &ep);
+            if (ep != numbuf + flen) {
+              PyErr_Format(PyExc_ValueError,
+                           "csv row %lld col %zd: bad float %.60s", nrow, j,
+                           numbuf);
+              ok = false;
+              break;
+            }
+          }
+          std::memcpy(PyByteArray_AS_STRING(outs[j]) + nrow * 8, &v, 8);
+        } else {  // I64
+          char* ep = nullptr;
+          errno = 0;
+          long long v = strtoll(numbuf, &ep, 10);
+          if (errno == ERANGE) {
+            PyErr_Format(PyExc_OverflowError,
+                         "csv row %lld col %zd: %.60s out of int64 range",
+                         nrow, j, numbuf);
+            ok = false;
+            break;
+          }
+          if (flen == 0 || ep != numbuf + flen) {
+            PyErr_Format(PyExc_ValueError,
+                         "csv row %lld col %zd: bad int %.60s", nrow, j,
+                         numbuf);
+            ok = false;
+            break;
+          }
+          int64_t v64 = static_cast<int64_t>(v);
+          std::memcpy(PyByteArray_AS_STRING(outs[j]) + nrow * 8, &v64, 8);
+        }
+      }
+      // advance past the delimiter (not past the newline)
+      if (p < end && *p == delim && j + 1 < ncols) ++p;
+    }
+    if (!ok) break;
+    // drop any extra fields beyond the header's columns (the csv-module
+    // fallback ignores them too) — without this the leftover text would
+    // be re-parsed as phantom rows PAST the preallocated buffers
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;  // consume the newline
+    ++nrow;
+  }
+
+  // shrink numeric buffers to the actual row count (empty lines skipped)
+  for (Py_ssize_t j = 0; j < ncols && ok; ++j) {
+    if (codes[j] != STR_CODE && PyByteArray_GET_SIZE(outs[j]) != nrow * 8) {
+      if (PyByteArray_Resize(outs[j], nrow * 8) != 0) ok = false;
+    }
+  }
+
+  PyObject* result = nullptr;
+  if (ok) {
+    result = PyTuple_New(ncols + 1);
+    if (result != nullptr) {
+      for (Py_ssize_t j = 0; j < ncols; ++j) {
+        PyTuple_SET_ITEM(result, j, outs[j]);  // steals
+        outs[j] = nullptr;
+      }
+      PyTuple_SET_ITEM(result, ncols, PyLong_FromLongLong(nrow));
+    }
+  }
+  for (Py_ssize_t j = 0; j < ncols; ++j) Py_XDECREF(outs[j]);
+  delete[] outs;
+  delete[] codes;
+  PyBuffer_Release(&data);
+  return result;
+}
+
 PyMethodDef methods[] = {
     {"gather_column", gather_column, METH_VARARGS,
      "gather_column(rows, name, dtype_code) -> bytearray of packed cells"},
     {"scatter_rows", scatter_rows, METH_VARARGS,
      "scatter_rows(names, buffers, dtype_codes) -> list of row dicts"},
+    {"parse_csv", parse_csv, METH_VARARGS,
+     "parse_csv(data, delim_byte, codes) -> (*columns, nrows)"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_rowpack",

@@ -226,3 +226,109 @@ def test_sharded_save_load_single_process(tmp_path):
     np.testing.assert_array_equal(np.asarray(back.column_values("x")), x)
     tot = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, back)
     assert float(tot) == float(x.sum())
+
+
+# ---------------------------------------------------------------------------
+# CSV ingestion
+# ---------------------------------------------------------------------------
+
+def _write(p, text):
+    p.write_text(text)
+    return str(p)
+
+
+def test_read_csv_native_types(tmp_path):
+    path = _write(
+        tmp_path / "t.csv",
+        "id,score,name\n1,0.5,alpha\n2,1.25,beta\n3,,gamma\n",
+    )
+    fr = tfs.read_csv(path)
+    assert fr.schema["id"].dtype.name == "int64"
+    assert fr.schema["score"].dtype.name == "float64"
+    np.testing.assert_array_equal(fr.column_values("id"), [1, 2, 3])
+    sc = fr.column_values("score")
+    assert sc[0] == 0.5 and sc[1] == 1.25 and np.isnan(sc[2])
+    assert [r["name"] for r in fr.collect()] == ["alpha", "beta", "gamma"]
+    # and the frame runs through the verbs
+    out = tfs.map_blocks(lambda id: {"id2": id * 2}, fr)
+    np.testing.assert_array_equal(out.column_values("id2"), [2, 4, 6])
+
+
+def test_read_csv_quoted_falls_back(tmp_path):
+    path = _write(
+        tmp_path / "q.csv",
+        'k,txt\n1,"hello, world"\n2,"line"\n',
+    )
+    fr = tfs.read_csv(path)
+    np.testing.assert_array_equal(fr.column_values("k"), [1, 2])
+    assert [r["txt"] for r in fr.collect()] == ["hello, world", "line"]
+
+
+def test_read_csv_native_matches_python(tmp_path):
+    import tensorframes_tpu.io as tio
+    from tensorframes_tpu import native
+
+    rng = np.random.default_rng(0)
+    n = 500
+    lines = ["a,b,c"]
+    for i in range(n):
+        lines.append(f"{rng.integers(-5, 5)},{rng.standard_normal():.6f},s{i}")
+    path = _write(tmp_path / "p.csv", "\n".join(lines) + "\n")
+
+    fr_native = tfs.read_csv(path)
+    import unittest.mock as mock
+
+    with mock.patch.object(native, "available", lambda: False):
+        fr_python = tfs.read_csv(path)
+    for col in ("a", "b"):
+        np.testing.assert_allclose(
+            fr_native.column_values(col), fr_python.column_values(col)
+        )
+    assert [r["c"] for r in fr_native.collect()] == [
+        r["c"] for r in fr_python.collect()
+    ]
+
+
+def test_read_csv_dtype_override_and_errors(tmp_path):
+    path = _write(tmp_path / "o.csv", "a\n1\n2\n")
+    fr = tfs.read_csv(path, dtypes={"a": "float64"})
+    assert fr.schema["a"].dtype.name == "float64"
+    bad = _write(tmp_path / "bad.csv", "a\n1\nnope\n")
+    with pytest.raises(ValueError):
+        tfs.read_csv(bad, dtypes={"a": "int64"})
+
+
+def test_read_csv_empty_and_crlf(tmp_path):
+    empty = _write(tmp_path / "e.csv", "x,y\n")
+    fr = tfs.read_csv(empty)
+    assert fr.num_rows == 0 and fr.columns == ["x", "y"]
+    crlf = _write(tmp_path / "c.csv", "x,s\r\n7,hi\r\n8,yo\r\n")
+    fr2 = tfs.read_csv(crlf)
+    np.testing.assert_array_equal(fr2.column_values("x"), [7, 8])
+    assert [r["s"] for r in fr2.collect()] == ["hi", "yo"]
+
+
+def test_read_csv_malformed_and_edge_rows(tmp_path):
+    # extra fields beyond the header are dropped (no phantom rows)
+    p = _write(tmp_path / "x.csv", "a,b\n1.0,2.0,3.0,4.0\n5.0,6.0\n")
+    fr = tfs.read_csv(p)
+    assert fr.num_rows == 2
+    np.testing.assert_array_equal(fr.column_values("a"), [1.0, 5.0])
+    np.testing.assert_array_equal(fr.column_values("b"), [2.0, 6.0])
+    # int64 overflow errors instead of silently clamping
+    p2 = _write(tmp_path / "o.csv", "a\n99999999999999999999\n")
+    with pytest.raises((OverflowError, ValueError)):
+        tfs.read_csv(p2, dtypes={"a": "int64"})
+    # CRLF blank lines are skipped like the csv-module path
+    p3 = _write(tmp_path / "b.csv", "a,s\r\n1,x\r\n\r\n2,y\r\n")
+    fr3 = tfs.read_csv(p3)
+    assert fr3.num_rows == 2
+    np.testing.assert_array_equal(fr3.column_values("a"), [1, 2])
+
+
+def test_read_csv_header_only_with_override(tmp_path):
+    p = _write(tmp_path / "h.csv", "id,name\n")
+    fr = tfs.read_csv(p, dtypes={"name": "string", "id": "int64"})
+    assert fr.num_rows == 0
+    assert fr.schema["id"].dtype.name == "int64"
+    assert fr.schema["name"].dtype.name == "string"
