@@ -83,7 +83,16 @@ from .ops.verbs import (  # noqa: E402,F401
 from .checkpoint import Checkpointer  # noqa: E402,F401
 from .training import run_resumable  # noqa: E402,F401
 from . import io  # noqa: E402,F401
-from .io import load_frame, read_csv, save_frame  # noqa: E402,F401
+from .io import (  # noqa: E402,F401
+    frame_from_arrow,
+    frame_to_arrow,
+    load_frame,
+    read_csv,
+    read_parquet,
+    save_frame,
+    write_csv,
+    write_parquet,
+)
 from .utils import profiling  # noqa: E402,F401
 
 __version__ = "0.1.0"
@@ -118,6 +127,11 @@ __all__ = [
     "save_frame",
     "load_frame",
     "read_csv",
+    "write_csv",
+    "frame_from_arrow",
+    "frame_to_arrow",
+    "read_parquet",
+    "write_parquet",
     # dsl / placeholder helpers
     "Node",
     "block",

@@ -304,7 +304,10 @@ PyObject* parse_csv(PyObject*, PyObject* args) {
             v = __builtin_nan("");
           } else {
             char* ep = nullptr;
-            v = strtod(numbuf, &ep);
+            // PyOS_string_to_double is locale-independent (strtod honours
+            // LC_NUMERIC and would reject '0.5' under comma-decimal locales)
+            v = PyOS_string_to_double(numbuf, &ep, nullptr);
+            if (v == -1.0 && PyErr_Occurred()) PyErr_Clear();
             if (ep != numbuf + flen) {
               PyErr_Format(PyExc_ValueError,
                            "csv row %lld col %zd: bad float %.60s", nrow, j,

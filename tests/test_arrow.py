@@ -1,0 +1,66 @@
+"""Arrow / Parquet interop tests (skipped as a unit when pyarrow is
+absent — pyarrow is an optional dependency)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+
+# ---------------------------------------------------------------------------
+# Arrow / Parquet interop
+# ---------------------------------------------------------------------------
+
+pa = pytest.importorskip("pyarrow")
+
+
+def test_arrow_roundtrip_zero_copy():
+    t = pa.table(
+        {
+            "i": pa.array(np.arange(6)),
+            "f": pa.array(np.linspace(0, 1, 6)),
+            "s": pa.array([f"r{i}" for i in range(6)]),
+        }
+    )
+    fr = tfs.frame_from_arrow(t, num_blocks=2)
+    np.testing.assert_array_equal(fr.column_values("i"), np.arange(6))
+    assert [r["s"] for r in fr.collect()] == [f"r{i}" for i in range(6)]
+    back = tfs.frame_to_arrow(fr)
+    assert back.column_names == ["i", "f", "s"]
+    np.testing.assert_array_equal(back.column("i").to_numpy(), np.arange(6))
+
+
+def test_arrow_list_columns_and_verbs():
+    t = pa.table({"v": pa.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])})
+    fr = tfs.frame_from_arrow(t)
+    out = tfs.map_blocks(lambda v: {"s": v.sum(axis=1)}, fr)
+    np.testing.assert_allclose(out.column_values("s"), [3.0, 7.0, 11.0])
+
+
+def test_arrow_null_int_rejected():
+    t = pa.table({"i": pa.array([1, None, 3])})
+    with pytest.raises(ValueError, match="nulls"):
+        tfs.frame_from_arrow(t)
+    # null floats become NaN
+    tf2 = tfs.frame_from_arrow(pa.table({"f": pa.array([1.0, None])}))
+    vals = tf2.column_values("f")
+    assert vals[0] == 1.0 and np.isnan(vals[1])
+
+
+def test_parquet_roundtrip(tmp_path):
+    d = {
+        "i": np.arange(10),
+        "f": np.linspace(0, 1, 10),
+        "s": [f"n{i}" for i in range(10)],
+        "vec": np.arange(20.0).reshape(10, 2),
+    }
+    fr = tfs.frame_from_arrays(d)
+    path = str(tmp_path / "t.parquet")
+    tfs.write_parquet(fr, path)
+    back = tfs.read_parquet(path, num_blocks=3)
+    np.testing.assert_array_equal(back.column_values("i"), d["i"])
+    np.testing.assert_allclose(
+        np.stack([np.asarray(r["vec"]) for r in back.collect()]), d["vec"]
+    )
+    # frames from parquet run through the verbs
+    tot = tfs.reduce_blocks(lambda f_input: {"f": f_input.sum(axis=0)}, back)
+    assert float(tot) == pytest.approx(float(d["f"].sum()))

@@ -332,3 +332,42 @@ def test_read_csv_header_only_with_override(tmp_path):
     assert fr.num_rows == 0
     assert fr.schema["id"].dtype.name == "int64"
     assert fr.schema["name"].dtype.name == "string"
+
+
+def test_write_csv_roundtrip(tmp_path):
+    d = {
+        "i": np.arange(5),
+        "f": np.linspace(0, 1, 5),
+        "s": [f"n{i}" for i in range(5)],
+    }
+    fr = tfs.frame_from_arrays(d)
+    path = str(tmp_path / "out.csv")
+    tfs.write_csv(fr, path)
+    back = tfs.read_csv(path)
+    np.testing.assert_array_equal(back.column_values("i"), d["i"])
+    np.testing.assert_allclose(back.column_values("f"), d["f"])
+    assert [r["s"] for r in back.collect()] == d["s"]
+    with pytest.raises(ValueError, match="scalar columns"):
+        tfs.write_csv(
+            tfs.frame_from_arrays({"m": np.ones((3, 2))}), str(tmp_path / "m.csv")
+        )
+
+
+def test_read_csv_quoted_header_and_inference(tmp_path):
+    """Quoted headers/samples go through real csv parsing (not naive
+    split), so quoted fields with delimiters don't corrupt names/types."""
+    p = _write(
+        tmp_path / "qh.csv",
+        'name,score\n"Doe, Jane",5\n"Roe, Rich",7\n',
+    )
+    fr = tfs.read_csv(p)
+    assert fr.columns == ["name", "score"]
+    assert fr.schema["score"].dtype.name == "int64"
+    np.testing.assert_array_equal(fr.column_values("score"), [5, 7])
+    assert [r["name"] for r in fr.collect()] == ["Doe, Jane", "Roe, Rich"]
+
+
+def test_read_csv_bad_dtype_override_raises(tmp_path):
+    p = _write(tmp_path / "d.csv", "a\n1\n")
+    with pytest.raises(ValueError, match="unsupported dtype"):
+        tfs.read_csv(p, dtypes={"a": "int32"})
