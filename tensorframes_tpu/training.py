@@ -30,6 +30,7 @@ def run_resumable(
     num_steps: int,
     save_every: int = 100,
     on_step: Optional[Callable[[int, Any], None]] = None,
+    skip_consumed: bool = True,
 ) -> Tuple[Any, int]:
     """Run up to ``num_steps`` of ``state, metrics = step_fn(state, batch)``,
     checkpointing every ``save_every`` steps and resuming from the latest
@@ -56,8 +57,10 @@ def run_resumable(
     it = iter(batches)
     # skip batches consumed before the preemption (deterministic replay);
     # a dataset shorter than the checkpointed progress is a caller bug and
-    # must not be silently absorbed
-    for i in range(start_step):
+    # must not be silently absorbed. Callers that pre-position the
+    # iterator (train_on_frame skips host-side, before any device
+    # transfer) pass skip_consumed=False.
+    for i in range(start_step if skip_consumed else 0):
         try:
             next(it)
         except StopIteration:
@@ -144,3 +147,89 @@ def make_grad_accum_step(
         return params, opt_state, l_sum / accum_steps
 
     return jax.jit(step)
+
+
+def train_on_frame(
+    step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+    init_state: Any,
+    frame,
+    columns,
+    batch_size: int,
+    num_steps: int,
+    checkpointer: Optional[Checkpointer] = None,
+    save_every: int = 100,
+    shuffle: bool = True,
+    seed: int = 0,
+    prefetch: int = 2,
+    on_step: Optional[Callable[[int, Any], None]] = None,
+) -> Tuple[Any, int]:
+    """Train straight off a frame: epoch-cycling minibatches from the
+    frame's columns (reshuffled per epoch), background host→device
+    prefetch, and — when a ``checkpointer`` is passed — preemption-safe
+    resume through :func:`run_resumable`.
+
+    This closes the loop the reference never had (inference-only): the
+    same columnar frame that feeds the verbs feeds a training step.
+    ``step_fn(state, batch)`` gets ``{column: device array[batch, ...]}``.
+    Batches are uniform (the per-epoch remainder is dropped) so one XLA
+    executable serves every step. ``on_step(i, metrics)`` receives the
+    GLOBAL step index — after a resume it continues from the checkpoint
+    (e.g. 701), matching ``run_resumable``.
+    """
+    import itertools
+
+    from .io import iterate_batches, prefetch_to_device
+
+    def batches():
+        epoch = 0
+        while True:
+            yield from iterate_batches(
+                frame,
+                columns,
+                batch_size=batch_size,
+                shuffle=shuffle,
+                seed=seed + epoch,
+                drop_remainder=True,
+            )
+            epoch += 1
+
+    raw = batches()
+    try:
+        if checkpointer is not None:
+            # fast-forward the replay HOST-SIDE before the prefetch wrapper
+            # exists, so resume never pays device transfers for batches it
+            # only discards
+            latest = checkpointer.latest_step() or 0
+            for _ in itertools.islice(raw, min(latest, num_steps)):
+                pass
+            stream = (
+                prefetch_to_device(raw, size=prefetch) if prefetch else raw
+            )
+            return run_resumable(
+                step_fn,
+                init_state,
+                checkpointer,
+                stream,
+                num_steps,
+                save_every=save_every,
+                on_step=on_step,
+                skip_consumed=False,
+            )
+        stream = prefetch_to_device(raw, size=prefetch) if prefetch else raw
+        state = init_state
+        ran = 0
+        for batch in itertools.islice(stream, num_steps):
+            state, metrics = step_fn(state, batch)
+            ran += 1
+            if on_step is not None:
+                on_step(ran, metrics)
+        return state, ran
+    finally:
+        # the epoch stream is infinite: close it (and the prefetch
+        # generator wrapping it) so the worker thread and its staged HBM
+        # buffers release now, not at GC time
+        try:
+            stream.close()  # type: ignore[union-attr]
+        except Exception:
+            pass
+        raw.close()
