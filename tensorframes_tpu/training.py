@@ -228,8 +228,18 @@ def train_on_frame(
         # the epoch stream is infinite: close it (and the prefetch
         # generator wrapping it) so the worker thread and its staged HBM
         # buffers release now, not at GC time
+        import time as _time
+
         try:
             stream.close()  # type: ignore[union-attr]
         except Exception:
             pass
-        raw.close()
+        # the prefetch worker may still be mid-next(raw) for an instant
+        # after its stop flag sets; retry briefly, then leave the
+        # suspended generator to GC (the worker has already exited)
+        for _ in range(100):
+            try:
+                raw.close()
+                break
+            except ValueError:
+                _time.sleep(0.01)

@@ -126,3 +126,21 @@ def test_multihost_demo_end_to_end():
     )
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.count("total(w)=824.0") == 2, r.stdout
+
+
+def test_train_logreg_example(tmp_path):
+    from examples import train_logreg
+    from tensorframes_tpu.models import logreg
+
+    x, y = logreg.make_synthetic_mnist(256, seed=0)
+    frame = tfs.frame_from_arrays({"features": x, "label_true": y})
+    params, losses = train_logreg.train(
+        frame, num_steps=15, checkpoint_dir=str(tmp_path)
+    )
+    assert len(losses) == 15
+    assert losses[-1] < losses[0]
+    # resume: asking for 20 total runs only the remaining 5
+    _, more = train_logreg.train(
+        frame, num_steps=20, checkpoint_dir=str(tmp_path)
+    )
+    assert len(more) == 5
