@@ -81,6 +81,7 @@ def _normalize_program(
     block: bool,
     reduce_mode: Optional[str] = None,
     feed_dict: Optional[Dict[str, str]] = None,
+    shape_hints: Optional[Dict[str, object]] = None,
 ) -> Tuple[Program, Optional[List[Tuple[str, str, str]]]]:
     """Accept DSL nodes / a python function / a Program; return an analyzed
     Program plus (for DSL reducer fetches) segment-lowering info.
@@ -128,7 +129,12 @@ def _normalize_program(
             "fetches must be a DSL Node, a list of Nodes, a Program, or a "
             f"callable; got {type(fetches).__name__}"
         )
-    program = analyze_program(program)
+    hints = (
+        {k: Shape.from_any(v) for k, v in shape_hints.items()}
+        if shape_hints
+        else None
+    )
+    program = analyze_program(program, hints=hints)
     program.seg_info = seg_info  # survives Program reuse via compile_program
     return program, seg_info
 
@@ -166,15 +172,25 @@ def compile_program(
     block: bool = True,
     reduce_mode: Optional[str] = None,
     feed_dict: Optional[Dict[str, str]] = None,
+    shape_hints: Optional[Dict[str, object]] = None,
 ) -> Program:
     """Pre-compile fetches against a frame's schema into a reusable Program.
 
     Passing the returned Program to a verb repeatedly reuses one XLA
     executable across calls (the jit cache lives on the Program), instead
     of re-tracing per invocation — the steady-state serving path.
+
+    ``shape_hints`` ({output name → shape}) override discovered output
+    shapes wherever the hint dim is known — the per-call shape side
+    channel (≙ ShapeDescription + the hint-override rule,
+    TensorFlowOps.scala:126-133).
     """
     program, _ = _normalize_program(
-        fetches, frame.schema, block=block, reduce_mode=reduce_mode
+        fetches,
+        frame.schema,
+        block=block,
+        reduce_mode=reduce_mode,
+        shape_hints=shape_hints,
     )
     return _apply_feed_dict(program, feed_dict)
 

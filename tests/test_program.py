@@ -164,3 +164,21 @@ def test_recompile_accounting():
     sizes = program.compiled().cache_sizes()
     assert sizes["block"] == 3  # cell shapes (2,), (1,), (3,)
     assert "compiled_shapes" in program.explain()
+
+
+def test_compile_program_shape_hints():
+    """Per-call output shape hints override discovery (≙ ShapeDescription
+    + the hint-override rule)."""
+    import tensorframes_tpu as tfs
+
+    import jax.numpy as jnp
+
+    frame = tfs.frame_from_arrays({"x": np.arange(12, dtype=np.float32)})
+    # outer product: analysis marks BOTH dims Unknown (they co-vary with
+    # the probe); the user knows the frame is 12 rows and pins dim 2
+    plain = tfs.compile_program(lambda x: {"y": jnp.outer(x, x)}, frame)
+    assert plain.output("y").shape.dims[-1] == tfs.Unknown
+    hinted = tfs.compile_program(
+        lambda x: {"y": jnp.outer(x, x)}, frame, shape_hints={"y": (None, 12)}
+    )
+    assert hinted.output("y").shape.dims[-1] == 12
