@@ -138,6 +138,13 @@ class TensorFrame:
             v = b[name]
             if isinstance(v, list):
                 v = np.asarray(v, dtype=object) if not info.is_device else np.asarray(v)
+            elif not getattr(v, "is_fully_addressable", True):
+                raise RuntimeError(
+                    f"Column {name!r} spans processes (multi-host global "
+                    "array); one process cannot materialize it. Reduce it "
+                    "with a verb (reduce_*/aggregate run as collectives), "
+                    "or persist per process with io.save_frame_sharded."
+                )
             parts.append(v)
         if not parts:
             return np.empty((0,), dtype=info.dtype.np_dtype)
