@@ -286,3 +286,14 @@ def make_ep_train_step(
         out_shardings=(shardings, None, NamedSharding(mesh, P())),
     )
     return jitted, data_sharding, shardings, init_opt
+
+
+def scoring_program(cfg: MoEConfig, params: Dict):
+    """map_blocks program: token-feature block [n, hidden] →
+    {"moe_out": [n, hidden]} — MoE inference through the same verb as
+    every other model family (params closure-captured ≙ frozen-graph)."""
+
+    def program(features):
+        return {"moe_out": moe_ffn(cfg, params, features)}
+
+    return program

@@ -86,3 +86,25 @@ def test_num_experts_must_divide_ep():
     x = jnp.zeros((8, 16), jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         moe.moe_ffn_ep(cfg, params, x, mesh)
+
+
+def test_moe_scoring_via_map_blocks():
+    import tensorframes_tpu as tfs
+
+    cfg = moe.MoEConfig(hidden=16, mlp_hidden=32, num_experts=4)
+    params = moe.init_moe_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((12, 16)).astype(np.float32)
+    df = tfs.frame_from_arrays({"features": x}, num_blocks=2)
+    out = tfs.map_blocks(
+        lambda features: moe.scoring_program(cfg, params)(features), df
+    )
+    y = np.stack([r["moe_out"] for r in out.collect()])
+    assert y.shape == (12, 16)
+    assert np.isfinite(y).all()
+    # block semantics: per-block routing equals direct per-block calls
+    blocks = df.blocks()
+    direct = np.concatenate(
+        [np.asarray(moe.moe_ffn(cfg, params, b["features"])) for b in blocks]
+    )
+    np.testing.assert_allclose(y, direct, rtol=1e-5, atol=1e-6)
