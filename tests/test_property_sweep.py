@@ -86,3 +86,25 @@ def test_sweep_device_residency():
     np.testing.assert_allclose(out.column_values("y"), x * 3.0, rtol=1e-6)
     tot = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, frame)
     assert float(tot) == pytest.approx(float(x.sum()), rel=1e-5)
+
+
+def test_bf16_map_and_reduce():
+    """bfloat16 columns ride the verbs end to end (device dtype in the
+    registry; numpy side via ml_dtypes)."""
+    import ml_dtypes
+
+    x = np.arange(32, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    frame = tfs.frame_from_arrays({"x": x})
+    assert frame.schema["x"].dtype.name == "bfloat16"
+    out = tfs.map_blocks(lambda x: {"y": x * 2}, frame)
+    got = out.column_values("y")
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        got.astype(np.float32), (x * 2).astype(np.float32)
+    )
+    tot = tfs.reduce_blocks(
+        lambda x_input: {"x": x_input.sum(axis=0, dtype=x_input.dtype)}, frame
+    )
+    assert float(np.asarray(tot).astype(np.float32)) == float(
+        x.astype(np.float32).sum()
+    )
