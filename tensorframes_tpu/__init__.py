@@ -31,7 +31,7 @@ if _get_config().enable_x64:
 from . import dtypes  # noqa: E402,F401
 from .shape import Shape, Unknown  # noqa: E402,F401
 from .schema import ColumnInfo, Schema  # noqa: E402,F401
-from .frame import TensorFrame, frame_from_arrays, frame_from_pandas, frame_from_rows  # noqa: E402,F401
+from .frame import TensorFrame, describe, frame_from_arrays, frame_from_pandas, frame_from_rows  # noqa: E402,F401
 from .frame import analyze, append_shape, print_schema, explain  # noqa: E402,F401
 from .dsl import (  # noqa: E402,F401
     Node,
@@ -119,6 +119,7 @@ __all__ = [
     "append_shape",
     "print_schema",
     "explain",
+    "describe",
     # aux subsystems
     "Checkpointer",
     "run_resumable",

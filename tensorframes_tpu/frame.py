@@ -743,3 +743,66 @@ def explain(frame: TensorFrame, detailed: bool = False) -> str:
 def print_schema(frame: TensorFrame) -> None:
     """≙ ``tfs.print_schema`` (core.py:355-364)."""
     print(explain(frame))
+
+
+def describe(frame: TensorFrame, columns: Optional[Sequence[str]] = None):
+    """Summary statistics per scalar numeric column — count, mean, std,
+    min, max (std via the sum/sum-of-squares identity, accumulated in
+    f64). One jitted stats program runs per block; on sharded frames the
+    block is a global array, so the stats reduce SPMD through compiler
+    collectives before the tiny per-block partials merge on the host.
+
+    Returns {column: {"count", "mean", "std", "min", "max"}} — the Spark
+    ``describe()`` affordance the reference's users had from the host
+    DataFrame API.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if columns is None:
+        columns = [
+            c.name
+            for c in frame.schema.device_columns
+            if c.cell_shape.rank == 0
+        ]
+    else:
+        for c in columns:
+            info = frame.schema[c]
+            if not info.is_device or info.cell_shape.rank != 0:
+                raise ValueError(
+                    f"describe: column {c!r} is not a scalar numeric column"
+                )
+    if not columns:
+        return {}
+
+    @jax.jit
+    def stats(v):
+        v = v.astype(jnp.float64)
+        return jnp.stack(
+            [v.sum(), (v * v).sum(), v.min(), v.max()]
+        )
+
+    partials: Dict[str, list] = {c: [] for c in columns}
+    counts: Dict[str, int] = {c: 0 for c in columns}
+    for b in frame.blocks():
+        n = _block_num_rows(b)
+        if n == 0:
+            continue
+        for c in columns:
+            v = b[c]
+            partials[c].append(np.asarray(stats(jnp.asarray(v))))
+            counts[c] += n
+    out = {}
+    for c in columns:
+        ps = np.stack(partials[c])
+        n = counts[c]
+        mean = ps[:, 0].sum() / n
+        var = max(ps[:, 1].sum() / n - mean * mean, 0.0)
+        out[c] = {
+            "count": int(n),
+            "mean": float(mean),
+            "std": float(np.sqrt(var)),
+            "min": float(ps[:, 2].min()),
+            "max": float(ps[:, 3].max()),
+        }
+    return out

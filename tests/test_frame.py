@@ -225,3 +225,26 @@ def test_concurrent_verbs_on_one_frame():
     assert not errors, errors
     expect = float(np.arange(n, dtype=np.float64).sum() * 2)
     assert all(abs(r - expect) < 1e-3 for r in results), results
+
+
+def test_describe():
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(1000)
+    k = rng.integers(0, 10, 1000)
+    fr = tfs.frame_from_arrays(
+        {"x": x, "k": k, "s": [str(i) for i in range(1000)]}, num_blocks=4
+    )
+    d = tfs.describe(fr)
+    assert set(d) == {"x", "k"}  # host string column excluded
+    assert d["x"]["count"] == 1000
+    assert d["x"]["mean"] == pytest.approx(float(x.mean()), abs=1e-9)
+    assert d["x"]["std"] == pytest.approx(float(x.std()), rel=1e-6)
+    assert d["k"]["min"] == float(k.min()) and d["k"]["max"] == float(k.max())
+    with pytest.raises(ValueError, match="scalar numeric"):
+        tfs.describe(fr, columns=["s"])
+    # sharded frames describe through the same path
+    d2 = tfs.describe(tfs.frame_from_arrays({"x": x[:64]}).to_device())
+    assert d2["x"]["count"] == 64
+    assert d2["x"]["mean"] == pytest.approx(float(x[:64].mean()), abs=1e-9)
