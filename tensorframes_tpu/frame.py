@@ -30,6 +30,7 @@ Shape discovery parity:
 
 from __future__ import annotations
 
+import functools as _functools
 import math
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Union
@@ -745,18 +746,36 @@ def print_schema(frame: TensorFrame) -> None:
     print(explain(frame))
 
 
+@_functools.lru_cache(maxsize=1)
+def _describe_stats_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stats(v):
+        # per-block (mean, M2, min, max): the two-pass mean/M2 form is
+        # cancellation-free even when x64 is disabled and accumulation
+        # silently runs in f32 (sum-of-squares would lose everything for
+        # |mean| >> std); blocks merge with the Chan parallel-variance
+        # recurrence on the host in python floats (always f64)
+        v = v.astype(jnp.float64)
+        m = v.mean()
+        return jnp.stack([m, ((v - m) ** 2).sum(), v.min(), v.max()])
+
+    return stats
+
+
 def describe(frame: TensorFrame, columns: Optional[Sequence[str]] = None):
     """Summary statistics per scalar numeric column — count, mean, std,
-    min, max (std via the sum/sum-of-squares identity, accumulated in
-    f64). One jitted stats program runs per block; on sharded frames the
-    block is a global array, so the stats reduce SPMD through compiler
-    collectives before the tiny per-block partials merge on the host.
+    min, max. One jitted stats program runs per block (on sharded frames
+    the block is a global array, so the stats reduce SPMD through
+    compiler collectives); the tiny per-block partials merge on the host
+    with the parallel-variance recurrence.
 
     Returns {column: {"count", "mean", "std", "min", "max"}} — the Spark
     ``describe()`` affordance the reference's users had from the host
-    DataFrame API.
+    DataFrame API. Empty frames report count 0 and NaN moments.
     """
-    import jax
     import jax.numpy as jnp
 
     if columns is None:
@@ -775,34 +794,38 @@ def describe(frame: TensorFrame, columns: Optional[Sequence[str]] = None):
     if not columns:
         return {}
 
-    @jax.jit
-    def stats(v):
-        v = v.astype(jnp.float64)
-        return jnp.stack(
-            [v.sum(), (v * v).sum(), v.min(), v.max()]
-        )
-
+    stats = _describe_stats_fn()
     partials: Dict[str, list] = {c: [] for c in columns}
-    counts: Dict[str, int] = {c: 0 for c in columns}
+    ns: List[int] = []
     for b in frame.blocks():
         n = _block_num_rows(b)
         if n == 0:
             continue
+        ns.append(n)
         for c in columns:
-            v = b[c]
-            partials[c].append(np.asarray(stats(jnp.asarray(v))))
-            counts[c] += n
+            partials[c].append(np.asarray(stats(jnp.asarray(b[c]))))
     out = {}
+    nan = float("nan")
     for c in columns:
-        ps = np.stack(partials[c])
-        n = counts[c]
-        mean = ps[:, 0].sum() / n
-        var = max(ps[:, 1].sum() / n - mean * mean, 0.0)
+        if not ns:
+            out[c] = {"count": 0, "mean": nan, "std": nan, "min": nan, "max": nan}
+            continue
+        # Chan et al. pairwise merge of (n, mean, M2)
+        n_t, mean_t, m2_t = 0, 0.0, 0.0
+        lo, hi = float("inf"), float("-inf")
+        for n_b, p in zip(ns, partials[c]):
+            mean_b, m2_b = float(p[0]), float(p[1])
+            delta = mean_b - mean_t
+            n_new = n_t + n_b
+            m2_t = m2_t + m2_b + delta * delta * n_t * n_b / n_new
+            mean_t = mean_t + delta * n_b / n_new
+            n_t = n_new
+            lo, hi = min(lo, float(p[2])), max(hi, float(p[3]))
         out[c] = {
-            "count": int(n),
-            "mean": float(mean),
-            "std": float(np.sqrt(var)),
-            "min": float(ps[:, 2].min()),
-            "max": float(ps[:, 3].max()),
+            "count": n_t,
+            "mean": mean_t,
+            "std": float(np.sqrt(max(m2_t / n_t, 0.0))),
+            "min": lo,
+            "max": hi,
         }
     return out

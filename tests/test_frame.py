@@ -248,3 +248,14 @@ def test_describe():
     d2 = tfs.describe(tfs.frame_from_arrays({"x": x[:64]}).to_device())
     assert d2["x"]["count"] == 64
     assert d2["x"]["mean"] == pytest.approx(float(x[:64].mean()), abs=1e-9)
+
+
+def test_describe_empty_and_conditioning():
+    import tensorframes_tpu as tfs
+
+    d = tfs.describe(tfs.frame_from_arrays({"x": np.zeros(0)}))
+    assert d["x"]["count"] == 0 and np.isnan(d["x"]["mean"])
+    # huge mean, tiny std: the naive sum-of-squares identity would report 0
+    x = 1e6 + np.random.default_rng(0).standard_normal(4000)
+    got = tfs.describe(tfs.frame_from_arrays({"x": x}, num_blocks=4))["x"]
+    assert got["std"] == pytest.approx(float(x.std()), rel=1e-3)
