@@ -194,6 +194,23 @@ class TensorFrame:
                 rows.append(row)
         return rows
 
+    def take(self, n: int) -> List[Dict[str, object]]:
+        """First ``n`` rows as dicts without materializing later blocks'
+        columns to rows (≙ ``DataFrame.take``)."""
+        out: List[Dict[str, object]] = []
+        for b in self.blocks():
+            m = _block_num_rows(b)
+            if m == 0:
+                continue
+            take_here = min(n - len(out), m)
+            small = TensorFrame(
+                [{k: v[:take_here] for k, v in b.items()}], self.schema
+            )
+            out.extend(small.collect())
+            if len(out) >= n:
+                break
+        return out
+
     def first(self) -> Dict[str, object]:
         for b in self.blocks():
             if _block_num_rows(b) > 0:
@@ -429,6 +446,35 @@ class GroupedData:
         from .ops.verbs import aggregate
 
         return aggregate(fetches, self)
+
+    def count(self) -> "TensorFrame":
+        """Rows per key (the ``groupBy().count()`` affordance): rides the
+        aggregate fast path by summing a ones column."""
+        import numpy as np_
+
+        from .ops.verbs import aggregate
+
+        ones = TensorFrame(
+            [
+                dict(b, count_tmp=np_.ones(_block_num_rows(b), np_.int64))
+                for b in self.frame.blocks()
+            ],
+            self.frame.schema.append(
+                [ColumnInfo("count_tmp", dt.int64, Shape((Unknown,)))]
+            ),
+        )
+        if self.frame.is_sharded:
+            ones._mesh = self.frame.mesh
+            ones._axis = getattr(self.frame, "_axis", None)
+        out = aggregate(
+            lambda count_tmp_input: {
+                "count_tmp": count_tmp_input.sum(
+                    axis=0, dtype=count_tmp_input.dtype
+                )
+            },
+            GroupedData(ones, self.keys),
+        )
+        return out.with_column_renamed("count_tmp", "count")
 
     def __repr__(self):
         return f"GroupedData(keys={self.keys}, {self.frame!r})"

@@ -259,3 +259,22 @@ def test_describe_empty_and_conditioning():
     x = 1e6 + np.random.default_rng(0).standard_normal(4000)
     got = tfs.describe(tfs.frame_from_arrays({"x": x}, num_blocks=4))["x"]
     assert got["std"] == pytest.approx(float(x.std()), rel=1e-3)
+
+
+def test_take_and_groupby_count():
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, 3, 50)
+    fr = tfs.frame_from_arrays(
+        {"k": k, "v": rng.standard_normal(50)}, num_blocks=4
+    )
+    head = fr.take(5)
+    assert len(head) == 5
+    assert [r["k"] for r in head] == list(k[:5])
+    assert fr.take(500) == fr.collect()
+
+    counted = fr.group_by("k").count()
+    got = {r["k"]: r["count"] for r in counted.collect()}
+    for key in np.unique(k):
+        assert got[int(key)] == int((k == key).sum())
