@@ -178,3 +178,15 @@ def test_multikey_span_overflow_falls_back():
             tfs.reduce_sum(v_input, axis=0, name="v"), dev.group_by("a", "b")
         )
     assert float(np.asarray(agg.column_values("v")).sum()) == n
+
+
+def test_groupby_count_sharded():
+    rng = np.random.default_rng(5)
+    k = rng.integers(0, 5, 640)
+    dev = tfs.frame_from_arrays(
+        {"k": k, "v": rng.standard_normal(640).astype(np.float32)}
+    ).to_device()
+    counted = dev.group_by("k").count()
+    got = {r["k"]: r["count"] for r in counted.collect()}
+    for key in np.unique(k):
+        assert got[int(key)] == int((k == key).sum())
