@@ -1,0 +1,125 @@
+"""TPU smoke: validate the accelerator path end to end in one command.
+
+Run this FIRST in any session with (possibly) working TPU hardware:
+
+    python dev/tpu_smoke.py
+
+It probes the backend from a throwaway subprocess (a wedged axon tunnel
+hangs jax.devices() forever — bench.py's watchdog pattern), then checks
+the pieces that only real-TPU compilation can validate:
+
+1. basic matmul on the chip
+2. the pallas segment-sum kernel NON-interpreted (its index maps were
+   fixed blind for the x64 literal-typing Mosaic failure — see
+   ops/segment.py)
+3. the upstream pallas flash-attention kernel under x64-off tracing
+4. a keyed aggregate through the fast path
+5. a small Inception block scoring via map_blocks
+
+Exit code 0 = all green (prints per-check lines).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def probe(timeout_s: float = 150.0) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    if not probe():
+        print("FAIL backend: accelerator unresponsive (wedged tunnel?)")
+        return 1
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    print(f"devices: {jax.devices()}")
+    if dev.platform == "cpu":
+        print("FAIL backend: only CPU visible")
+        return 1
+
+    t0 = time.time()
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    s = float((x @ x).sum())
+    print(f"OK matmul ({s:.0f}) in {time.time() - t0:.1f}s")
+
+    from tensorframes_tpu.ops import segment
+
+    vals = jnp.asarray(np.random.default_rng(0).standard_normal((512, 4)), jnp.float32)
+    sids = jnp.asarray(np.random.default_rng(1).integers(0, 16, 512), jnp.int32)
+    try:
+        t0 = time.time()
+        out = segment.segment_sum_pallas(vals, sids, 16)
+        ref = np.zeros((16, 4), np.float32)
+        np.add.at(ref, np.asarray(sids), np.asarray(vals))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+        print(f"OK pallas segment-sum (non-interpreted) in {time.time() - t0:.1f}s")
+    except Exception as e:
+        print(f"FAIL pallas segment-sum: {type(e).__name__}: {str(e)[:200]}")
+        return 1
+
+    from tensorframes_tpu.ops import attention as att
+
+    q = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 4, 512, 128)), jnp.bfloat16
+    )
+    try:
+        t0 = time.time()
+        fast = jax.jit(lambda q: att.flash_attention(q, q, q, causal=True))(q)
+        slow = att.blockwise_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(fast, np.float32),
+            np.asarray(slow, np.float32),
+            rtol=3e-2,
+            atol=3e-2,
+        )
+        print(f"OK flash attention in {time.time() - t0:.1f}s")
+    except Exception as e:
+        print(f"WARN flash attention fell back/failed: {type(e).__name__}: {str(e)[:160]}")
+
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(0)
+    fr = tfs.frame_from_arrays(
+        {"k": rng.integers(0, 32, 10_000), "v": rng.standard_normal(10_000).astype(np.float32)}
+    )
+    with tfs.with_graph():
+        v_input = tfs.block(fr, "v", tf_name="v_input")
+        agg = tfs.aggregate(
+            tfs.reduce_sum(v_input, axis=0, name="v"), fr.group_by("k")
+        )
+    total = float(np.asarray(agg.column_values("v")).sum())
+    assert abs(total - float(np.asarray(fr.column_values("v")).sum())) < 1e-2
+    print(f"OK aggregate fast path (pallas={'on' if segment.pallas_enabled() else 'OFF'})")
+
+    from tensorframes_tpu.models import inception as inc
+
+    cfg = inc.inception_v3(channel_scale=0.25)
+    params = inc.init_params(cfg, seed=0)
+    images = inc.synthetic_images(cfg, 8, seed=0)
+    df = tfs.frame_from_arrays({"images": images}).to_device()
+    t0 = time.time()
+    out = tfs.map_blocks(lambda images: inc.scoring_program(cfg, params)(images), df)
+    lab = np.asarray(out.column_values("label"))
+    print(f"OK inception quarter-width scoring ({lab.shape[0]} rows) in {time.time() - t0:.1f}s")
+    print("ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
