@@ -95,7 +95,7 @@ from .io import (  # noqa: E402,F401
 )
 from .utils import profiling  # noqa: E402,F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "TensorFrame",
