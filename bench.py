@@ -38,6 +38,22 @@ def _time_rows_per_sec(run_once, n_rows: int, iters: int) -> float:
     return n_rows * iters / dt
 
 
+def _record_mfu(name: str, program, rows_per_sec: float, n_rows: int) -> None:
+    """Attach XLA-cost-model FLOPs to a profiling span so report() prints
+    achieved GFLOP/s (and MFU when config.peak_flops is set). Best-iter
+    seconds reconstructed from the returned throughput."""
+    try:
+        from tensorframes_tpu.utils import profiling
+
+        fpr = program.flops_per_row()
+        if fpr > 0 and rows_per_sec > 0:
+            profiling.record(
+                name, n_rows / rows_per_sec, rows=n_rows, flops=fpr * n_rows
+            )
+    except Exception as e:  # cost model unavailable on some backends
+        print(f"# mfu accounting unavailable for {name}: {e}")
+
+
 def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
     import tensorframes_tpu as tfs
     from tensorframes_tpu.models import logreg
@@ -54,7 +70,9 @@ def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
         _sync(b["scores"])
         _sync(b["label"])
 
-    return _time_rows_per_sec(run_once, n_rows, iters)
+    rps = _time_rows_per_sec(run_once, n_rows, iters)
+    _record_mfu("bench.logreg", program, rps, n_rows)
+    return rps
 
 
 def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
@@ -95,7 +113,11 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
         [b] = out.blocks()
         _sync(b["label"])
 
-    return _time_rows_per_sec(run_once, n_rows, iters)
+    rps = _time_rows_per_sec(run_once, n_rows, iters)
+    _record_mfu(
+        f"bench.inception_v3{'_int8' if int8 else ''}", program, rps, n_rows
+    )
+    return rps
 
 
 def _bench_bert_embed(n_rows: int = 1024, seq: int = 128, iters: int = 3,
@@ -119,7 +141,9 @@ def _bench_bert_embed(n_rows: int = 1024, seq: int = 128, iters: int = 3,
         [b] = out.blocks()
         _sync(b["embedding"])
 
-    return _time_rows_per_sec(run_once, n_rows, iters)
+    rps = _time_rows_per_sec(run_once, n_rows, iters)
+    _record_mfu("bench.bert_embed", program, rps, n_rows)
+    return rps
 
 
 def _bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
@@ -329,6 +353,20 @@ def main():
     import jax
 
     n_chips = max(1, len(jax.devices()))
+    # per-chip bf16 peak FLOP/s by device kind → MFU column in the report
+    # (public spec sheets; MFU vs bf16 peak is the scaling-book convention)
+    from tensorframes_tpu import configure
+
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for pat, peak in (
+        ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+        ("v4", 275e12), ("v6e", 918e12), ("v6 lite", 918e12),
+    ):
+        if pat in kind:
+            # benched frames shard over every chip, so the recorded FLOPs
+            # are fleet-aggregate — compare against the fleet peak
+            configure(peak_flops=peak * n_chips)
+            break
     logreg_rps = _try("logreg", _bench_map_blocks_logreg, 0.0)
     add3_rps = _try("add3", _bench_add3, 0.0)
     reduce_s = _try("reduce_blocks", _bench_reduce_blocks, float("nan"))
@@ -415,6 +453,13 @@ def main():
     size = "small" if on_tpu else "tiny"
     print(f"# gpt_{size}_decode_tokens_per_sec={gen_tps:.0f}")
     print(f"# gpt_{size}_int8_decode_tokens_per_sec={gen_tps_q:.0f}")
+    from tensorframes_tpu.utils import profiling
+
+    mfu_rows = [
+        ln for ln in profiling.report().splitlines() if "bench." in ln or "GFLOP" in ln
+    ]
+    for ln in mfu_rows:
+        print(f"# mfu | {ln}")
 
     baseline = None
     # the published baseline is full-scale-on-TPU; a CPU fallback run uses a

@@ -1,0 +1,56 @@
+"""Bench regression gate for CI (CPU-fallback config).
+
+Parses the ``# key=value`` lines bench.py prints and enforces loose
+floors/ceilings (~20x headroom vs the recorded CPU-fallback table in
+BASELINE.md) — the goal is to catch order-of-magnitude regressions
+(accidental per-row dispatch, lost native marshalling, recompile storms),
+not to benchmark CI runners.
+
+Usage: python dev/bench_check.py bench_output.txt
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+# metric name → (kind, bound). kind 'min' = value must be >= bound,
+# 'max' = value must be <= bound. Bounds are ~20x slack off the
+# BASELINE.md CPU-fallback rows so runner variance never flakes.
+BOUNDS = {
+    "add3_map_blocks_rows_per_sec": ("min", 2e7),
+    "logreg_map_blocks_rows_per_sec": ("min", 8e4),
+    "inception_v3_map_blocks_rows_per_sec": ("min", 3.0),
+    "convert_1M_int_rows_s": ("max", 1.0),
+    "convertback_1M_int_cells_s": ("max", 6.0),
+    "read_csv_1M_rows_s": ("max", 3.0),
+    "aggregate_1M_512groups_wall_s": ("max", 3.0),
+    "reduce_blocks_1M_wall_s": ("max", 0.5),
+    "bert_tiny_map_rows_rows_per_sec": ("min", 500.0),
+}
+
+
+def main(path: str) -> int:
+    with open(path) as f:
+        text = f.read()
+    found = dict(re.findall(r"^# (\w+)=([0-9.eE+-]+)$", text, re.M))
+    failures = []
+    checked = 0
+    for name, (kind, bound) in BOUNDS.items():
+        if name not in found:
+            failures.append(f"MISSING metric {name}")
+            continue
+        v = float(found[name])
+        checked += 1
+        if kind == "min" and v < bound:
+            failures.append(f"{name}={v:g} below floor {bound:g}")
+        elif kind == "max" and v > bound:
+            failures.append(f"{name}={v:g} above ceiling {bound:g}")
+    print(f"bench_check: {checked} metrics checked, {len(failures)} failures")
+    for f_ in failures:
+        print(f"  FAIL {f_}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

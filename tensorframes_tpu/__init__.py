@@ -71,6 +71,11 @@ from .program import (  # noqa: E402,F401
     program_from_function,
     save_program,
 )
+from .graphdef import (  # noqa: E402,F401
+    load_graphdef,
+    parse_graphdef,
+    program_from_graphdef,
+)
 from .validation import ValidationError  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
     aggregate,

@@ -32,8 +32,11 @@ def _env_int(name: str, default: int) -> int:
 class Config:
     # Enable float64/int64 end-to-end (the reference's Double/Long columns).
     enable_x64: bool = _env_bool("TFTPU_ENABLE_X64", True)
-    # Pad block row-counts up to powers of two between these bounds so jit
-    # caches stay small (XLA wants static shapes; SURVEY.md §7 hard-part 1).
+    # map_rows lead-dim bucketing: pad the vmapped row count up to
+    # min_bucket * 2**k (k <= max_bucket_doublings) so jit caches stay
+    # O(log n) across varying block sizes; padded rows are sliced off
+    # (XLA wants static shapes; SURVEY.md §7 hard-part 1). Only row-
+    # independent semantics pad — map_blocks programs see the true block.
     min_bucket: int = _env_int("TFTPU_MIN_BUCKET", 8)
     max_bucket_doublings: int = _env_int("TFTPU_MAX_BUCKET_DOUBLINGS", 30)
     # Default number of blocks when partitioning un-blocked input.
@@ -49,6 +52,9 @@ class Config:
     # map_blocks keeps this many extra blocks in flight so transfer and
     # compute overlap (0 = fully synchronous per block).
     map_pipeline_depth: int = _env_int("TFTPU_MAP_PIPELINE_DEPTH", 2)
+    # Per-chip peak FLOP/s for MFU accounting in profiling.report()
+    # (0 = unknown; bench.py sets it from the detected device kind).
+    peak_flops: float = float(os.environ.get("TFTPU_PEAK_FLOPS", 0) or 0)
 
 
 _config = Config()

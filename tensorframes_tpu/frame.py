@@ -448,10 +448,14 @@ class GroupedData:
         return aggregate(fetches, self)
 
     def count(self) -> "TensorFrame":
-        """Rows per key (the ``groupBy().count()`` affordance): rides the
-        aggregate fast path by summing a ones column."""
+        """Rows per key (the ``groupBy().count()`` affordance): sums a
+        ones column through a DSL reducer fetch so ``segment_reduce_info``
+        recognizes it and the segment/device-aggregate fast paths apply
+        (a plain-function fetch would take the generic chunked path and
+        host-gather on multi-host frames)."""
         import numpy as np_
 
+        from . import dsl
         from .ops.verbs import aggregate
 
         ones = TensorFrame(
@@ -466,14 +470,10 @@ class GroupedData:
         if self.frame.is_sharded:
             ones._mesh = self.frame.mesh
             ones._axis = getattr(self.frame, "_axis", None)
-        out = aggregate(
-            lambda count_tmp_input: {
-                "count_tmp": count_tmp_input.sum(
-                    axis=0, dtype=count_tmp_input.dtype
-                )
-            },
-            GroupedData(ones, self.keys),
-        )
+        with dsl.with_graph():
+            cnt_in = dsl.block(ones, "count_tmp", tf_name="count_tmp_input")
+            cnt = dsl.reduce_sum(cnt_in, axis=0, name="count_tmp")
+        out = aggregate(cnt, GroupedData(ones, self.keys))
         return out.with_column_renamed("count_tmp", "count")
 
     def __repr__(self):

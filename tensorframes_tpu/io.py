@@ -206,9 +206,20 @@ def save_frame(frame, path: str) -> None:
         if host:
             with open(os.path.join(tmp, _HOST), "wb") as f:
                 pickle.dump(host, f)
+        # keep a recoverable frame on disk at every instant: rename the
+        # old directory aside, swap the new one in, only then delete the
+        # old (rmtree-then-rename would lose the previous frame outright
+        # on a crash between the two calls). The aside name is FIXED so a
+        # later save — any process — can self-heal a crash that happened
+        # inside the two-rename window instead of leaking the only copy.
+        old = f"{path}.old"
+        if os.path.isdir(old) and not os.path.isdir(path):
+            os.rename(old, path)  # heal a previous crashed swap
+        shutil.rmtree(old, ignore_errors=True)
         if os.path.isdir(path):
-            shutil.rmtree(path)
+            os.rename(path, old)
         os.rename(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     logger.info(
@@ -230,6 +241,11 @@ def load_frame(path: str, num_blocks: Optional[int] = None):
     from .schema import ColumnInfo, Schema
     from .shape import Shape
 
+    path = os.path.normpath(path)
+    if not os.path.isdir(path) and os.path.isdir(f"{path}.old"):
+        # a save crashed inside its two-rename swap window; the previous
+        # frame is intact under the fixed aside name — read it
+        path = f"{path}.old"
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
     if manifest.get("format_version", 0) > _FORMAT_VERSION:

@@ -242,8 +242,14 @@ PyObject* parse_csv(PyObject*, PyObject* args) {
   const Py_ssize_t ncols = PySequence_Fast_GET_SIZE(codes_fast);
   int* codes = new int[ncols];
   for (Py_ssize_t j = 0; j < ncols; ++j) {
-    codes[j] =
-        static_cast<int>(PyLong_AsLong(PySequence_Fast_GET_ITEM(codes_fast, j)));
+    const long code = PyLong_AsLong(PySequence_Fast_GET_ITEM(codes_fast, j));
+    if (code == -1 && PyErr_Occurred()) {
+      Py_DECREF(codes_fast);
+      delete[] codes;
+      PyBuffer_Release(&data);
+      return nullptr;
+    }
+    codes[j] = static_cast<int>(code);
   }
   Py_DECREF(codes_fast);
 

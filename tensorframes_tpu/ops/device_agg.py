@@ -17,12 +17,23 @@ counts. Multi-host works by construction: the collective crosses
 process boundaries through ICI/DCN, and only the tiny dense table is
 ever host-materialized.
 
-Eligibility: algebraic fetches (sum/min/max/mean), integer key columns,
-and a key span small enough that the dense table is cheap
-(``K <= 1<<20`` buckets and ``K × feature-elems <= 1<<24``). Anything
-else falls back to the host path. The dense-table trick is the same
-reformulation the pallas segment kernel uses (scatter → dense compute):
-on TPU, bounded dense work beats data-dependent shuffles.
+Two plans, tried in order:
+
+* **dense span** — integer keys whose mixed-radix span is small
+  (``K <= 1<<20`` buckets, ``K × feature-elems <= 1<<24``): bucket ids
+  come from pure device arithmetic; the keys never touch the host.
+* **dictionary encoding** — arbitrary keys (strings, huge-span ints,
+  composites): one host pass over the *key columns only* builds dense
+  group ids via ``np.unique`` (values stay on device), then the same
+  segment-reduce + collective runs with ``K = #distinct groups``. This
+  removes the reference's Catalyst shuffle for any key type
+  (DebugRowOps.scala:583) at the cost of one key-column transfer.
+
+Anything else (non-algebraic fetches, ragged values, trimmed row counts
+the mesh no longer divides) falls back to the host path. The dense-table
+trick is the same reformulation the pallas segment kernel uses
+(scatter → dense compute): on TPU, bounded dense work beats
+data-dependent shuffles.
 """
 
 from __future__ import annotations
@@ -91,99 +102,37 @@ def _agg_fn(mesh, axis: str, ops_key, K: int, strides: Tuple[int, ...]):
 
 @jax.jit
 def _stacked_minmax(*cols):
-    """[n_cols, 2] (min, max) in one device computation / one transfer."""
-    return jnp.stack(
-        [
-            jnp.stack([c.min().astype(jnp.int64), c.max().astype(jnp.int64)])
-            for c in cols
-        ]
-    )
+    """Per-column (min, max) pairs in one device computation / one
+    transfer. Each pair keeps its column's own dtype — casting to a
+    common int64 here would silently truncate to int32 when x64 is
+    disabled and corrupt the range guard."""
+    return tuple((c.min(), c.max()) for c in cols)
 
 
-def try_aggregate_device(
-    frame,
-    keys: Sequence[str],
-    seg_info,
-    out_names: Sequence[str],
-) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]]:
-    """Attempt the sharded dense-bucket plan. Returns
-    ``(key_cols, out_cols)`` with groups in lexicographic key order (the
-    host path's ordering), or None when ineligible."""
-    if not frame.is_sharded or frame.num_rows == 0:
-        return None
-    ops = {name: op for name, op, _ in seg_info}
-    if any(ops[x] not in ("reduce_sum", "reduce_min", "reduce_max", "reduce_mean")
-           for x in out_names):
-        return None
-    for k in keys:
-        info = frame.schema[k]
-        if not info.is_device or not np.issubdtype(info.dtype.np_dtype, np.integer):
-            return None
-    blocks = frame.blocks()
-    main, tail = blocks[0], (blocks[1] if len(blocks) > 1 else None)
-    for x in out_names:
-        if isinstance(main[x], list):
-            return None
-    for k in keys:
-        if isinstance(main[k], list):
-            return None
-    main_rows = int(main[keys[0]].shape[0])
-    if main_rows == 0:
-        return None  # everything in the tail → host path is already optimal
-
-    # -- key ranges → mixed-radix bucket ids --------------------------------
-    mm = np.asarray(jax.device_get(_stacked_minmax(*(main[k] for k in keys))))
-    mins, ranges = [], []
-    for i, k in enumerate(keys):
-        lo, hi = int(mm[i, 0]), int(mm[i, 1])
-        if tail is not None and len(tail[k]):
-            t = np.asarray(tail[k])
-            lo, hi = min(lo, int(t.min())), max(hi, int(t.max()))
-        mins.append(lo)
-        ranges.append(int(hi - lo + 1))
-    # python ints: key spans near the int32/int64 limits must not wrap the
-    # product and sneak past the eligibility gate
-    K = math.prod(ranges)
-    feat = 0
-    for x in out_names:
-        cell = main[x].shape[1:]
-        feat = max(feat, int(np.prod(cell)) if cell else 1)
-    if K > _KEY_LIMIT or K * feat > _TABLE_ELEM_LIMIT:
-        logger.debug(
-            "device aggregate: key span %d (×%d feat) too large; host path",
-            K, feat,
-        )
-        return None
-    # keys[0] most significant → bucket order == lexicographic key order
-    strides = [1] * len(keys)
-    for i in range(len(keys) - 2, -1, -1):
-        strides[i] = strides[i + 1] * ranges[i + 1]
-
-    mesh = frame.mesh
-    axis = getattr(frame, "_axis", None) or "dp"
+def _run_tables(
+    frame, axis, ops, out_names, K, strides, key_feeds, main, tail, ids_tail
+):
+    """Shared tail of both plans: device segment-reduce + collective,
+    host fold of the tiny tail block, empty-bucket drop, mean divide.
+    Returns ``(sel, out_cols)`` — the surviving bucket ids (ascending,
+    i.e. lexicographic key order) and the finished output columns."""
     ops_key = tuple((x, ops[x], int(main[x].ndim)) for x in out_names)
-    fn = _agg_fn(mesh, axis, ops_key, K, tuple(strides))
-    keys_off = tuple(
-        (main[k] - mins[i]).astype(jnp.int32) for i, k in enumerate(keys)
-    )
-    res = fn(keys_off, {x: main[x] for x in out_names})
+    fn = _agg_fn(frame.mesh, axis, ops_key, K, tuple(strides))
+    res = fn(key_feeds, {x: main[x] for x in out_names})
     count = np.asarray(res["__count__"])
     tables = {x: np.asarray(res[x]) for x in out_names}
 
     # -- fold the host tail block in (≤ dp-1 rows) --------------------------
-    if tail is not None:
-        ids_t = np.zeros(len(tail[keys[0]]), np.int64)
-        for i, k in enumerate(keys):
-            ids_t += (np.asarray(tail[k]) - mins[i]) * strides[i]
-        np.add.at(count, ids_t, 1)
+    if tail is not None and ids_tail is not None and len(ids_tail):
+        np.add.at(count, ids_tail, 1)
         for x in out_names:
             v = np.asarray(tail[x], dtype=tables[x].dtype)
             if ops[x] in ("reduce_sum", "reduce_mean"):
-                np.add.at(tables[x], ids_t, v)
+                np.add.at(tables[x], ids_tail, v)
             elif ops[x] == "reduce_min":
-                np.minimum.at(tables[x], ids_t, v)
+                np.minimum.at(tables[x], ids_tail, v)
             else:
-                np.maximum.at(tables[x], ids_t, v)
+                np.maximum.at(tables[x], ids_tail, v)
 
     sel = np.flatnonzero(count > 0)
     out_cols: Dict[str, np.ndarray] = {}
@@ -193,8 +142,162 @@ def try_aggregate_device(
             c = count[sel].reshape((-1,) + (1,) * (t.ndim - 1))
             t = (t / c).astype(tables[x].dtype)
         out_cols[x] = t
-    key_cols: Dict[str, np.ndarray] = {}
+    return sel, out_cols
+
+
+def try_aggregate_device(
+    frame,
+    keys: Sequence[str],
+    seg_info,
+    out_names: Sequence[str],
+) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]]:
+    """Attempt the sharded device plans (dense span, then dictionary
+    encoding). Returns ``(key_cols, out_cols)`` with groups in
+    lexicographic key order (the host path's ordering), or None when
+    ineligible."""
+    if not frame.is_sharded or frame.num_rows == 0:
+        return None
+    ops = {name: op for name, op, _ in seg_info}
+    if any(ops[x] not in ("reduce_sum", "reduce_min", "reduce_max", "reduce_mean")
+           for x in out_names):
+        return None
+    blocks = frame.blocks()
+    main, tail = blocks[0], (blocks[1] if len(blocks) > 1 else None)
+    for x in out_names:
+        if isinstance(main[x], list):
+            return None
+    for k in keys:
+        # ragged device key columns can't form ids; host-resident key
+        # columns (strings, …) are fine — the dictionary plan handles them
+        if isinstance(main[k], list) and frame.schema[k].is_device:
+            return None
+    main_rows = int(
+        len(main[keys[0]])
+        if isinstance(main[keys[0]], list)
+        else main[keys[0]].shape[0]
+    )
+    if main_rows == 0:
+        return None  # everything in the tail → host path is already optimal
+    axis = getattr(frame, "_axis", None) or "dp"
+    dp = frame.mesh.shape.get(axis, 1)
+    if main_rows % dp:
+        # a trimmed map can leave a sharded frame with a row count the
+        # mesh no longer divides; shard_map would reject it — host path
+        # (mirrors the reduce_rows guard, verbs.py)
+        return None
+    feat = 0
+    for x in out_names:
+        cell = main[x].shape[1:]
+        feat = max(feat, int(np.prod(cell)) if cell else 1)
+
+    dense_eligible = all(
+        frame.schema[k].is_device
+        and np.issubdtype(frame.schema[k].dtype.np_dtype, np.integer)
+        for k in keys
+    )
+    if dense_eligible:
+        # -- plan A: dense mixed-radix span (keys never leave the device) ---
+        mm = jax.device_get(_stacked_minmax(*(main[k] for k in keys)))
+        mins, ranges = [], []
+        for i, k in enumerate(keys):
+            lo, hi = int(mm[i][0]), int(mm[i][1])
+            if tail is not None and len(tail[k]):
+                t = np.asarray(tail[k])
+                lo, hi = min(lo, int(t.min())), max(hi, int(t.max()))
+            mins.append(lo)
+            ranges.append(int(hi - lo + 1))
+        # python ints: key spans near the int32/int64 limits must not wrap
+        # the product and sneak past the eligibility gate
+        K = math.prod(ranges)
+        if K <= _KEY_LIMIT and K * feat <= _TABLE_ELEM_LIMIT:
+            # keys[0] most significant → bucket order == lexicographic order
+            strides = [1] * len(keys)
+            for i in range(len(keys) - 2, -1, -1):
+                strides[i] = strides[i + 1] * ranges[i + 1]
+            keys_off = tuple(
+                (main[k] - mins[i]).astype(jnp.int32)
+                for i, k in enumerate(keys)
+            )
+            ids_tail = None
+            if tail is not None:
+                ids_tail = np.zeros(len(tail[keys[0]]), np.int64)
+                for i, k in enumerate(keys):
+                    ids_tail += (np.asarray(tail[k]) - mins[i]) * strides[i]
+            sel, out_cols = _run_tables(
+                frame, axis, ops, out_names, K, strides, keys_off,
+                main, tail, ids_tail,
+            )
+            key_cols: Dict[str, np.ndarray] = {}
+            for i, k in enumerate(keys):
+                comp = (sel // strides[i]) % ranges[i] + mins[i]
+                key_cols[k] = comp.astype(frame.schema[k].dtype.np_dtype)
+            return key_cols, out_cols
+        logger.debug(
+            "device aggregate: key span %d (×%d feat) too large for the "
+            "dense plan; trying dictionary encoding", K, feat,
+        )
+
+    # -- plan B: dictionary encoding — one host pass over the KEY columns
+    # only (values stay sharded on device). Arbitrary key types; K becomes
+    # the number of distinct groups, not the key span. -----------------------
+    if jax.process_count() > 1:
+        # the key-column device_get below needs fully-addressable arrays;
+        # multi-process frames keep the dense plan or the host path
+        return None
+    key_host: List[np.ndarray] = []
+    for k in keys:
+        v = main[k]
+        if isinstance(v, list):
+            arr = np.asarray(v, dtype=object)
+        else:
+            arr = np.asarray(jax.device_get(v))
+        if tail is not None and len(tail[k]):
+            tv = tail[k]
+            tarr = (
+                np.asarray(tv, dtype=object)
+                if isinstance(tv, list)
+                else np.asarray(tv)
+            )
+            arr = np.concatenate([arr, tarr])
+        key_host.append(arr)
+    codes: List[np.ndarray] = []
+    uniques: List[np.ndarray] = []
+    span = 1
+    for arr in key_host:
+        u, c = np.unique(arr, return_inverse=True)
+        uniques.append(u)
+        codes.append(c.astype(np.int64))
+        span *= len(u)
+        if span > 1 << 62:  # composite code must fit int64
+            return None
+    comb = codes[0]
+    for c, u in zip(codes[1:], uniques[1:]):
+        comb = comb * np.int64(len(u)) + c
+    # sorted uniques ⇒ combined codes sort lexicographically by key tuple
+    ucomb, ids_all = np.unique(comb, return_inverse=True)
+    K = len(ucomb)
+    if K * feat > _TABLE_ELEM_LIMIT:
+        logger.debug(
+            "device aggregate: %d groups ×%d feat exceeds the table limit; "
+            "host path", K, feat,
+        )
+        return None
+    ids_main = ids_all[:main_rows].astype(np.int32)
+    ids_tail = ids_all[main_rows:] if tail is not None else None
+    sel, out_cols = _run_tables(
+        frame, axis, ops, out_names, K, (1,), (jnp.asarray(ids_main),),
+        main, tail, ids_tail,
+    )
+    # decode group ids back to key values (sel indexes ucomb)
+    strides_u = [1] * len(keys)
+    for i in range(len(keys) - 2, -1, -1):
+        strides_u[i] = strides_u[i + 1] * len(uniques[i + 1])
+    key_cols = {}
     for i, k in enumerate(keys):
-        comp = (sel // strides[i]) % ranges[i] + mins[i]
-        key_cols[k] = comp.astype(frame.schema[k].dtype.np_dtype)
+        code = (ucomb[sel] // strides_u[i]) % len(uniques[i])
+        vals = uniques[i][code]
+        info = frame.schema[k]
+        key_cols[k] = (
+            vals.astype(info.dtype.np_dtype) if info.is_device else vals
+        )
     return key_cols, out_cols

@@ -51,7 +51,14 @@ from ..validation import (
     validate_reduce_blocks,
     validate_reduce_rows,
 )
-from .executor import block_is_ragged, gather_feeds, make_pair_fold, pair_fold_body
+from .executor import (
+    block_is_ragged,
+    bucket_rows,
+    gather_feeds,
+    make_pair_fold,
+    pad_lead_dim,
+    pair_fold_body,
+)
 
 logger = get_logger(__name__)
 
@@ -199,6 +206,57 @@ def compile_program(
 # map_blocks
 # ---------------------------------------------------------------------------
 
+def _rebalance_trimmed(out_blocks, names, mesh, axis):
+    """Re-split a trimmed sharded result so the mesh divides the main
+    block again (SURVEY §7 hard-part 3: row-count-changing outputs across
+    shards need a size exchange before reassembly — here the exchange is
+    a ``device_put`` resharding, which XLA lowers to ICI collectives,
+    ≙ TrimmingOperationsSuite.scala:17-47 semantics). The result obeys
+    the same invariants as ``to_device``: divisible device main block +
+    small host tail, so every downstream verb fast path composes."""
+    import jax
+
+    from ..parallel.mesh import batch_sharding
+
+    if jax.process_count() > 1:
+        # boundary rows can't be host-shuffled across non-addressable
+        # shards; leave the blocks as produced — the verb guards decline
+        # the fast paths for non-divisible shapes, so results stay correct
+        return out_blocks
+
+    dp = mesh.shape[axis]
+    dev_cols = dict(out_blocks[0])
+    # any further blocks are the mapped host-tail results — tiny
+    tail_cols = {
+        nm: np.concatenate([np.asarray(ob[nm]) for ob in out_blocks[1:]])
+        for nm in names
+    } if len(out_blocks) > 1 else {}
+    n_dev = int(next(iter(dev_cols.values())).shape[0])
+    n_tail = int(next(iter(tail_cols.values())).shape[0]) if tail_cols else 0
+    n_main = ((n_dev + n_tail) // dp) * dp
+    main, tailb = {}, {}
+    for nm in names:
+        arr = dev_cols[nm]
+        if n_main <= n_dev:
+            # only the <= dp-1 overflow rows leave the device; the big
+            # array reshards in place via device_put (ICI on real chips)
+            extra = np.asarray(arr[n_main:]) if n_main < n_dev else None
+        else:
+            # promote tail rows to fill the last full shard row-group
+            fill = jnp.asarray(tail_cols[nm][: n_main - n_dev])
+            arr = jnp.concatenate([arr, fill], axis=0)
+            extra = None
+        main[nm] = jax.device_put(
+            arr[:n_main], batch_sharding(mesh, arr.ndim, axis)
+        )
+        rest = tail_cols.get(nm)
+        if rest is not None:
+            rest = rest[max(0, n_main - n_dev):]
+        parts = [p for p in (extra, rest) if p is not None and len(p)]
+        if parts:
+            tailb[nm] = np.concatenate(parts)
+    return [main] + ([tailb] if tailb else [])
+
 def map_blocks(
     fetches: Fetches,
     frame,
@@ -272,6 +330,13 @@ def map_blocks(
                 finish(*in_flight.popleft())
         while in_flight:
             finish(*in_flight.popleft())
+        if trim and sharded and out_blocks:
+            out_blocks = _rebalance_trimmed(
+                out_blocks,
+                [i.name for i in out_infos],
+                parent.mesh,
+                getattr(parent, "_axis", None) or get_config().batch_axis,
+            )
         # device-resident outputs return before the TPU finishes (async
         # dispatch); label those spans distinctly so report() rows/s is
         # honest — only the host path measures completed execution
@@ -336,16 +401,45 @@ def map_rows(
                 continue
             if not block_is_ragged(b, input_names):
                 feeds = gather_feeds(b, input_names, program)
-                outs = compiled.run_rows(feeds, to_numpy=not parent.is_sharded)
+                if not parent.is_sharded:
+                    # lead-dim bucketing: pad to a power-of-two row count
+                    # so varying block sizes share O(log n) compiles
+                    # (sharded main blocks have one stable size — and
+                    # padding would disturb their device layout)
+                    target = bucket_rows(n)
+                    feeds = pad_lead_dim(feeds, n, target)
+                    outs = compiled.run_rows(feeds, to_numpy=False)
+                    outs = {k: np.asarray(v[:n]) for k, v in outs.items()}
+                else:
+                    outs = compiled.run_rows(feeds, to_numpy=False)
             else:
-                # ragged path: per-row programs, compiled per cell shape
-                # (≙ per-row dynamic lead dim, TFDataOps.scala:90-103)
-                per_row: List[Dict[str, np.ndarray]] = []
+                # ragged path (≙ per-row dynamic lead dim,
+                # TFDataOps.scala:90-103): group rows by their input cell
+                # shapes, run each group as ONE vmapped dispatch with its
+                # lead dim bucketed — #dispatches = #distinct shapes and
+                # #compiles = #shapes × O(log bucket), not one per row
+                groups: Dict[tuple, List[int]] = {}
                 for i in range(n):
+                    key = tuple(
+                        np.shape(b[name][i]) for name in input_names
+                    )
+                    groups.setdefault(key, []).append(i)
+                per_row: List[Optional[Dict[str, np.ndarray]]] = [None] * n
+                for idx in groups.values():
+                    g = len(idx)
                     feeds = {
-                        name: np.asarray(b[name][i]) for name in input_names
+                        name: np.stack(
+                            [np.asarray(b[name][i]) for i in idx]
+                        )
+                        for name in input_names
                     }
-                    per_row.append(compiled.run_single_row(feeds))
+                    feeds = pad_lead_dim(feeds, g, bucket_rows(g))
+                    outs_g = compiled.run_rows(feeds, to_numpy=True)
+                    for j, i in enumerate(idx):
+                        per_row[i] = {
+                            o.name: outs_g[o.name][j]
+                            for o in program.outputs
+                        }
                 outs = {}
                 for o in program.outputs:
                     cells = [r[o.name] for r in per_row]

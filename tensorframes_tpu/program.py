@@ -168,6 +168,20 @@ class Program:
             costs = costs[0] if costs else {}
         return dict(costs or {})
 
+    def flops_per_row(self, probe: int = 8) -> float:
+        """Marginal model FLOPs per input row, estimated from XLA's cost
+        model at two probe batch sizes (the difference removes any
+        batch-independent constant work). Memoized — feeds the MFU
+        column in ``profiling.report()``."""
+        cached = getattr(self, "_flops_per_row", None)
+        if cached is not None:
+            return cached
+        f1 = float(self.cost_analysis(probe).get("flops", 0.0))
+        f2 = float(self.cost_analysis(2 * probe).get("flops", 0.0))
+        val = max(0.0, (f2 - f1) / probe)
+        self._flops_per_row = val
+        return val
+
 
 def _abstract_inputs(
     inputs: Sequence[TensorSpec], probe: int

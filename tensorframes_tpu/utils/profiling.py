@@ -34,10 +34,15 @@ class SpanStats:
     calls: int = 0
     seconds: float = 0.0
     rows: int = 0
+    flops: float = 0.0  # model FLOPs executed under this span (if known)
 
     @property
     def rows_per_sec(self) -> float:
         return self.rows / self.seconds if self.seconds > 0 else 0.0
+
+    @property
+    def flops_per_sec(self) -> float:
+        return self.flops / self.seconds if self.seconds > 0 else 0.0
 
 
 _lock = threading.Lock()
@@ -59,13 +64,17 @@ def span(name: str, rows: int = 0) -> Iterator[None]:
             s.rows += rows
 
 
-def record(name: str, seconds: float, rows: int = 0) -> None:
-    """Directly accumulate one measurement (for code that times itself)."""
+def record(name: str, seconds: float, rows: int = 0, flops: float = 0.0) -> None:
+    """Directly accumulate one measurement (for code that times itself).
+    ``flops`` lets callers attach a model-FLOP count (e.g. from
+    ``Program.flops_per_row``) so :func:`report` can print achieved
+    FLOP/s and — when ``config.peak_flops`` is set — MFU."""
     with _lock:
         s = _stats.setdefault(name, SpanStats())
         s.calls += 1
         s.seconds += seconds
         s.rows += rows
+        s.flops += flops
 
 
 def metrics() -> Dict[str, SpanStats]:
@@ -80,21 +89,38 @@ def reset_metrics() -> None:
 
 
 def report() -> str:
-    """Human-readable per-span table (the profiling ``explain``)."""
+    """Human-readable per-span table (the profiling ``explain``). Spans
+    carrying FLOP counts get achieved GFLOP/s, plus model FLOP
+    utilization (achieved / ``config.peak_flops``) when the chip's peak
+    is configured — perf work becomes a number, not a vibe."""
+    from ..config import get_config
+
     snap = metrics()
     if not snap:
         return "no spans recorded"
+    peak = float(getattr(get_config(), "peak_flops", 0.0) or 0.0)
+    any_flops = any(s.flops for s in snap.values())
     name_w = max(len(k) for k in snap) + 2
-    lines = [
-        f"{'span':<{name_w}}{'calls':>7}{'seconds':>12}{'rows':>12}{'rows/s':>14}"
-    ]
+    hdr = f"{'span':<{name_w}}{'calls':>7}{'seconds':>12}{'rows':>12}{'rows/s':>14}"
+    if any_flops:
+        hdr += f"{'GFLOP/s':>12}" + (f"{'MFU%':>8}" if peak else "")
+    lines = [hdr]
     for name in sorted(snap):
         s = snap[name]
         rps = f"{s.rows_per_sec:,.0f}" if s.rows else "-"
         rows = f"{s.rows:,}" if s.rows else "-"
-        lines.append(
-            f"{name:<{name_w}}{s.calls:>7}{s.seconds:>12.4f}{rows:>12}{rps:>14}"
-        )
+        line = f"{name:<{name_w}}{s.calls:>7}{s.seconds:>12.4f}{rows:>12}{rps:>14}"
+        if any_flops:
+            line += (
+                f"{s.flops_per_sec / 1e9:>12,.1f}" if s.flops else f"{'-':>12}"
+            )
+            if peak:
+                line += (
+                    f"{100.0 * s.flops_per_sec / peak:>8.1f}"
+                    if s.flops
+                    else f"{'-':>8}"
+                )
+        lines.append(line)
     return "\n".join(lines)
 
 

@@ -1,0 +1,90 @@
+"""Lead-dim bucketing: map_rows over arbitrary block sizes must keep the
+jit cache O(log n) instead of compiling once per distinct row count
+(SURVEY §7 hard-part 1; ≙ the reference's per-shape dynamic handling,
+DataOps.scala:103-144, which re-ran analysis per block instead)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import ColumnInfo, Schema, Shape, Unknown
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.config import configure, get_config
+from tensorframes_tpu.ops.executor import bucket_rows
+
+
+@pytest.fixture
+def bucket_cfg():
+    old = (get_config().min_bucket, get_config().max_bucket_doublings)
+    configure(min_bucket=8, max_bucket_doublings=30)
+    yield
+    configure(min_bucket=old[0], max_bucket_doublings=old[1])
+
+
+def test_bucket_rows_bounds(bucket_cfg):
+    assert bucket_rows(1) == 8
+    assert bucket_rows(8) == 8
+    assert bucket_rows(9) == 16
+    assert bucket_rows(100) == 128
+    configure(max_bucket_doublings=2)
+    # beyond the largest bucket (8*2^2=32): exact-shape compile
+    assert bucket_rows(33) == 33
+
+
+def test_map_rows_bounded_compiles_over_varied_block_sizes(bucket_cfg):
+    """19 distinct block sizes share O(log n) vmap compiles, and the
+    padded rows never leak into results."""
+    sizes = list(range(1, 20))
+    blocks = []
+    off = 0
+    for s in sizes:
+        blocks.append({"x": np.arange(off, off + s, dtype=np.float64)})
+        off += s
+    schema = Schema([ColumnInfo("x", dt.float64, Shape((Unknown,)))])
+    fr = tfs.TensorFrame(blocks, schema)
+    program = tfs.compile_program(lambda x: {"y": x * 2.0 + 1.0}, fr, block=False)
+    out = tfs.map_rows(program, fr)
+    got = np.concatenate([np.atleast_1d(b["y"]) for b in out.blocks()])
+    np.testing.assert_array_equal(got, np.arange(off, dtype=np.float64) * 2.0 + 1.0)
+    # sizes 1..19 → buckets {8, 16, 32}: three compiles, not nineteen
+    assert program.compiled().cache_sizes()["vmap"] <= 3
+
+
+def test_ragged_map_rows_grouped_dispatch(bucket_cfg):
+    """Ragged cells run one vmapped dispatch per distinct cell shape
+    (not one per row), with correct per-row results."""
+    lens = [3, 7, 3, 5, 7, 3, 5, 3]
+    rows = [{"v": np.arange(n, dtype=np.float64)} for n in lens]
+    fr = tfs.frame_from_rows(rows, num_blocks=1)
+    program = tfs.compile_program(
+        lambda v: {"s": v.sum()}, fr, block=False
+    )
+    out = tfs.map_rows(program, fr)
+    got = [r["s"] for r in out.collect()]
+    expect = [float(np.arange(n).sum()) for n in lens]
+    assert got == pytest.approx(expect)
+    # 3 distinct cell shapes, every group ≤ 8 rows → ≤ 3 vmap compiles
+    assert program.compiled().cache_sizes()["vmap"] <= 3
+
+
+def test_ragged_map_rows_ragged_output(bucket_cfg):
+    """Shape-preserving programs over ragged cells keep ragged outputs."""
+    lens = [2, 4, 2, 3]
+    rows = [{"v": np.arange(n, dtype=np.float64)} for n in lens]
+    fr = tfs.frame_from_rows(rows, num_blocks=1)
+    out = tfs.map_rows(lambda v: {"w": v * 10.0}, fr)
+    got = [r["w"] for r in out.collect()]
+    for g, n in zip(got, lens):
+        np.testing.assert_array_equal(np.asarray(g), np.arange(n) * 10.0)
+
+
+def test_map_rows_bucketing_respects_reduction_semantics(bucket_cfg):
+    """Padded rows are replicas of real rows and are sliced off — a
+    program whose per-row result depends on the whole cell (sum) must
+    still be exact for every real row."""
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(13, 4))
+    fr = tfs.frame_from_arrays({"m": vals}, num_blocks=1)
+    out = tfs.map_rows(lambda m: {"t": m.sum()}, fr)
+    got = np.asarray([r["t"] for r in out.collect()])
+    np.testing.assert_allclose(got, vals.sum(axis=1), rtol=1e-12)

@@ -125,9 +125,10 @@ def test_multi_key_mixed_radix():
     )
 
 
-def test_huge_key_span_falls_back(data):
-    # keys spanning > _KEY_LIMIT buckets → device path declines, host path
-    # still answers through the public API
+def test_huge_key_span_rides_dictionary_plan(data):
+    # keys spanning > _KEY_LIMIT buckets exceed the dense plan but ride
+    # the dictionary plan (K = #groups); results still match via the
+    # public API
     d = dict(data)
     d["k"] = d["k"].copy()
     d["k"][0] = 5_000_000
@@ -136,10 +137,31 @@ def test_huge_key_span_falls_back(data):
         device_agg.try_aggregate_device(
             dev, ["k"], ((("v"), "reduce_sum", 1),), ["v"]
         )
-        is None
+        is not None
     )
     a = _dsl_agg(dev, "v", tfs.reduce_sum)
     assert 5_000_000 in set(np.asarray(a.column_values("k")).tolist())
+
+
+def test_wide_features_exceeding_table_limit_fall_back(data):
+    """Both device plans decline when K × feature-elems exceeds the
+    table limit; the host path answers."""
+    n = len(data["k"])
+    wide = np.ones((n, 4096), np.float32)
+    d = {"k": data["k"].copy(), "v": wide}
+    d["k"][0] = 5_000_000  # dense plan out (span), dict plan out (table)
+    old = device_agg._TABLE_ELEM_LIMIT
+    device_agg._TABLE_ELEM_LIMIT = 1 << 14
+    try:
+        dev = tfs.frame_from_arrays(dict(d)).to_device()
+        assert (
+            device_agg.try_aggregate_device(
+                dev, ["k"], (("v", "reduce_sum", 2),), ["v"]
+            )
+            is None
+        )
+    finally:
+        device_agg._TABLE_ELEM_LIMIT = old
 
 
 def test_float_keys_fall_back():
@@ -153,10 +175,11 @@ def test_float_keys_fall_back():
     assert len(a.collect()) == 64  # every float key unique → 64 groups
 
 
-def test_multikey_span_overflow_falls_back():
-    """Two huge-span key columns must not wrap the bucket product past the
-    eligibility gate (int64 overflow → K=0 'passes'); the device path
-    declines and the host path answers."""
+def test_multikey_span_overflow_rides_dictionary_plan():
+    """Two huge-span key columns must not wrap the dense plan's bucket
+    product past its gate (int64 overflow → K=0 'passes'); they skip to
+    the dictionary plan, whose K is the distinct-group count, and the
+    result matches the host path."""
     rng = np.random.default_rng(4)
     n = 64
     a = rng.integers(0, 10, n).astype(np.int64)
@@ -165,12 +188,19 @@ def test_multikey_span_overflow_falls_back():
     a[1], b[1] = 2**31 - 1, 2**31 - 1
     d = {"a": a, "b": b, "v": np.ones(n, np.float32)}
     dev = tfs.frame_from_arrays(dict(d)).to_device()
-    assert (
-        device_agg.try_aggregate_device(
-            dev, ["a", "b"], (("v", "reduce_sum", 1),), ["v"]
-        )
-        is None
+    got = device_agg.try_aggregate_device(
+        dev, ["a", "b"], (("v", "reduce_sum", 1),), ["v"]
     )
+    assert got is not None
+    key_cols, out_cols = got
+    want = {}
+    for ka, kb, v in zip(a, b, d["v"]):
+        want[(int(ka), int(kb))] = want.get((int(ka), int(kb)), 0.0) + float(v)
+    got_map = {
+        (int(ka), int(kb)): float(v)
+        for ka, kb, v in zip(key_cols["a"], key_cols["b"], out_cols["v"])
+    }
+    assert got_map == want
 
     with tfs.with_graph():
         v_input = tfs.block(dev, "v", tf_name="v_input")

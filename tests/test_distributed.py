@@ -1,12 +1,14 @@
-"""Multi-process distributed backend test: two real OS processes, each
+"""Multi-process distributed backend tests: N real OS processes, each
 owning one CPU device, coordinate through ``init_distributed``
-(jax.distributed) and run a psum across process boundaries.
+(jax.distributed) and run collectives across process boundaries.
 
 This is the test the reference never had (SURVEY §4: "no multi-node test
 infrastructure anywhere in the repo" — distribution was tested by
 partition count only). Here the control plane (coordinator service) and
 the collective path are exercised across actual process boundaries — the
-single-host analogue of multi-host DCN.
+single-host analogue of multi-host DCN — at 2 and at 4 processes
+(the 4-way run additionally covers multi-hop collective schedules and
+the sharded save/load round-trip with four writers).
 """
 
 import os
@@ -25,14 +27,15 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {repo!r})
 from tensorframes_tpu.parallel import init_distributed, is_multiprocess, process_index
 
+NPROC = int(sys.argv[2])
 init_distributed(
     coordinator_address={coord!r},
-    num_processes=2,
+    num_processes=NPROC,
     process_id=int(sys.argv[1]),
 )
 assert is_multiprocess(), f"process_count={{jax.process_count()}}"
 assert process_index() == int(sys.argv[1])
-assert len(jax.devices()) == 2, jax.devices()  # both processes' devices visible
+assert len(jax.devices()) == NPROC, jax.devices()  # every process's device visible
 
 import jax.numpy as jnp
 import numpy as np
@@ -42,24 +45,26 @@ mesh = Mesh(jax.devices(), ("dp",))
 # each process contributes its own shard; the jitted sum crosses the
 # process boundary through the collective
 arr = jax.make_array_from_callback(
-    (2,), NamedSharding(mesh, P("dp")),
+    (NPROC,), NamedSharding(mesh, P("dp")),
     lambda idx: jnp.asarray([float(process_index()) + 1.0]),
 )
 total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
-assert float(total) == 3.0, float(total)  # 1.0 (proc 0) + 2.0 (proc 1)
+want_total = float(sum(range(1, NPROC + 1)))
+assert float(total) == want_total, float(total)
 
 # frame-level: each process contributes local rows; verbs run SPMD and the
-# reduction crosses the host boundary (≙ partitions on two executors)
+# reduction crosses the host boundary (≙ partitions on N executors)
 import tensorframes_tpu as tfs
 from tensorframes_tpu.parallel import frame_from_process_local
 
 pid = process_index()
-local = np.asarray([10.0 * pid + 1.0, 10.0 * pid + 2.0])  # p0: 1,2; p1: 11,12
+local = np.asarray([10.0 * pid + 1.0, 10.0 * pid + 2.0])
 frame = frame_from_process_local({{"v": local}}, mesh=mesh, axis="dp")
-assert frame.num_rows == 4  # global rows, both processes' shards
+assert frame.num_rows == 2 * NPROC  # global rows, all processes' shards
 doubled = tfs.map_blocks(lambda v: {{"w": v * 2.0}}, frame)
 s = tfs.reduce_blocks(lambda w_input: {{"w": w_input.sum(axis=0)}}, doubled)
-assert float(s) == 2.0 * (1 + 2 + 11 + 12), float(s)
+want_s = 2.0 * sum(10.0 * p + 1.0 + 10.0 * p + 2.0 for p in range(NPROC))
+assert float(s) == want_s, float(s)
 # keyed aggregate across processes: the sharded dense-bucket plan
 # (ops/device_agg.py) reduces per shard and merges with one psum over the
 # process boundary; only the tiny replicated bucket table reaches numpy,
@@ -73,15 +78,18 @@ with tfs.with_graph():
         tfs.reduce_sum(v_input, axis=0, name="v"), kf.group_by("k")
     )
 got = {{r["k"]: r["v"] for r in agg.collect()}}
-# p0 contributes k=0:1.0, k=1:2.0; p1 contributes k=1:11.0, k=2:12.0
-assert got == {{0: 1.0, 1: 13.0, 2: 12.0}}, got
+want = {{}}
+for p in range(NPROC):
+    want[p] = want.get(p, 0.0) + 10.0 * p + 1.0
+    want[p + 1] = want.get(p + 1, 0.0) + 10.0 * p + 2.0
+assert got == want, (got, want)
 # sharded persistence: each process writes its part, reloads, and the
 # reassembled global frame reduces to the same total across hosts
 sf_dir = {sf_dir!r}
 tfs.io.save_frame_sharded(frame, sf_dir)
 back = tfs.io.load_frame_sharded(sf_dir, mesh=mesh, axis="dp")
 s2 = tfs.reduce_blocks(lambda v_input: {{"v": v_input.sum(axis=0)}}, back)
-assert float(s2) == (1 + 2 + 11 + 12), float(s2)
+assert float(s2) == want_s / 2.0, float(s2)
 print(f"proc {{sys.argv[1]}} OK total={{float(total)}} frame_sum={{float(s)}}", flush=True)
 """
 
@@ -92,7 +100,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_psum(tmp_path):
+def _run_workers(tmp_path, nproc: int, timeout: float):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     coord = f"localhost:{_free_port()}"
     script = tmp_path / "worker.py"
@@ -104,25 +112,37 @@ def test_two_process_psum(tmp_path):
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i)],
+            [sys.executable, str(script), str(i), str(nproc)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     try:
         outs = []
         for p in procs:
-            out, _ = p.communicate(timeout=110)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
+        want_total = float(sum(range(1, nproc + 1)))
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
-            assert f"proc {i} OK total=3.0" in out, out[-2000:]
+            assert f"proc {i} OK total={want_total}" in out, out[-2000:]
     finally:
         # a hung coordinator rendezvous must not orphan workers into CI
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
+
+
+def test_two_process_psum(tmp_path):
+    _run_workers(tmp_path, 2, timeout=110)
+
+
+def test_four_process_psum(tmp_path):
+    """4 processes ≙ 4 hosts: multi-hop collectives, 4-writer sharded
+    save/load, and the device-aggregate merge at process_count=4
+    (VERDICT r1 next-step 7: scale the multi-process story past 2)."""
+    _run_workers(tmp_path, 4, timeout=150)

@@ -1,0 +1,158 @@
+"""Foreign frozen-graph ingestion: the reference's own GraphDef fixtures
+(src/test/resources/graph.pb, graph2.pb — loaded by
+PythonInterface.scala:115-118 / test/dsl.scala:109-112) must decode and
+execute through the verbs."""
+
+import os
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+_FIXTURES = "/root/reference/src/test/resources"
+
+
+def _fixture(name: str) -> str:
+    p = os.path.join(_FIXTURES, name)
+    if not os.path.exists(p):
+        pytest.skip(f"reference fixture {name} unavailable")
+    return p
+
+
+def test_parse_graph_pb_nodes():
+    with open(_fixture("graph.pb"), "rb") as f:
+        nodes = parse_graphdef(f.read())
+    by_name = {n.name: n for n in nodes}
+    assert set(by_name) == {"matrix1", "x"}
+    assert by_name["x"].op == "Placeholder"
+    assert by_name["matrix1"].op == "Const"
+    # matrix1 = [[3.0, 3.0]] float32 (the 1x2 constant the fixture embeds)
+    val = by_name["matrix1"].attrs["value"].tensor
+    np.testing.assert_array_equal(val, np.full((1, 2), 3.0, np.float32))
+
+
+def test_graph_pb_const_fetch_executes():
+    prog = tfs.load_graphdef(_fixture("graph.pb"), fetches=["matrix1"])
+    out = prog.fn({})
+    np.testing.assert_array_equal(
+        np.asarray(out["matrix1"]), np.full((1, 2), 3.0, np.float32)
+    )
+
+
+def test_graph2_pb_runs_through_map_blocks():
+    """graph2.pb: out = Add(z_1, z_2) over float [2,2] placeholders.
+    relax_lead_dim widens the fixed lead dim so the frozen graph maps
+    over arbitrary block row counts."""
+    prog = tfs.load_graphdef(
+        _fixture("graph2.pb"), fetches=["out"], relax_lead_dim=True
+    )
+    assert prog.input_names == ["z_1", "z_2"]
+    a = np.arange(12, dtype=np.float32).reshape(6, 2)
+    b = np.ones((6, 2), np.float32)
+    df = tfs.frame_from_arrays({"z_1": a, "z_2": b}, num_blocks=2)
+    res = tfs.map_blocks(prog, df)
+    got = np.concatenate([blk["out"] for blk in res.blocks()])
+    np.testing.assert_array_equal(got, a + b)
+
+
+def test_graph_pb_placeholder_feeds_map_blocks():
+    """graph.pb's x placeholder (float [2]) + matmul-free scoring: feed x
+    as a block column and fetch a Const-backed product via the DSL-less
+    path — here just identity on x through the graph's placeholder."""
+    prog = tfs.load_graphdef(
+        _fixture("graph.pb"), fetches=["matrix1", "x"], relax_lead_dim=True
+    )
+    x = np.arange(4, dtype=np.float32)
+    df = tfs.frame_from_arrays({"x": x}, num_blocks=1)
+    res = tfs.map_blocks(prog, df, trim=True)
+    rows = res.blocks()[0]
+    np.testing.assert_array_equal(rows["x"], x)
+
+
+def test_synthetic_reducer_roundtrip():
+    """A Sum-with-reduction_indices graph (the shape the reference DSL's
+    build_reducer emits, DslImpl.scala:175-200) — built here with TF if
+    available, else skipped; exercises Const-axis reducers end to end."""
+    tf = pytest.importorskip("tensorflow")
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float64, shape=[None, 3], name="x")
+        tf.reduce_sum(x, axis=[0], name="total")
+    data = g.as_graph_def().SerializeToString()
+    prog = program_from_graphdef(parse_graphdef(data), fetches=["total"])
+    feeds = {"x": np.arange(12, dtype=np.float64).reshape(4, 3)}
+    out = prog.fn(feeds)
+    np.testing.assert_array_equal(
+        np.asarray(out["total"]), feeds["x"].sum(axis=0)
+    )
+
+
+def test_unsupported_op_raises_with_name():
+    tf = pytest.importorskip("tensorflow")
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, shape=[2], name="x")
+        tf.raw_ops.Cumsum(x=x, axis=0, name="c")
+    data = g.as_graph_def().SerializeToString()
+    with pytest.raises(ValueError, match="Cumsum"):
+        program_from_graphdef(parse_graphdef(data))
+
+
+def test_tf_cross_check_elementwise_graph():
+    """Golden cross-check against real TensorFlow execution (the spirit of
+    the reference's ExtractNodes oracle, ExtractNodes.scala:13-76)."""
+    tf = pytest.importorskip("tensorflow")
+    g = tf.Graph()
+    with g.as_default():
+        a = tf.compat.v1.placeholder(tf.float32, shape=[None, 4], name="a")
+        b = tf.compat.v1.placeholder(tf.float32, shape=[None, 4], name="b")
+        c = tf.math.divide(tf.identity(a) + b * 2.0, 4.0, name="c")
+        tf.reduce_min(c, axis=[1], name="m")
+    data = g.as_graph_def().SerializeToString()
+    rng = np.random.default_rng(7)
+    feeds = {
+        "a": rng.normal(size=(5, 4)).astype(np.float32),
+        "b": rng.normal(size=(5, 4)).astype(np.float32),
+    }
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run("m:0", {"a:0": feeds["a"], "b:0": feeds["b"]})
+    prog = program_from_graphdef(parse_graphdef(data), fetches=["m"])
+    got = np.asarray(prog.fn(feeds)["m"])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def _varint(x: int) -> bytes:
+    out = b""
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        out += bytes([b | (0x80 if x else 0)])
+        if not x:
+            return out
+
+
+def test_half_val_const_decodes_bit_patterns():
+    """fp16 Consts stored in the typed half_val field (bit patterns as
+    varints) must decode to real values, not silent zeros."""
+    from tensorframes_tpu.graphdef import _parse_tensor
+
+    half_bits = [0x3E00, 0x4100]  # fp16 1.5, 2.5
+    payload = b"".join(_varint(b) for b in half_bits)
+    proto = (
+        b"\x08\x13"  # dtype = 19 (DT_HALF)
+        + b"\x12\x04\x12\x02\x08\x02"  # shape { dim { size: 2 } }
+        + b"\x6a" + _varint(len(payload)) + payload  # half_val packed
+    )
+    arr = _parse_tensor(proto)
+    assert arr.dtype == np.float16
+    np.testing.assert_array_equal(arr, np.asarray([1.5, 2.5], np.float16))
+
+
+def test_string_const_raises():
+    from tensorframes_tpu.graphdef import _parse_tensor
+
+    proto = b"\x08\x07" + b"\x42\x02hi"  # dtype=DT_STRING, string_val="hi"
+    with pytest.raises(ValueError, match="string"):
+        _parse_tensor(proto)

@@ -89,3 +89,12 @@ def test_int_column_with_float_cell_falls_back():
     df = tfs.frame_from_rows(rows)
     got = [r["x"] for r in df.collect()]
     assert got[1] == pytest.approx(2.5) or got[1] == 2  # numpy coercion class
+
+
+def test_parse_csv_rejects_non_int_dtype_code():
+    """A non-int element in the dtype-code list must raise cleanly (the
+    C++ loop checks the PyLong_AsLong sentinel) instead of continuing
+    with a garbage code and surfacing a SystemError later."""
+    mod = native._load()
+    with pytest.raises(TypeError):
+        mod.parse_csv(b"1,2\n3,4\n", ord(","), ["not-an-int", 1])

@@ -118,6 +118,66 @@ def test_sharded_aggregate():
         assert res[k]["v"] == expected
 
 
+def test_sharded_aggregate_string_keys_device_plan():
+    """String keys ride the dictionary-encoding device plan (one host
+    pass over the key column; values reduce on device) and match the
+    host-path result and ordering."""
+    import string
+
+    n = 4000
+    labels = [string.ascii_lowercase[i % 7] for i in range(n)]
+    vals = np.arange(n, dtype=np.float64)
+    dev = tfs.frame_from_rows(
+        [{"k": labels[i], "v": float(i)} for i in range(n)]
+    ).to_device()
+    assert dev.is_sharded
+    v_input = tfs.block(dev, "v", tf_name="v_input")
+    v = tfs.reduce_sum(v_input, axis=0, name="v")
+    res = tfs.aggregate(v, dev.group_by("k")).collect()
+    want = {}
+    for lab, x in zip(labels, vals):
+        want[lab] = want.get(lab, 0.0) + x
+    assert [r["k"] for r in res] == sorted(want)  # lexicographic order
+    assert {r["k"]: r["v"] for r in res} == pytest.approx(want)
+
+
+def test_sharded_aggregate_huge_span_int_keys():
+    """Integer keys with span >> 2^20 exceed the dense plan but ride the
+    dictionary plan: K = #distinct groups, not the key span."""
+    rng = np.random.default_rng(3)
+    base = rng.choice(np.arange(0, 2**40, 2**33, dtype=np.int64), size=4000)
+    vals = rng.normal(size=4000)
+    dev = tfs.frame_from_arrays({"key": base, "v": vals}).to_device()
+    v_input = tfs.block(dev, "v", tf_name="v_input")
+    v = tfs.reduce_sum(v_input, axis=0, name="v")
+    res = tfs.aggregate(v, dev.group_by("key")).collect()
+    want = {}
+    for k, x in zip(base, vals):
+        want[int(k)] = want.get(int(k), 0.0) + float(x)
+    assert [r["key"] for r in res] == sorted(want)
+    for r in res:
+        assert r["v"] == pytest.approx(want[r["key"]], rel=1e-9)
+
+
+def test_sharded_aggregate_composite_string_int_keys():
+    """Composite (string, int) group keys through the dictionary plan."""
+    n = 2000
+    rows = [
+        {"a": "xy"[i % 2], "b": np.int64((i // 2) % 3), "v": float(i)}
+        for i in range(n)
+    ]
+    dev = tfs.frame_from_rows(rows).to_device()
+    v_input = tfs.block(dev, "v", tf_name="v_input")
+    v = tfs.reduce_sum(v_input, axis=0, name="v")
+    res = tfs.aggregate(v, dev.group_by("a", "b")).collect()
+    want = {}
+    for r in rows:
+        key = (r["a"], int(r["b"]))
+        want[key] = want.get(key, 0.0) + r["v"]
+    assert [(r["a"], r["b"]) for r in res] == sorted(want)
+    assert {(r["a"], r["b"]): r["v"] for r in res} == pytest.approx(want)
+
+
 def test_to_host_roundtrip():
     host = _frame(32)
     back = host.to_device().to_host(num_blocks=4)
@@ -226,6 +286,85 @@ def test_sharded_reduce_rows_after_trim_falls_back():
     trimmed = tfs.map_blocks(lambda x: {"x": x[:5]}, dev, trim=True)
     got = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, trimmed)
     assert float(got) == float(np.arange(5).sum())
+
+
+def test_sharded_aggregate_after_trim_falls_back():
+    """Same trimmed-shape hazard for the device-aggregate fast path: a
+    row count the mesh no longer divides must decline to the host path
+    instead of crashing inside shard_map."""
+    import tensorframes_tpu as tfs
+
+    dev = tfs.frame_from_arrays(
+        {
+            "key": np.arange(4000, dtype=np.int64) % 4,
+            "x": np.arange(4000, dtype=np.float64),
+        }
+    ).to_device()
+    trimmed = tfs.map_blocks(
+        lambda key, x: {"key": key[:5], "x": x[:5]}, dev, trim=True
+    )
+    x_input = tfs.block(trimmed, "x", tf_name="x_input")
+    x = tfs.reduce_sum(x_input, axis=0, name="x")
+    res = tfs.aggregate(x, trimmed.group_by("key")).collect()
+    # per-shard the first 5 rows of each 500-row shard survive
+    host = {}
+    for r in trimmed.collect():
+        host[r["key"]] = host.get(r["key"], 0.0) + r["x"]
+    assert {r["key"]: r["x"] for r in res} == host
+
+
+def test_grouped_count_rides_fast_path():
+    """count() builds its fetch via the DSL so segment_reduce_info
+    recognizes it (a plain lambda would take the generic chunked path)."""
+    import tensorframes_tpu as tfs
+
+    dev = tfs.frame_from_arrays(
+        {"key": np.arange(4000, dtype=np.int64) % 3}
+    ).to_device()
+    out = dev.group_by("key").count()
+    got = {r["key"]: r["count"] for r in out.collect()}
+    assert got == {0: 1334, 1: 1333, 2: 1333}
+
+
+def test_trimmed_sharded_frame_is_verb_composable():
+    """trim=True on a sharded frame re-balances the output to to_device
+    invariants (divisible main block + host tail), so the full chain
+    trimmed map → map → aggregate → collect stays on the device fast
+    paths and equals the host-path result (SURVEY §7 hard-part 3)."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.ops.device_agg import try_aggregate_device
+
+    n = 4000
+    keys = np.arange(n, dtype=np.int64) % 5
+    vals = np.arange(n, dtype=np.float64)
+    dev = tfs.frame_from_arrays({"key": keys, "x": vals}).to_device()
+    # keep the first 1003 global rows: 1003 % 8 != 0 pre-balance
+    trimmed = tfs.map_blocks(
+        lambda key, x: {"key": key[:1003], "x": x[:1003]}, dev, trim=True
+    )
+    blocks = trimmed.blocks()
+    assert trimmed.is_sharded
+    assert blocks[0]["x"].shape[0] == 1000  # divisible main block
+    assert len(blocks) == 2 and len(blocks[1]["x"]) == 3  # host tail
+    # downstream map chains on device
+    mapped = tfs.map_blocks(lambda x: {"y": x * 2.0}, trimmed)
+    # aggregate rides the device plan again (guard no longer trips)
+    y_input = tfs.block(mapped, "y", tf_name="y_input")
+    fetch = tfs.reduce_sum(y_input, axis=0, name="y")
+    seg_info = [("y", "reduce_sum", "y_input")]
+    mapped.blocks()
+    assert (
+        try_aggregate_device(mapped, ["key"], seg_info, ["y"])
+        is not None
+    )
+    res = tfs.aggregate(fetch, mapped.group_by("key")).collect()
+    want = {}
+    for k, v in zip(keys[:1003], vals[:1003]):
+        want[int(k)] = want.get(int(k), 0.0) + 2.0 * float(v)
+    assert {r["key"]: r["y"] for r in res} == pytest.approx(want)
+    # reduce_rows also stays sharded-eligible
+    got = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, trimmed)
+    assert float(got) == pytest.approx(float(vals[:1003].sum()))
 
 
 def test_tiny_frame_to_device_all_tail():

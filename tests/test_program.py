@@ -150,8 +150,9 @@ def test_cost_analysis():
 
 
 def test_recompile_accounting():
-    """Ragged map_rows compiles once per distinct cell shape; the cache
-    sizes are queryable (honest recompile accounting, SURVEY §7)."""
+    """Ragged map_rows compiles once per distinct (cell shape, lead-dim
+    bucket) group — through the vmapped entrypoint, not per row; the
+    cache sizes are queryable (honest recompile accounting, SURVEY §7)."""
     import tensorframes_tpu as tfs
 
     rows = [{"v": [1.0, 2.0]}, {"v": [3.0]}, {"v": [4.0, 5.0, 6.0]},
@@ -162,7 +163,9 @@ def test_recompile_accounting():
     )
     tfs.map_rows(program, frame).collect()
     sizes = program.compiled().cache_sizes()
-    assert sizes["block"] == 3  # cell shapes (2,), (1,), (3,)
+    # cell shapes (2,), (1,), (3,) — each group one bucketed vmap compile
+    assert sizes["vmap"] == 3
+    assert sizes["block"] == 0  # no per-row dispatches
     assert "compiled_shapes" in program.explain()
 
 

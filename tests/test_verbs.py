@@ -402,7 +402,7 @@ def test_map_blocks_pipeline_depths_agree():
         np.testing.assert_array_equal(got, np.arange(1000.0) * 2.0 + 1.0)
 
 
-def test_aggregate_string_keys():
+def test_aggregate_string_keys_plain_fn():
     """groupBy on a host string column (≙ Catalyst groupBy on strings —
     keys never touch the device; values aggregate on it)."""
     fr = tfs.frame_from_rows(
