@@ -345,7 +345,13 @@ def main():
     # probe unless explicitly pinned to cpu: an unset JAX_PLATFORMS still
     # auto-detects accelerators, which is exactly where a wedged backend
     # would hang jax.devices() forever
-    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu" and not _probe_backend():
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # the env var alone is not enough — an accelerator sitecustomize
+        # can re-pin the platform after import; force the config
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif not _probe_backend():
         print("# accelerator backend unresponsive; falling back to cpu")
         import jax
 

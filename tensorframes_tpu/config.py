@@ -55,6 +55,15 @@ class Config:
     # Per-chip peak FLOP/s for MFU accounting in profiling.report()
     # (0 = unknown; bench.py sets it from the detected device kind).
     peak_flops: float = float(os.environ.get("TFTPU_PEAK_FLOPS", 0) or 0)
+    # Demote f64/i64 device columns to f32/i32 at the device boundary:
+    # False = never (reference-parity precision, f64 emulated on TPU),
+    # True = on TPU backends only, "always" = every backend (testing /
+    # CPU measurement). Accounted for in explain(detailed=True).
+    demote_x64_on_tpu: object = (
+        "always"
+        if os.environ.get("TFTPU_DEMOTE_X64", "").lower() == "always"
+        else _env_bool("TFTPU_DEMOTE_X64", False)
+    )
 
 
 _config = Config()

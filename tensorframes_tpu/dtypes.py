@@ -87,6 +87,35 @@ class UnsupportedTypeError(TypeError):
     ``SupportedOperations.opsFor`` (datatypes.scala:265-324)."""
 
 
+# 64-bit → 32-bit demotion table for the TPU x64 story (VERDICT r1
+# next-step 2): f64 matmuls/reductions on TPU are software-emulated, so
+# reference-parity Double/Long columns can optionally demote at the
+# device boundary.
+_DEMOTIONS = {float64: float32, int64: int32}
+
+
+def demote(t: ScalarType) -> ScalarType:
+    """The 32-bit device type a 64-bit column demotes to (identity for
+    everything else)."""
+    return _DEMOTIONS.get(t, t)
+
+
+def demotion_active() -> bool:
+    """True when ``configure(demote_x64_on_tpu=...)`` applies to the
+    current backend: ``"always"`` forces it (tests/CPU measurement);
+    ``True`` restricts it to real TPU backends."""
+    from .config import get_config
+
+    cfg = getattr(get_config(), "demote_x64_on_tpu", False)
+    if cfg == "always":
+        return True
+    if cfg:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    return False
+
+
 def all_types():
     return list(_ALL_TYPES)
 

@@ -348,12 +348,23 @@ class TensorFrame:
         # small host tail block (verbs handle multi-block frames natively),
         # so no padding ever corrupts reduction semantics.
         n_main = (total // dp) * dp
+        demote = dt.demotion_active()
         merged: Block = {}
         tail: Block = {}
+        infos: List[ColumnInfo] = []
         for info in self.schema:
             parts = [b[info.name] for b in blocks]
             if info.is_device and all(not isinstance(p, list) for p in parts):
                 arr = np.concatenate([np.asarray(p) for p in parts], axis=0)
+                if demote and dt.demote(info.dtype) is not info.dtype:
+                    # x64 demotion: store HBM-resident columns in 32-bit
+                    # (halves bandwidth/footprint); schema follows so
+                    # downstream validation/analysis see a consistent
+                    # 32-bit world
+                    info = ColumnInfo(
+                        info.name, dt.demote(info.dtype), info.block_shape
+                    )
+                    arr = arr.astype(info.dtype.np_dtype)
                 sharding = batch_sharding(mesh, arr.ndim, axis)
                 merged[info.name] = jax.device_put(arr[:n_main], sharding)
                 if n_main < total:
@@ -365,8 +376,9 @@ class TensorFrame:
                 merged[info.name] = flat[:n_main]
                 if n_main < total:
                     tail[info.name] = flat[n_main:]
+            infos.append(info)
         out_blocks = [merged] + ([tail] if n_main < total else [])
-        out = TensorFrame(out_blocks, self.schema)
+        out = TensorFrame(out_blocks, Schema(infos))
         out._mesh = mesh
         out._axis = axis
         return out
@@ -762,6 +774,17 @@ def explain(frame: TensorFrame, detailed: bool = False) -> str:
     if not detailed:
         return base
     lines = [base, ""]
+    if dt.demotion_active():
+        demoting = [
+            c.name
+            for c in frame.schema.device_columns
+            if dt.demote(c.dtype) is not c.dtype
+        ]
+        lines.append(
+            "x64 demotion active (config.demote_x64_on_tpu): "
+            "float64->float32, int64->int32 at the device boundary"
+            + (f"; affected columns: {demoting}" if demoting else "")
+        )
     state = "materialized" if frame.is_materialized else "lazy (forcing)"
     blocks = frame.blocks()
     lines.append(

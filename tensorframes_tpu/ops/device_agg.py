@@ -50,6 +50,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel._shard_map import shard_map
 from ..utils import get_logger
+from .keys import group_ids, mixed_radix_strides
 
 logger = get_logger(__name__)
 
@@ -211,18 +212,23 @@ def try_aggregate_device(
         K = math.prod(ranges)
         if K <= _KEY_LIMIT and K * feat <= _TABLE_ELEM_LIMIT:
             # keys[0] most significant → bucket order == lexicographic order
-            strides = [1] * len(keys)
-            for i in range(len(keys) - 2, -1, -1):
-                strides[i] = strides[i + 1] * ranges[i + 1]
+            strides = mixed_radix_strides(ranges)
+            # widen BEFORE the offset subtraction: an int8 key spanning
+            # -128..127 must not wrap its 255-wide offset (the negative
+            # id would be silently dropped by the XLA scatter)
             keys_off = tuple(
-                (main[k] - mins[i]).astype(jnp.int32)
+                (main[k].astype(jnp.int32) - np.int32(mins[i]))
+                if main[k].dtype.itemsize < 8
+                else (main[k] - mins[i]).astype(jnp.int32)
                 for i, k in enumerate(keys)
             )
             ids_tail = None
             if tail is not None:
                 ids_tail = np.zeros(len(tail[keys[0]]), np.int64)
                 for i, k in enumerate(keys):
-                    ids_tail += (np.asarray(tail[k]) - mins[i]) * strides[i]
+                    ids_tail += (
+                        np.asarray(tail[k]).astype(np.int64) - mins[i]
+                    ) * strides[i]
             sel, out_cols = _run_tables(
                 frame, axis, ops, out_names, K, strides, keys_off,
                 main, tail, ids_tail,
@@ -260,22 +266,8 @@ def try_aggregate_device(
             )
             arr = np.concatenate([arr, tarr])
         key_host.append(arr)
-    codes: List[np.ndarray] = []
-    uniques: List[np.ndarray] = []
-    span = 1
-    for arr in key_host:
-        u, c = np.unique(arr, return_inverse=True)
-        uniques.append(u)
-        codes.append(c.astype(np.int64))
-        span *= len(u)
-        if span > 1 << 62:  # composite code must fit int64
-            return None
-    comb = codes[0]
-    for c, u in zip(codes[1:], uniques[1:]):
-        comb = comb * np.int64(len(u)) + c
-    # sorted uniques ⇒ combined codes sort lexicographically by key tuple
-    ucomb, ids_all = np.unique(comb, return_inverse=True)
-    K = len(ucomb)
+    # shared encoder (ops/keys.py): dense group ids, lexicographic order
+    ids_all, group_key_cols, K = group_ids(key_host)
     if K * feat > _TABLE_ELEM_LIMIT:
         logger.debug(
             "device aggregate: %d groups ×%d feat exceeds the table limit; "
@@ -288,14 +280,9 @@ def try_aggregate_device(
         frame, axis, ops, out_names, K, (1,), (jnp.asarray(ids_main),),
         main, tail, ids_tail,
     )
-    # decode group ids back to key values (sel indexes ucomb)
-    strides_u = [1] * len(keys)
-    for i in range(len(keys) - 2, -1, -1):
-        strides_u[i] = strides_u[i + 1] * len(uniques[i + 1])
     key_cols = {}
     for i, k in enumerate(keys):
-        code = (ucomb[sel] // strides_u[i]) % len(uniques[i])
-        vals = uniques[i][code]
+        vals = group_key_cols[i][sel]
         info = frame.schema[k]
         key_cols[k] = (
             vals.astype(info.dtype.np_dtype) if info.is_device else vals

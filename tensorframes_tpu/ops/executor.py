@@ -127,6 +127,7 @@ def gather_feeds(
     reference's equivalent failure happens in ``TFDataOps.convert``'s
     lead-dim check (TFDataOps.scala:28-59).
     """
+    demote = dt.demotion_active()
     feeds = {}
     for name in input_names:
         v = block[name]
@@ -140,6 +141,13 @@ def gather_feeds(
                     "dense block. Use map_rows for ragged data, or run "
                     "analyze()/append_shape() if the cells are uniform."
                 ) from None
+        elif demote:
+            # x64 demotion boundary: cast 64-bit columns down to the
+            # program's 32-bit input spec (works for numpy and sharded
+            # jax arrays alike — on device it is a cheap elementwise op)
+            spec = program.input(name)
+            if getattr(v, "dtype", None) != spec.dtype.np_dtype:
+                v = v.astype(spec.dtype.np_dtype)
         feeds[name] = v
     return feeds
 

@@ -1,0 +1,87 @@
+"""Group-key encoding shared by the host aggregate path and the sharded
+device plans: key columns → dense group ids in lexicographic group order
+(the ordering Catalyst's groupBy output sort matched,
+DebugRowOps.scala:583).
+
+Two strategies:
+
+* **dense span** — all-integer keys with a small mixed-radix span use
+  pure O(n) arithmetic + bincount; no sort of any kind;
+* **dictionary** — anything else encodes via ``np.unique`` per column,
+  then a composite code. NaN float keys collapse into ONE group — the
+  Catalyst/Spark groupBy convention (NaNs compare equal for grouping).
+
+All arithmetic is performed in int64 regardless of the key column dtype
+(an int8 key spanning -128..127 must not wrap its 255-wide offset).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# max dense bucket count for the arithmetic strategy
+DENSE_SPAN_LIMIT = 1 << 20
+
+
+def group_ids(
+    arrs: Sequence[np.ndarray],
+) -> Tuple[np.ndarray, List[np.ndarray], int]:
+    """Encode parallel key columns into dense group ids.
+
+    Returns ``(seg_ids, group_key_cols, num_groups)`` where ``seg_ids``
+    is int64 of row length, ``group_key_cols`` holds one array per input
+    column with the key values of each group (lexicographic order), and
+    ``num_groups`` is the distinct-group count.
+    """
+    arrs = [np.asarray(a) for a in arrs]
+    if all(np.issubdtype(a.dtype, np.integer) for a in arrs):
+        mins = [int(a.min()) for a in arrs]
+        ranges = [int(a.max()) - m + 1 for a, m in zip(arrs, mins)]
+        K = 1
+        for r in ranges:  # python ints: no overflow past the gate
+            K *= r
+        if K <= DENSE_SPAN_LIMIT:
+            comb = arrs[0].astype(np.int64) - mins[0]
+            for a, m, r in zip(arrs[1:], mins[1:], ranges[1:]):
+                comb = comb * np.int64(r) + (a.astype(np.int64) - m)
+            counts = np.bincount(comb, minlength=K)
+            present = np.flatnonzero(counts)
+            remap = np.empty(K, np.int64)
+            remap[present] = np.arange(len(present))
+            seg_ids = remap[comb]
+            strides = mixed_radix_strides(ranges)
+            group_key_cols = [
+                ((present // strides[i]) % ranges[i] + mins[i]).astype(
+                    arrs[i].dtype
+                )
+                for i in range(len(arrs))
+            ]
+            return seg_ids, group_key_cols, len(present)
+    comb = None
+    for a in arrs:
+        _, c = np.unique(a, return_inverse=True)
+        c = c.astype(np.int64)
+        if comb is None:
+            comb = c
+        else:
+            # comb is densified each step (< n), so comb*radix+c stays
+            # within int64 up to ~3e9 rows — no mixed-radix overflow
+            _, comb = np.unique(comb, return_inverse=True)
+            comb = comb.astype(np.int64) * np.int64(int(c.max()) + 1) + c
+    _, first_idx, seg_ids = np.unique(
+        comb, return_index=True, return_inverse=True
+    )
+    # each group's key values = the key tuple at its first occurrence
+    group_key_cols = [a[first_idx] for a in arrs]
+    return seg_ids.astype(np.int64), group_key_cols, len(first_idx)
+
+
+def mixed_radix_strides(ranges: Sequence[int]) -> List[int]:
+    """Strides with the FIRST key most significant, so composite codes
+    order lexicographically by key tuple."""
+    strides = [1] * len(ranges)
+    for i in range(len(ranges) - 2, -1, -1):
+        strides[i] = strides[i + 1] * ranges[i + 1]
+    return strides

@@ -141,6 +141,14 @@ def _normalize_program(
         if shape_hints
         else None
     )
+    if dt.demotion_active():
+        # x64 demotion: analyze (and hence trace/execute) the program
+        # against 32-bit input specs; gather_feeds casts at the boundary
+        demoted = [
+            TensorSpec(s.name, dt.demote(s.dtype), s.shape)
+            for s in program.inputs
+        ]
+        program = Program(program.fn, demoted, fetch_order=program.fetch_order)
     program = analyze_program(program, hints=hints)
     program.seg_info = seg_info  # survives Program reuse via compile_program
     return program, seg_info
@@ -402,11 +410,17 @@ def map_rows(
             if not block_is_ragged(b, input_names):
                 feeds = gather_feeds(b, input_names, program)
                 if not parent.is_sharded:
-                    # lead-dim bucketing: pad to a power-of-two row count
-                    # so varying block sizes share O(log n) compiles
-                    # (sharded main blocks have one stable size — and
-                    # padding would disturb their device layout)
-                    target = bucket_rows(n)
+                    # adaptive lead-dim bucketing: the partitioner yields
+                    # at most two block sizes, so the first few distinct
+                    # shapes compile exactly (zero padded work); once the
+                    # vmap cache shows shape proliferation (>= 3 distinct
+                    # sizes — an externally-built frame), pad to
+                    # power-of-two buckets so compiles stay O(log n).
+                    # (Sharded main blocks have one stable size — and
+                    # padding would disturb their device layout.)
+                    target = n
+                    if compiled.cache_sizes()["vmap"] >= 3:
+                        target = bucket_rows(n)
                     feeds = pad_lead_dim(feeds, n, target)
                     outs = compiled.run_rows(feeds, to_numpy=False)
                     outs = {k: np.asarray(v[:n]) for k, v in outs.items()}
@@ -427,12 +441,19 @@ def map_rows(
                 per_row: List[Optional[Dict[str, np.ndarray]]] = [None] * n
                 for idx in groups.values():
                     g = len(idx)
-                    feeds = {
-                        name: np.stack(
+                    feeds = {}
+                    for name in input_names:
+                        stacked = np.stack(
                             [np.asarray(b[name][i]) for i in idx]
                         )
-                        for name in input_names
-                    }
+                        spec = program.input(name)
+                        if (
+                            dt.demotion_active()
+                            and stacked.dtype != spec.dtype.np_dtype
+                        ):
+                            # x64 demotion boundary (mirrors gather_feeds)
+                            stacked = stacked.astype(spec.dtype.np_dtype)
+                        feeds[name] = stacked
                     feeds = pad_lead_dim(feeds, g, bucket_rows(g))
                     outs_g = compiled.run_rows(feeds, to_numpy=True)
                     for j, i in enumerate(idx):
@@ -671,6 +692,21 @@ from functools import lru_cache
 from .segment import segment_sum as _segment_sum
 
 
+def _host_group_ids(key_cols, keys):
+    """Dense group ids (lexicographic group order) for the host aggregate
+    path, touching ONLY the key columns — value columns are never
+    reordered because segment scatters take unsorted ids (this replaces
+    the old full-row lexsort ≙ Catalyst's shuffle, DebugRowOps.scala:583).
+    Encoding strategies live in :mod:`.keys` (shared with the sharded
+    device plans). Returns ``(seg_ids, out_key_cols, num_groups)``."""
+    from .keys import group_ids
+
+    seg_ids, group_key_cols, num_groups = group_ids(
+        [key_cols[k] for k in keys]
+    )
+    return seg_ids, dict(zip(keys, group_key_cols)), num_groups
+
+
 @lru_cache(maxsize=32)
 def _seg_fast_for(ops, num_groups):
     """Jitted keyed reduction over key-sorted rows: one XLA program for all
@@ -792,23 +828,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
                 empty[i.name] = []
         profiling.record("aggregate", time.perf_counter() - t0, 0)
         return TensorFrame([empty], Schema(infos))
-    order = np.lexsort(tuple(np.asarray(key_cols[k]) for k in reversed(keys)))
-    sorted_keys = {k: np.asarray(key_cols[k])[order] for k in keys}
-    # group boundaries over the sorted key tuples
-    if len(keys) == 1:
-        kview = sorted_keys[keys[0]]
-        change = np.empty(n, dtype=bool)
-        change[0] = True
-        change[1:] = kview[1:] != kview[:-1]
-    else:
-        change = np.zeros(n, dtype=bool)
-        change[0] = True
-        for k in keys:
-            kv = sorted_keys[k]
-            change[1:] |= kv[1:] != kv[:-1]
-    seg_ids = np.cumsum(change) - 1
-    num_groups = int(seg_ids[-1]) + 1 if n else 0
-    group_starts = np.flatnonzero(change)
+    seg_ids, out_key_cols, num_groups = _host_group_ids(key_cols, keys)
 
     out_cols: Dict[str, np.ndarray] = {}
     if seg_info is not None and all(op in _SEGMENT_OPS or op == "reduce_mean" for _, op, _ in seg_info):
@@ -817,10 +837,10 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         # and sids a real argument, so repeated aggregates with the same
         # shapes reuse one XLA executable (no giant captured constants)
         ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
-        sorted_vals = {x: jnp.asarray(val_cols[x][order]) for x in out_names}
+        seg_vals = {x: jnp.asarray(val_cols[x]) for x in out_names}
         sids = jnp.asarray(seg_ids)
         try:
-            res = _seg_fast_for(ops_key, num_groups)(sorted_vals, sids)
+            res = _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
         except Exception as e:
             from . import segment as _segment
 
@@ -831,10 +851,15 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
                 raise
             _segment.disable_pallas(f"{type(e).__name__} in aggregate")
             _seg_fast_for.cache_clear()  # drop executables traced w/ pallas
-            res = _seg_fast_for(ops_key, num_groups)(sorted_vals, sids)
+            res = _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
         out_cols = {x: np.asarray(res[x]) for x in out_names}
     else:
-        # -- generic chunked-compaction path --------------------------------
+        # -- generic chunked-compaction path (needs contiguous groups:
+        # stable argsort of the int ids, cheaper than a lexsort over the
+        # original key columns) ---------------------------------------------
+        order = np.argsort(seg_ids, kind="stable")
+        counts = np.bincount(seg_ids, minlength=num_groups)
+        group_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
         compiled = program.compiled()
         buf = max(2, get_config().aggregate_buffer_size)
         sorted_vals = {x: val_cols[x][order] for x in out_names}
@@ -866,5 +891,4 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         out_cols = {x: np.stack(results[x]) if results[x] else np.empty((0,)) for x in out_names}
 
     # -- assemble result frame: key cols + fetch cols -----------------------
-    out_key_cols = {k: np.asarray(sorted_keys[k])[group_starts] for k in keys}
     return _assemble(out_key_cols, out_cols, n)

@@ -51,6 +51,11 @@ def _no_collisions(outputs: Sequence[TensorSpec], schema: Schema) -> None:
 
 def _check_dtype(col: ColumnInfo, spec: TensorSpec, role: str) -> None:
     if col.dtype is not spec.dtype:
+        # the single sanctioned exception to no-casting: an f64/i64
+        # column feeding a demoted 32-bit program input while x64
+        # demotion is active (config.demote_x64_on_tpu)
+        if dt.demotion_active() and dt.demote(col.dtype) is spec.dtype:
+            return
         raise ValidationError(
             f"{role} {spec.name!r} has dtype {spec.dtype.name} but column "
             f"{col.name!r} has dtype {col.dtype.name}. No implicit casting "

@@ -32,8 +32,11 @@ def test_bucket_rows_bounds(bucket_cfg):
 
 
 def test_map_rows_bounded_compiles_over_varied_block_sizes(bucket_cfg):
-    """19 distinct block sizes share O(log n) vmap compiles, and the
-    padded rows never leak into results."""
+    """19 distinct block sizes share a bounded number of vmap compiles,
+    and the padded rows never leak into results. Bucketing is adaptive:
+    the first 3 distinct sizes compile exactly (zero padded work for
+    partitioner-produced frames, which have at most two sizes); after
+    that, sizes pad to power-of-two buckets — O(3 + log n) compiles."""
     sizes = list(range(1, 20))
     blocks = []
     off = 0
@@ -46,8 +49,19 @@ def test_map_rows_bounded_compiles_over_varied_block_sizes(bucket_cfg):
     out = tfs.map_rows(program, fr)
     got = np.concatenate([np.atleast_1d(b["y"]) for b in out.blocks()])
     np.testing.assert_array_equal(got, np.arange(off, dtype=np.float64) * 2.0 + 1.0)
-    # sizes 1..19 → buckets {8, 16, 32}: three compiles, not nineteen
-    assert program.compiled().cache_sizes()["vmap"] <= 3
+    # exact sizes {1,2,3} then buckets {8,16,32}: six compiles, not 19
+    assert program.compiled().cache_sizes()["vmap"] <= 6
+
+
+def test_map_rows_partitioner_frames_never_pad(bucket_cfg):
+    """Frames from the internal partitioner (at most two distinct block
+    sizes) stay on exact-shape compiles — no padded compute, ever."""
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(1001, dtype=np.float64)}, num_blocks=4
+    )  # blocks of 251 and 250 rows
+    program = tfs.compile_program(lambda x: {"y": x + 1.0}, fr, block=False)
+    tfs.map_rows(program, fr).blocks()
+    assert program.compiled().cache_sizes()["vmap"] == 2  # exact, unpadded
 
 
 def test_ragged_map_rows_grouped_dispatch(bucket_cfg):

@@ -1,0 +1,105 @@
+"""The x64 demotion option (VERDICT r1 next-step 2): reference-parity
+Double/Long columns can demote to f32/i32 at the device boundary —
+``configure(demote_x64_on_tpu=True)`` applies on real TPU backends,
+``"always"`` forces it anywhere (this suite runs it on the CPU mesh).
+Accounting surfaces in ``explain(detailed=True)``."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.config import configure, get_config
+
+
+@pytest.fixture
+def demoted():
+    old = get_config().demote_x64_on_tpu
+    configure(demote_x64_on_tpu="always")
+    yield
+    configure(demote_x64_on_tpu=old)
+
+
+def test_demotion_inactive_by_default():
+    assert get_config().demote_x64_on_tpu is False
+    assert not dt.demotion_active()
+
+
+def test_ragged_map_rows_demotes(demoted):
+    """The grouped ragged dispatch honors the demoted input spec (it
+    bypasses gather_feeds, so it casts explicitly)."""
+    rows = [{"v": list(np.arange(3 + (i % 2), dtype=np.float64))}
+            for i in range(6)]
+    fr = tfs.frame_from_rows(rows, num_blocks=1)
+    out = tfs.map_rows(lambda v: {"s": v.sum()}, fr)
+    assert out.schema["s"].dtype is dt.float32
+    got = out.blocks()[0]["s"]
+    assert got.dtype == np.float32
+    want = [float(np.arange(3 + (i % 2)).sum()) for i in range(6)]
+    np.testing.assert_allclose(got, want)
+
+
+def test_map_blocks_outputs_f32_under_demotion(demoted):
+    df = tfs.frame_from_arrays({"x": np.arange(10, dtype=np.float64)})
+    out = tfs.map_blocks(lambda x: {"z": x * 2.0 + 1.0}, df)
+    assert out.schema["z"].dtype is dt.float32
+    vals = out.column_values("z")
+    assert vals.dtype == np.float32
+    np.testing.assert_allclose(vals, np.arange(10) * 2.0 + 1.0, rtol=1e-6)
+    # the input column itself is untouched on the host
+    assert out.schema["x"].dtype is dt.float64
+
+
+def test_dsl_program_demotes(demoted):
+    df = tfs.frame_from_rows([{"x": float(i)} for i in range(8)])
+    with tfs.with_graph():
+        x = tfs.block(df, "x")
+        out = tfs.map_blocks(tfs.add(x, 3, name="z"), df)
+    assert out.schema["z"].dtype is dt.float32
+    assert [r["z"] for r in out.collect()] == [float(i) + 3 for i in range(8)]
+
+
+def test_to_device_demotes_storage_and_schema(demoted):
+    df = tfs.frame_from_arrays(
+        {
+            "k": np.arange(4000, dtype=np.int64) % 7,
+            "x": np.arange(4000, dtype=np.float64),
+        }
+    ).to_device()
+    assert df.schema["x"].dtype is dt.float32
+    assert df.schema["k"].dtype is dt.int32
+    main = df.blocks()[0]
+    assert main["x"].dtype == np.float32
+    # verbs compose in the 32-bit world, incl. the device aggregate plan
+    with tfs.with_graph():
+        x_in = tfs.block(df, "x", tf_name="x_input")
+        res = tfs.aggregate(
+            tfs.reduce_sum(x_in, axis=0, name="x"), df.group_by("k")
+        ).collect()
+    want = {}
+    for i in range(4000):
+        want[i % 7] = want.get(i % 7, 0.0) + float(i)
+    got = {r["k"]: r["x"] for r in res}
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-5)
+
+
+def test_reduce_rows_under_demotion(demoted):
+    df = tfs.frame_from_arrays({"x": np.arange(100, dtype=np.float64)})
+    got = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, df)
+    assert float(got) == pytest.approx(4950.0)
+
+
+def test_explain_accounts_for_demotion(demoted):
+    df = tfs.frame_from_arrays({"x": np.arange(4, dtype=np.float64)})
+    text = tfs.explain(df, detailed=True)
+    assert "x64 demotion active" in text
+    assert "x" in text
+
+
+def test_no_demotion_when_disabled():
+    assert get_config().demote_x64_on_tpu is False
+    df = tfs.frame_from_arrays({"x": np.arange(10, dtype=np.float64)})
+    out = tfs.map_blocks(lambda x: {"z": x * 2.0}, df)
+    assert out.schema["z"].dtype is dt.float64

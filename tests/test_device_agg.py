@@ -220,3 +220,18 @@ def test_groupby_count_sharded():
     got = {r["k"]: r["count"] for r in counted.collect()}
     for key in np.unique(k):
         assert got[int(key)] == int((k == key).sum())
+
+
+def test_int8_full_span_keys_no_wrap():
+    """int8 keys spanning -128..127: the 255-wide offset must widen
+    before subtraction — a wrap would silently drop whole groups."""
+    keys = np.array(([-128] * 8 + [127] * 8) * 100, np.int8)
+    vals = np.ones(len(keys), np.float32)
+    dev = tfs.frame_from_arrays({"k": keys, "v": vals}).to_device()
+    got = device_agg.try_aggregate_device(
+        dev, ["k"], (("v", "reduce_sum", 1),), ["v"]
+    )
+    assert got is not None
+    key_cols, out_cols = got
+    assert list(key_cols["k"]) == [-128, 127]
+    assert list(out_cols["v"]) == [800.0, 800.0]

