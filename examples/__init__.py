@@ -6,7 +6,8 @@ and geometric means via aggregate (geom_mean.py:26-49), and model inference
 over an image frame (read_image.py's VGG sketch → VGG-16 + Inception here,
 f32 and int8). Beyond the reference's snippets: batched text generation
 (text_generation), a multi-process launcher (multihost_demo), and
-resumable training off a frame (train_logreg). Each is a library function
-with tests, not just a script — but every one is also runnable as
-``python -m examples.<name>``.
+resumable training off a frame (train_logreg), and scoring a foreign
+frozen TF ``GraphDef`` through the bundled decoder (foreign_graph). Each
+is a library function with tests, not just a script — but every one is
+also runnable as ``python -m examples.<name>``.
 """

@@ -1,0 +1,64 @@
+"""Scoring a foreign frozen TF graph over a frame — no TensorFlow needed.
+
+≙ the reference's core ingestion promise (a serialized ``GraphDef`` from
+*any* TF program runs over DataFrame columns — PythonInterface.scala:115-118
+``graphFromFile``): here the bundled clean-room GraphDef decoder lowers
+the frozen graph to jax and the verbs execute it like any traced program.
+Falls back to building the fixture bytes inline when the reference
+fixtures are absent, so the example is self-contained.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import tensorframes_tpu as tfs
+
+_FIXTURE = "/root/reference/src/test/resources/graph2.pb"
+
+
+def _inline_add_graph() -> bytes:
+    """A hand-assembled GraphDef: out = Add(z_1, z_2), float32 [2,2]
+    placeholders — byte-equivalent to the reference's graph2.pb fixture."""
+
+    def node(name: bytes, op: bytes, inputs=(), attrs=b"") -> bytes:
+        body = b"\x0a" + bytes([len(name)]) + name
+        body += b"\x12" + bytes([len(op)]) + op
+        for i in inputs:
+            body += b"\x1a" + bytes([len(i)]) + i
+        body += attrs
+        return b"\x0a" + bytes([len(body)]) + body
+
+    dtype_attr = b"\x2a\x0b\x0a\x05dtype\x12\x02\x30\x01"
+    shape_attr = b"\x2a\x13\x0a\x05shape\x12\x0a\x3a\x08\x12\x02\x08\x02\x12\x02\x08\x02"
+    t_attr = b"\x2a\x07\x0a\x01T\x12\x02\x30\x01"
+    return (
+        node(b"z_1", b"Placeholder", attrs=dtype_attr + shape_attr)
+        + node(b"z_2", b"Placeholder", attrs=dtype_attr + shape_attr)
+        + node(b"out", b"Add", inputs=[b"z_1", b"z_2"], attrs=t_attr)
+    )
+
+
+def run() -> dict:
+    if os.path.exists(_FIXTURE):
+        program = tfs.load_graphdef(
+            _FIXTURE, fetches=["out"], relax_lead_dim=True
+        )
+    else:
+        program = tfs.program_from_graphdef(
+            tfs.parse_graphdef(_inline_add_graph()),
+            fetches=["out"],
+            relax_lead_dim=True,
+        )
+    a = np.arange(20, dtype=np.float32).reshape(10, 2)
+    b = np.full((10, 2), 0.5, np.float32)
+    frame = tfs.frame_from_arrays({"z_1": a, "z_2": b}, num_blocks=2)
+    scored = tfs.map_blocks(program, frame)
+    total = float(np.asarray(scored.column_values("out")).sum())
+    return {"rows": 10, "sum": total, "inputs": program.input_names}
+
+
+if __name__ == "__main__":
+    print(run())

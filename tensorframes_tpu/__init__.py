@@ -28,6 +28,14 @@ if _get_config().enable_x64:
     # (datatypes.scala:265-267); x64 makes those exact end-to-end.
     _jax.config.update("jax_enable_x64", True)
 
+if _get_config().compilation_cache_dir:
+    # persistent executable cache: a fresh process deserializes compiled
+    # XLA programs instead of paying the 20-40s TPU compile again
+    _jax.config.update(
+        "jax_compilation_cache_dir", _get_config().compilation_cache_dir
+    )
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 from . import dtypes  # noqa: E402,F401
 from .shape import Shape, Unknown  # noqa: E402,F401
 from .schema import ColumnInfo, Schema  # noqa: E402,F401
@@ -100,7 +108,7 @@ from .io import (  # noqa: E402,F401
 )
 from .utils import profiling  # noqa: E402,F401
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "TensorFrame",

@@ -55,6 +55,11 @@ class Config:
     # Per-chip peak FLOP/s for MFU accounting in profiling.report()
     # (0 = unknown; bench.py sets it from the detected device kind).
     peak_flops: float = float(os.environ.get("TFTPU_PEAK_FLOPS", 0) or 0)
+    # Persistent XLA compilation cache directory: first TPU compiles of
+    # the big model programs take 20-40s; with a cache dir set, later
+    # processes deserialize the executable instead of recompiling
+    # (empty = disabled).
+    compilation_cache_dir: str = os.environ.get("TFTPU_COMPILE_CACHE", "")
     # Demote f64/i64 device columns to f32/i32 at the device boundary:
     # False = never (reference-parity precision, f64 emulated on TPU),
     # True = on TPU backends only, "always" = every backend (testing /

@@ -168,6 +168,19 @@ def _apply_feed_dict(program: Program, feed_dict: Optional[Dict[str, str]]) -> P
     return program.rename_inputs(dict(feed_dict))
 
 
+def _demote_cast(v, spec: TensorSpec):
+    """The x64-demotion boundary for verb paths that build feeds by hand
+    (gather_feeds applies the same rule): cast a 64-bit column down to
+    the program's demoted 32-bit input spec. Identity when demotion is
+    inactive or dtypes already agree; works on numpy and jax arrays."""
+    if (
+        dt.demotion_active()
+        and getattr(v, "dtype", None) != spec.dtype.np_dtype
+    ):
+        return v.astype(spec.dtype.np_dtype)
+    return v
+
+
 def _sorted_output_infos(program: Program, block_mode: bool) -> List[ColumnInfo]:
     """Output columns first, sorted by name (≙ DebugRowOps.scala:353-379)."""
     infos = []
@@ -586,7 +599,12 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
                 )
                 program._sharded_rr = ((frame.mesh, axis), fn)
             fn = program._sharded_rr[1]
-            res = fn({x: main[x] for x in out_names})
+            res = fn(
+                {
+                    x: _demote_cast(main[x], program.input(f"{x}_1"))
+                    for x in out_names
+                }
+            )
             partials.append({x: np.asarray(res[x]) for x in out_names})
             blocks = blocks[1:]  # tail (if any) folds in below
 
@@ -611,7 +629,7 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
                 # contract, so pull the shard-split array to host rather
                 # than scan over a dp-sharded lead dim (unsupported slice)
                 v = np.asarray(v)
-            feeds[x] = v
+            feeds[x] = _demote_cast(v, program.input(f"{x}_1"))
         if n == 1:
             partials.append({x: np.asarray(feeds[x][0]) for x in out_names})
         else:
@@ -659,8 +677,8 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
         feeds = {}
         for x in out_names:
             v = b[x]
+            spec = program.input(f"{x}_input")
             if isinstance(v, list):
-                spec = program.input(f"{x}_input")
                 try:
                     v = np.asarray(v, dtype=spec.dtype.np_dtype)
                 except (ValueError, TypeError):
@@ -668,6 +686,8 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
                         f"Column {x!r} holds ragged cells; reduce_blocks "
                         "needs dense blocks (run analyze() first)."
                     ) from None
+            else:
+                v = _demote_cast(v, spec)
             feeds[f"{x}_input"] = v
         partials.append(compiled.run_block(feeds))
     if not partials:
@@ -709,11 +729,13 @@ def _host_group_ids(key_cols, keys):
 
 @lru_cache(maxsize=32)
 def _seg_fast_for(ops, num_groups):
-    """Jitted keyed reduction over key-sorted rows: one XLA program for all
-    fetches. ``ops`` is a tuple of (output_name, reducer_op). The LRU keeps
-    repeated aggregates on one executable while bounding retained programs
-    when group counts vary per batch (evicted entries free their XLA
-    executables)."""
+    """Jitted keyed reduction: one XLA program for all fetches. ``sids``
+    may arrive in ANY order — segment scatters (and the pallas one-hot
+    kernel) are sortedness-agnostic, so do not add ``indices_are_sorted``
+    here. ``ops`` is a tuple of (output_name, reducer_op). The LRU keeps
+    repeated aggregates on one executable while bounding retained
+    programs when group counts vary per batch (evicted entries free
+    their XLA executables)."""
 
     @jax.jit
     def fn(vals, sids):
@@ -754,12 +776,17 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
     ``TensorFlowUDAF`` (DebugRowOps.scala:554-599, 608-702). Fetches follow
     the ``x`` / ``x_input`` naming contract, like reduce_blocks.
 
-    Execution: rows are sorted by key on the host; then either
+    Execution order, no sorting of rows anywhere: sharded frames first
+    try the on-device plans (ops/device_agg.py — per-shard segment
+    reduce + one collective). Otherwise keys encode to dense group ids
+    on the host (ops/keys.py; value columns are never reordered), then
+    either
     (a) *segment fast path* — the fetches are recognized algebraic
     reducers and lower to one vectorized ``jax.ops.segment_*`` program
-    over the whole frame (replacing the Catalyst shuffle + UDAF with a
-    single XLA program), or
-    (b) *generic path* — per group, chunked compaction through the user
+    over the whole frame fed UNSORTED ids (replacing the Catalyst
+    shuffle + UDAF with a single XLA program), or
+    (b) *generic path* — groups made contiguous by a stable argsort of
+    the int ids, then per group chunked compaction through the user
     program with a bounded buffer (compact-every-N,
     ≙ DebugRowOps.scala:646-657), keeping the jit cache ≤ N shapes.
     """
@@ -797,7 +824,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
             key_cols_d, out_cols_d = dev
             return _assemble(key_cols_d, out_cols_d, frame.num_rows)
 
-    # -- gather rows to host, sort by key -----------------------------------
+    # -- gather rows to host, encode group keys -----------------------------
     key_cols = {k: frame.column_values(k) for k in keys}
     val_cols = {}
     for x in out_names:
@@ -807,7 +834,7 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
                 f"Column {x!r} is ragged; aggregate requires uniform cells "
                 "(run analyze() first)."
             )
-        val_cols[x] = vals
+        val_cols[x] = _demote_cast(vals, program.input(f"{x}_input"))
     n = len(next(iter(key_cols.values())))
     if n == 0:
         infos = [

@@ -103,3 +103,29 @@ def test_no_demotion_when_disabled():
     df = tfs.frame_from_arrays({"x": np.arange(10, dtype=np.float64)})
     out = tfs.map_blocks(lambda x: {"z": x * 2.0}, df)
     assert out.schema["z"].dtype is dt.float64
+
+
+def test_aggregate_and_reduce_store_demoted_dtypes(demoted):
+    """The manual-feed verb paths (aggregate value columns, reduce_rows
+    and reduce_blocks feeds) honor the demotion boundary: stored blocks
+    match the 32-bit schema and reductions execute in 32-bit."""
+    df = tfs.frame_from_arrays(
+        {
+            "k": np.arange(100, dtype=np.int64) % 4,
+            "x": np.arange(100, dtype=np.float64),
+        }
+    )
+    agg = tfs.aggregate(
+        lambda x_input: {"x": x_input.sum(0)}, df.group_by("k")
+    )
+    assert agg.schema["x"].dtype.name == "float32"
+    assert np.asarray(agg.blocks()[0]["x"]).dtype == np.float32
+    # vector cells: reduce results keep array form, exposing the dtype
+    # (scalar reduces unwrap to python floats by contract)
+    vdf = tfs.frame_from_arrays(
+        {"x": np.arange(40, dtype=np.float64).reshape(20, 2)}
+    )
+    r1 = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, vdf)
+    assert np.asarray(r1).dtype == np.float32
+    r2 = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, vdf)
+    assert np.asarray(r2).dtype == np.float32

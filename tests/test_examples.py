@@ -144,3 +144,22 @@ def test_train_logreg_example(tmp_path):
         frame, num_steps=20, checkpoint_dir=str(tmp_path)
     )
     assert len(more) == 5
+
+
+def test_foreign_graph_example():
+    """examples/foreign_graph.py: a frozen TF GraphDef (the reference's
+    own fixture when present, an inline byte-equivalent otherwise) scores
+    a frame through map_blocks."""
+    from examples import foreign_graph
+
+    res = foreign_graph.run()
+    assert res["inputs"] == ["z_1", "z_2"]
+    assert res["rows"] == 10
+    # sum of (a + 0.5) over a = 0..19  ->  190 + 10
+    assert res["sum"] == 190.0 + 10.0
+    # the inline builder must stay byte-faithful to the decoder
+    prog = tfs.program_from_graphdef(
+        tfs.parse_graphdef(foreign_graph._inline_add_graph()),
+        fetches=["out"],
+    )
+    assert prog.input_names == ["z_1", "z_2"]

@@ -63,3 +63,27 @@ def test_trace_writes_profile(tmp_path):
         tmp_path.rglob("*.trace.json.gz")
     )
     assert found, f"no trace output under {tmp_path}"
+
+
+def test_compilation_cache_config_plumbs_through(tmp_path):
+    """TFTPU_COMPILE_CACHE wires jax's persistent compilation cache at
+    import (fresh process: import-time config)."""
+    import subprocess
+    import sys
+
+    cache = str(tmp_path / "xla-cache")
+    script = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import os, sys\n"
+        f"os.environ['TFTPU_COMPILE_CACHE'] = {cache!r}\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        "import tensorframes_tpu\n"
+        "print('dir=', jax.config.jax_compilation_cache_dir)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert cache in r.stdout

@@ -71,8 +71,15 @@ def test_aggregate_by_host_key_carries_key_values(frame):
 
 
 def test_host_column_as_aggregation_value_rejected(frame):
+    # DSL route: block() refuses to make a placeholder from a host column
     with pytest.raises((TypeError, ValueError), match="host|string"):
         tfs.block(frame, "name")
+    # aggregate route (plain-function fetch): the value column never
+    # becomes a program input — parameter matching rejects it
+    with pytest.raises(ValueError, match="name_input"):
+        tfs.aggregate(
+            lambda name_input: {"name": name_input}, frame.group_by("x")
+        )
 
 
 def test_host_column_cannot_feed_device_program(frame):
