@@ -256,17 +256,14 @@ def _bench_convert(n_rows: int = 1_000_000):
     return convert_s, convertback_s
 
 
-def _bench_aggregate(n_rows: int = 1_000_000, n_groups: int = 512):
-    """Keyed aggregate wall-clock over the segment fast path (pallas
-    one-hot MXU kernel on TPU, XLA segment scatter elsewhere)."""
+def _bench_aggregate_keyed(keys: "np.ndarray", n_rows: int):
+    """Shared keyed-aggregate timing harness: reduce_sum over a float
+    column grouped by ``keys``, warmup excluded."""
     import tensorframes_tpu as tfs
 
     rng = np.random.default_rng(0)
     frame = tfs.frame_from_arrays(
-        {
-            "k": rng.integers(0, n_groups, n_rows),
-            "v": rng.standard_normal(n_rows).astype(np.float32),
-        },
+        {"k": keys, "v": rng.standard_normal(n_rows).astype(np.float32)},
         num_blocks=1,
     )
     with tfs.with_graph():
@@ -279,9 +276,50 @@ def _bench_aggregate(n_rows: int = 1_000_000, n_groups: int = 512):
 
     run_once().blocks()  # warmup/compile
     t0 = time.perf_counter()
-    out = run_once()
-    out.blocks()
+    run_once().blocks()
     return time.perf_counter() - t0
+
+
+def _bench_aggregate(n_rows: int = 1_000_000, n_groups: int = 512):
+    """Keyed aggregate wall-clock over the segment fast path (pallas
+    one-hot MXU kernel on TPU, XLA segment scatter elsewhere)."""
+    rng = np.random.default_rng(0)
+    return _bench_aggregate_keyed(rng.integers(0, n_groups, n_rows), n_rows)
+
+
+def _bench_aggregate_strings(n_rows: int = 1_000_000, n_groups: int = 512):
+    """Keyed aggregate with STRING keys: one host dictionary pass over
+    the key column (ops/keys.py), values reduce through the same segment
+    fast path — the config Catalyst always paid a shuffle for."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, n_groups, n_rows)
+    labels = np.array([f"key{i:04d}" for i in range(n_groups)], object)[ids]
+    return _bench_aggregate_keyed(labels, n_rows)
+
+
+def _bench_map_rows_ragged(n_rows: int = 20_000, iters: int = 3):
+    """Ragged map_rows throughput: grouped vmapped dispatch with
+    bucketed lead dims (one dispatch per distinct cell shape, not one
+    per row — the round-2 rewrite of the reference's per-row dynamic
+    lead dim, TFDataOps.scala:90-103)."""
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(0)
+    lens = rng.choice([8, 16, 24, 32], n_rows)
+    rows = [
+        {"v": np.arange(n, dtype=np.float32)} for n in lens
+    ]
+    frame = tfs.frame_from_rows(rows, num_blocks=4)
+    program = tfs.compile_program(
+        lambda v: {"s": v.sum()}, frame, block=False
+    )
+
+    def run_once():
+        out = tfs.map_rows(program, frame)
+        for b in out.blocks():
+            _sync(b["s"])
+
+    return _time_rows_per_sec(run_once, n_rows, iters)
 
 
 def _bench_reduce_blocks(n_rows: int = 1_000_000):
@@ -377,6 +415,10 @@ def main():
     add3_rps = _try("add3", _bench_add3, 0.0)
     reduce_s = _try("reduce_blocks", _bench_reduce_blocks, float("nan"))
     aggregate_s = _try("aggregate", _bench_aggregate, float("nan"))
+    aggregate_str_s = _try(
+        "aggregate_strings", _bench_aggregate_strings, float("nan")
+    )
+    ragged_rps = _try("map_rows_ragged", _bench_map_rows_ragged, 0.0)
     # full-scale Inception on the real chip; CPU fallback shrinks widths so
     # the harness stays runnable anywhere
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -449,6 +491,8 @@ def main():
     print(f"# add3_map_blocks_rows_per_sec={add3_rps:.0f}")
     print(f"# reduce_blocks_1M_wall_s={reduce_s:.4f}")
     print(f"# aggregate_1M_512groups_wall_s={aggregate_s:.4f}")
+    print(f"# aggregate_strings_1M_512groups_wall_s={aggregate_str_s:.4f}")
+    print(f"# map_rows_ragged_rows_per_sec={ragged_rps:.0f}")
     print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")
     print(f"# inception_v3_map_blocks_rows_per_sec={inception_rps:.0f}")
     print(f"# inception_v3_int8_map_blocks_rows_per_sec={inception_rps_q:.0f}")

@@ -27,6 +27,8 @@ BOUNDS = {
     "aggregate_1M_512groups_wall_s": ("max", 3.0),
     "reduce_blocks_1M_wall_s": ("max", 0.5),
     "bert_tiny_map_rows_rows_per_sec": ("min", 500.0),
+    "aggregate_strings_1M_512groups_wall_s": ("max", 30.0),
+    "map_rows_ragged_rows_per_sec": ("min", 1000.0),
 }
 
 

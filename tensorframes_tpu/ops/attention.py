@@ -31,6 +31,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
 NEG_INF = -1e30
 
 
@@ -119,7 +123,7 @@ def flash_attention(
     block_size: int = 512,
 ) -> jnp.ndarray:
     """TPU pallas flash kernel when available, else blockwise fallback."""
-    if jax.default_backend() in ("tpu", "axon"):
+    if jax.default_backend() in ("tpu", "axon") and _pallas_flash_usable():
         try:
             from jax.experimental import enable_x64
             from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -136,9 +140,42 @@ def flash_attention(
                 return pallas_flash(
                     q, k, v, causal=causal, sm_scale=1.0 / np.sqrt(d)
                 )
-        except Exception:  # pragma: no cover - kernel/backend mismatch
+        except Exception:
+            # per-call trace-time rejections (seq not divisible by the
+            # kernel's 128 block, unsupported dtype/head_dim) — the
+            # canary only rules out process-wide Mosaic failures
             pass
     return blockwise_attention(q, k, v, causal=causal, block_size=block_size)
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_flash_usable() -> bool:
+    """Compile-probe the upstream pallas flash kernel ONCE per process on
+    a canary shape. A trace-time try/except alone cannot protect callers:
+    a Mosaic legalization failure surfaces at the OUTER jit's compile,
+    long after this helper returned — so compile a tiny standalone jit
+    here and fall back to blockwise attention for the whole process if
+    it fails (the same self-healing contract as the segment kernel's
+    kill-switch, ops/segment.py)."""
+    try:
+        from jax.experimental import enable_x64
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as pallas_flash,
+        )
+
+        with enable_x64(False):
+            q = jnp.zeros((1, 2, 256, 128), jnp.float32)
+            jax.jit(
+                lambda a, b, c: pallas_flash(a, b, c, causal=False)
+            ).lower(q, q, q).compile()
+        return True
+    except Exception as e:  # pragma: no cover - backend-dependent
+        logger.warning(
+            "pallas flash attention unusable on this backend (%s: %s); "
+            "using blockwise attention",
+            type(e).__name__, e,
+        )
+        return False
 
 
 # ---------------------------------------------------------------------------

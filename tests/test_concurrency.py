@@ -1,0 +1,110 @@
+"""Thread-safety under concurrent use (SURVEY §5-race: the reference had
+no race detection and documented its DSL naming context as
+single-threaded only, dsl/Paths.scala:10-11). Here thread-local graph
+contexts and the frame's force-once lock make concurrent use safe —
+these tests race real threads over the public API to pin that."""
+
+import threading
+
+import numpy as np
+
+import tensorframes_tpu as tfs
+
+
+def _run_threads(fn, n=8):
+    errs = []
+    results = [None] * n
+
+    def wrap(i):
+        try:
+            results[i] = fn(i)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append((i, e))
+
+    ts = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts), "worker thread timed out"
+    assert not errs, errs
+    return results
+
+
+def test_concurrent_map_blocks_same_frame():
+    df = tfs.frame_from_arrays({"x": np.arange(1000, dtype=np.float64)})
+
+    def work(i):
+        out = tfs.map_blocks(lambda x: {"z": x * float(i)}, df)
+        return np.asarray(out.column_values("z"))
+
+    results = _run_threads(work)
+    for i, got in enumerate(results):
+        np.testing.assert_array_equal(got, np.arange(1000) * float(i))
+
+
+def test_concurrent_dsl_graphs_are_thread_local():
+    """Each thread builds its own scoped graph; TF-style name dedup
+    counters must not bleed across threads (the reference's Paths was a
+    process-global mutable context — explicitly unsafe)."""
+    df = tfs.frame_from_arrays({"x": np.arange(64, dtype=np.float64)})
+
+    def work(i):
+        with tfs.with_graph():
+            x = tfs.block(df, "x")
+            z = tfs.add(x, float(i), name="z")
+            out = tfs.map_blocks(z, df)
+            # same fetch name in every thread: thread-local contexts mean
+            # no _1/_2 dedup suffix ever appears
+            assert "z" in out.schema.names
+            return np.asarray(out.column_values("z"))
+
+    results = _run_threads(work)
+    for i, got in enumerate(results):
+        np.testing.assert_array_equal(got, np.arange(64) + float(i))
+
+
+def test_lazy_frame_forces_once_under_races():
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return [{"x": np.arange(100, dtype=np.float64)}]
+
+    from tensorframes_tpu import ColumnInfo, Schema, Shape, Unknown
+    from tensorframes_tpu import dtypes as dt
+
+    frame = tfs.TensorFrame(
+        None,
+        Schema([ColumnInfo("x", dt.float64, Shape((Unknown,)))]),
+        pending=compute,
+    )
+
+    def work(_):
+        return frame.num_rows
+
+    results = _run_threads(work)
+    assert set(results) == {100}
+    assert len(calls) == 1  # the force-once lock held
+
+
+def test_concurrent_aggregates():
+    rng = np.random.default_rng(0)
+    df = tfs.frame_from_arrays(
+        {
+            "k": rng.integers(0, 16, 4000),
+            "v": rng.standard_normal(4000),
+        }
+    )
+
+    def work(_):
+        res = tfs.aggregate(
+            lambda v_input: {"v": v_input.sum(0)}, df.group_by("k")
+        )
+        return {r["k"]: r["v"] for r in res.collect()}
+
+    results = _run_threads(work, n=6)
+    for r in results[1:]:
+        assert r.keys() == results[0].keys()
+        for k in r:
+            assert r[k] == results[0][k]
