@@ -120,6 +120,48 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
     return rps
 
 
+def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
+                            side: int = 299):
+    """BASELINE config 4 in its literal form: a frozen TF GraphDef of
+    Inception-v3 scored over an image frame — decoded by the bundled
+    clean-room importer, lowered to jax, executed via map_blocks.
+    Requires tensorflow only to BUILD the frozen fixture (random
+    weights, no downloads); scoring itself is TF-free."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+    import tensorflow as tf  # noqa: F401 — fixture construction only
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(0)
+    model = tf.keras.applications.InceptionV3(
+        weights=None, input_shape=(side, side, 3)
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(
+        tf.TensorSpec([None, side, side, 3], tf.float32)
+    )
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+    prog = program_from_graphdef(parse_graphdef(data), relax_lead_dim=True)
+    [inp] = prog.inputs
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n_rows, side, side, 3)).astype(np.float32)
+    frame = tfs.frame_from_arrays({inp.name: x}, num_blocks=1).to_device()
+    program = tfs.compile_program(prog, frame)
+
+    def run_once():
+        out = tfs.map_blocks(program, frame)
+        [b] = out.blocks()
+        _sync(b[prog.fetch_order[0]])
+
+    rps = _time_rows_per_sec(run_once, n_rows, iters)
+    _record_mfu("bench.inception_v3_frozen", program, rps, n_rows)
+    return rps
+
+
 def _bench_bert_embed(n_rows: int = 1024, seq: int = 128, iters: int = 3,
                       full_scale: bool = True):
     """BERT-base embedding extraction via map_rows (BASELINE config 5)."""
@@ -441,6 +483,15 @@ def main():
         ),
         0.0,
     )
+    inception_frozen_rps = _try(
+        "inception_frozen",
+        lambda: _bench_inception_frozen(
+            n_rows=64 if on_tpu else 8,
+            iters=3 if on_tpu else 1,
+            side=299 if on_tpu else 75,
+        ),
+        0.0,
+    )
     bert_rps = _try(
         "bert",
         lambda: _bench_bert_embed(
@@ -496,6 +547,9 @@ def main():
     print(f"# logreg_map_blocks_rows_per_sec={logreg_rps:.0f}")
     print(f"# inception_v3_map_blocks_rows_per_sec={inception_rps:.0f}")
     print(f"# inception_v3_int8_map_blocks_rows_per_sec={inception_rps_q:.0f}")
+    print(
+        f"# inception_v3_frozen_graphdef_rows_per_sec={inception_frozen_rps:.0f}"
+    )
     print(
         f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"
     )

@@ -11,9 +11,14 @@ wire-format reader decodes the ``GraphDef``/``NodeDef``/``AttrValue``/
 lowers to a ``jax.numpy`` expression evaluated in topological order.
 
 Supported ops cover the surface the reference's own DSL emits
-(Placeholder/Const/Identity/Add/Div/Sum/Min — dsl/DslImpl.scala:77-200)
-plus the obvious neighbours (Sub/Mul/Neg/Max/Mean/Prod/Maximum/Minimum/
-MatMul/Relu/Exp/Log/Sqrt/Cast/Reshape). Anything else raises with the op
+(Placeholder/Const/Identity/Add/Div/Sum/Min — dsl/DslImpl.scala:77-200),
+the obvious neighbours (Sub/Mul/Neg/Max/Mean/Prod/Maximum/Minimum/
+MatMul/Relu/Exp/Log/Sqrt/Rsqrt/Cast/Reshape/Squeeze/Pad/Softmax), and
+the convolutional family frozen image models need (Conv2D/
+DepthwiseConv2dNative/MaxPool/AvgPool/BiasAdd/Concat[V2] over NHWC) —
+enough that a full frozen keras Inception-v3 (~2200 nodes, batchnorm
+decomposed to Mul/Sub/Rsqrt/AddV2 by the freezer) executes bit-close to
+TF (tests/test_graphdef_frozen.py). Anything else raises with the op
 name — the honest bounded-op-subset contract.
 """
 
@@ -22,10 +27,12 @@ from __future__ import annotations
 import struct
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import dtypes as dt
+from .ops.windows import same_pool_counts
 from .program import Program, TensorSpec, analyze_program
 from .shape import Shape, Unknown
 
@@ -201,19 +208,58 @@ def _parse_tensor(data: bytes) -> np.ndarray:
 
 class _Attr:
     """One decoded AttrValue (attr_value.proto): whichever oneof member
-    was present."""
+    was present. ``ints``/``floats``/``bools`` carry ListValue members
+    (Conv2D strides, pool ksize, Squeeze dims, …)."""
 
-    __slots__ = ("s", "i", "f", "b", "type", "shape", "tensor")
+    __slots__ = ("s", "i", "f", "b", "type", "shape", "tensor",
+                 "ints", "floats", "bools")
 
     def __init__(self):
         self.s = self.i = self.f = self.b = None
         self.type = self.shape = self.tensor = None
+        self.ints = self.floats = self.bools = None
+
+
+def _parse_list_value(a: _Attr, data: bytes) -> None:
+    """AttrValue.ListValue: repeated i (field 3) / f (4) / b (5), packed
+    per proto3 (attr_value.proto declares [packed = true]); handle the
+    unpacked encoding too."""
+    ints: List[int] = []
+    floats: List[float] = []
+    bools: List[bool] = []
+    for field, wire, v in _iter_fields(data):
+        if field == 3:
+            if wire == 0:
+                ints.append(_signed(v))
+            else:
+                pos = 0
+                while pos < len(v):
+                    x, pos = _read_varint(v, pos)
+                    ints.append(_signed(x))
+        elif field == 4:
+            if wire == 5:
+                floats.append(struct.unpack("<f", v)[0])
+            else:
+                floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+        elif field == 5:
+            if wire == 0:
+                bools.append(bool(v))
+            else:
+                bools.extend(bool(b) for b in v)
+    if ints:
+        a.ints = ints
+    if floats:
+        a.floats = floats
+    if bools:
+        a.bools = bools
 
 
 def _parse_attr(data: bytes) -> _Attr:
     a = _Attr()
     for field, _, v in _iter_fields(data):
-        if field == 2:
+        if field == 1:
+            _parse_list_value(a, v)
+        elif field == 2:
             a.s = v
         elif field == 3:
             a.i = _signed(v)
@@ -300,9 +346,11 @@ _UNARY = {
     "Identity": lambda x: x,
     "Neg": jnp.negative,
     "Relu": lambda x: jnp.maximum(x, 0),
+    "Relu6": lambda x: jnp.clip(x, 0, 6),
     "Exp": jnp.exp,
     "Log": jnp.log,
     "Sqrt": jnp.sqrt,
+    "Rsqrt": lambda x: 1.0 / jnp.sqrt(x),
     "Tanh": jnp.tanh,
     "Sigmoid": lambda x: 1.0 / (1.0 + jnp.exp(-x)),
     "Softmax": lambda x: jnp.exp(x - x.max(-1, keepdims=True))
@@ -323,6 +371,94 @@ def _base(ref: str) -> str:
     NodeDef input reference."""
     ref = ref[1:] if ref.startswith("^") else ref
     return ref.split(":")[0]
+
+
+def _nhwc(n: "GraphNode") -> None:
+    fmt = n.attrs.get("data_format")
+    if fmt is not None and fmt.s not in (None, b"NHWC"):
+        raise ValueError(
+            f"{n.op} node {n.name!r}: only NHWC data_format is supported "
+            f"(got {fmt.s!r}) — TPU-native layouts are NHWC"
+        )
+
+
+def _pad_str(n: "GraphNode") -> str:
+    p = n.attrs.get("padding")
+    pad = (p.s or b"VALID").decode() if p else "VALID"
+    if pad not in ("SAME", "VALID"):
+        raise ValueError(
+            f"{n.op} node {n.name!r}: padding {pad!r} unsupported "
+            "(SAME/VALID only)"
+        )
+    return pad
+
+
+def _conv2d(n: "GraphNode", x, w):
+    """Conv2D (NHWC, HWIO weights — TF's native layouts, which are also
+    the TPU-friendly ones)."""
+    _nhwc(n)
+    strides = (n.attrs["strides"].ints or [1, 1, 1, 1])[1:3]
+    dil = n.attrs.get("dilations")
+    rhs_dilation = tuple((dil.ints or [1, 1, 1, 1])[1:3]) if dil else (1, 1)
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(strides),
+        padding=_pad_str(n),
+        rhs_dilation=rhs_dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _depthwise_conv2d(n: "GraphNode", x, w):
+    """DepthwiseConv2dNative: [H,W,C,M] filter → grouped conv with
+    feature_group_count=C and an [H,W,1,C*M] kernel."""
+    _nhwc(n)
+    strides = (n.attrs["strides"].ints or [1, 1, 1, 1])[1:3]
+    dil = n.attrs.get("dilations")
+    rhs_dilation = tuple((dil.ints or [1, 1, 1, 1])[1:3]) if dil else (1, 1)
+    h, wd, c, m = w.shape
+    return jax.lax.conv_general_dilated(
+        x,
+        w.reshape(h, wd, 1, c * m),
+        window_strides=tuple(strides),
+        padding=_pad_str(n),
+        rhs_dilation=rhs_dilation,
+        feature_group_count=c,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _pool(n: "GraphNode", x):
+    """MaxPool / AvgPool over NHWC. AvgPool with SAME padding divides by
+    the true (edge-clipped) window population, matching TF."""
+    _nhwc(n)
+    ksize = tuple(n.attrs["ksize"].ints)
+    strides = tuple(n.attrs["strides"].ints)
+    pad = _pad_str(n)
+    if n.op == "MaxPool":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else (
+            jnp.iinfo(x.dtype).min
+        )
+        return jax.lax.reduce_window(
+            x, init, jax.lax.max, ksize, strides, pad
+        )
+    # accumulate at >= f32 precision without truncating f64 graphs
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    s = jax.lax.reduce_window(
+        x.astype(acc), 0.0, jax.lax.add, ksize, strides, pad
+    )
+    if pad == "VALID":
+        cnt = float(np.prod(ksize))
+    else:
+        # trace-time numpy window counts: reduce_window over a constant
+        # would make XLA constant-fold a full-size pool per shape (the
+        # inception-stem slow_operation_alarm stalls; ops/windows.py)
+        cnt = same_pool_counts(
+            int(x.shape[1]), int(x.shape[2]),
+            ksize[1], ksize[2], strides[1], strides[2],
+        )
+    return (s / cnt).astype(x.dtype)
 
 
 def program_from_graphdef(
@@ -348,7 +484,7 @@ def program_from_graphdef(
         fetches = [
             n.name
             for n in nodes
-            if n.name not in consumed and n.op != "Placeholder"
+            if n.name not in consumed and n.op not in ("Placeholder", "NoOp")
         ]
         if not fetches:
             raise ValueError("GraphDef has no sink nodes; pass fetches=")
@@ -376,11 +512,16 @@ def program_from_graphdef(
         elif n.op == "Const":
             consts[n.name] = n.attrs["value"].tensor
 
+    structural = (
+        "Placeholder", "Const", "Cast", "Reshape", "MatMul", "NoOp",
+        "Conv2D", "DepthwiseConv2dNative", "MaxPool", "AvgPool",
+        "BiasAdd", "ConcatV2", "Concat", "Squeeze", "Pad", "PadV2",
+    )
     unsupported = sorted(
         {
             n.op
             for n in nodes
-            if n.op not in ("Placeholder", "Const", "Cast", "Reshape", "MatMul")
+            if n.op not in structural
             and n.op not in _BINARY
             and n.op not in _UNARY
             and n.op not in _REDUCERS
@@ -389,7 +530,7 @@ def program_from_graphdef(
     if unsupported:
         raise ValueError(
             f"GraphDef contains unsupported op(s) {unsupported}; supported: "
-            "Placeholder, Const, Cast, Reshape, MatMul, "
+            f"{sorted(structural)}, "
             f"{sorted(_BINARY)}, {sorted(_UNARY)}, {sorted(_REDUCERS)}"
         )
 
@@ -448,6 +589,57 @@ def program_from_graphdef(
                     if tb and tb.b:
                         b = b.T
                     v = a @ b
+                elif n.op == "Conv2D":
+                    v = _conv2d(n, *args)
+                elif n.op == "DepthwiseConv2dNative":
+                    v = _depthwise_conv2d(n, *args)
+                elif n.op in ("MaxPool", "AvgPool"):
+                    v = _pool(n, args[0])
+                elif n.op == "BiasAdd":
+                    _nhwc(n)
+                    v = args[0] + args[1]
+                elif n.op in ("ConcatV2", "Concat"):
+                    # axis is a Const DATA input: LAST for ConcatV2,
+                    # FIRST for the v1 form (control inputs '^dep' trail
+                    # the data inputs — filter them before indexing)
+                    data_refs = [
+                        r for r in n.inputs if not r.startswith("^")
+                    ]
+                    ax_ref = (
+                        data_refs[-1] if n.op == "ConcatV2" else data_refs[0]
+                    )
+                    ax_name = _base(ax_ref)
+                    if ax_name not in consts:
+                        raise ValueError(
+                            f"{n.op} node {name!r}: axis must be a Const"
+                        )
+                    ax = int(np.asarray(consts[ax_name]))
+                    vals_cat = args[:-1] if n.op == "ConcatV2" else args[1:]
+                    v = jnp.concatenate(vals_cat, axis=ax)
+                elif n.op == "Squeeze":
+                    dims_a = n.attrs.get("squeeze_dims") or n.attrs.get("axis")
+                    dims = tuple(dims_a.ints) if dims_a and dims_a.ints else None
+                    v = jnp.squeeze(args[0], axis=dims)
+                elif n.op in ("Pad", "PadV2"):
+                    pad_name = _base(n.inputs[1])
+                    if pad_name not in consts:
+                        raise ValueError(
+                            f"{n.op} node {name!r}: paddings must be a Const"
+                        )
+                    pads = [tuple(int(x) for x in row)
+                            for row in np.asarray(consts[pad_name])]
+                    cval = 0.0
+                    if n.op == "PadV2":
+                        cv_name = _base(n.inputs[2])
+                        if cv_name not in consts:
+                            raise ValueError(
+                                f"PadV2 node {name!r}: pad value must be a "
+                                "Const"
+                            )
+                        cval = float(np.asarray(consts[cv_name]))
+                    v = jnp.pad(args[0], pads, constant_values=cval)
+                elif n.op == "NoOp":
+                    v = None  # control-only; never consumed as data
                 else:  # pragma: no cover — filtered above
                     raise ValueError(f"unsupported op {n.op}")
             values[name] = v

@@ -25,7 +25,6 @@ A ``channel_scale`` knob shrinks widths for tests; ``tiny()`` runs on
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Dict, Tuple
 
 import jax
@@ -213,26 +212,17 @@ def _maxpool(x, window: int = 3, stride: int = 2, padding="VALID"):
     )
 
 
-@functools.lru_cache(maxsize=32)
-def _avgpool3_counts(h: int, w: int) -> np.ndarray:
-    """Per-pixel window population for a 3x3 SAME sum-pool — computed with
-    numpy at trace time. Feeding ``reduce_window(ones)`` to XLA instead
-    makes the compiler constant-fold a full-size reduce-window per shape
-    (the 8-12s slow_operation_alarm stalls in the inception stem)."""
-    ones = np.ones((h, w), np.float32)
-    counts = np.zeros((h, w), np.float32)
-    padded = np.pad(ones, 1)
-    for dy in range(3):
-        for dx in range(3):
-            counts += padded[dy:dy + h, dx:dx + w]
-    return counts.reshape(1, h, w, 1)
-
-
 def _avgpool3(x):
+    """3x3 SAME average pool with a trace-time numpy divisor — feeding
+    ``reduce_window(ones)`` to XLA instead makes the compiler
+    constant-fold a full-size reduce-window per shape (the 8-12s
+    slow_operation_alarm stalls in the inception stem; ops/windows.py)."""
+    from ..ops.windows import same_pool_counts
+
     s = lax.reduce_window(
         x.astype(jnp.float32), 0.0, lax.add, (1, 3, 3, 1), (1, 1, 1, 1), "SAME"
     )
-    n = _avgpool3_counts(int(x.shape[1]), int(x.shape[2]))
+    n = same_pool_counts(int(x.shape[1]), int(x.shape[2]), 3, 3)
     return (s / n).astype(x.dtype)
 
 

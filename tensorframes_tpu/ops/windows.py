@@ -1,0 +1,34 @@
+"""Trace-time numpy window arithmetic shared by the model zoo and the
+GraphDef importer.
+
+Why numpy and not ``reduce_window(ones)``: a reduce-window over a
+constant makes XLA constant-fold a full-size pooling per compiled shape
+— the 8-12s ``slow_operation_alarm`` stalls originally seen in the
+Inception stem. Computing the divisor on the host embeds a ready
+constant instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=64)
+def same_pool_counts(
+    h: int, w: int, kh: int, kw: int, sh: int = 1, sw: int = 1
+) -> np.ndarray:
+    """Per-pixel window population of a SAME-padded pool (TF's
+    edge-clipped average divisor), shaped ``[1, out_h, out_w, 1]``."""
+    out_h, out_w = -(-h // sh), -(-w // sw)
+    pad_h = max((out_h - 1) * sh + kh - h, 0)
+    pad_w = max((out_w - 1) * sw + kw - w, 0)
+    top, left = pad_h // 2, pad_w // 2
+    padded = np.zeros((h + pad_h, w + pad_w), np.float32)
+    padded[top:top + h, left:left + w] = 1.0
+    counts = np.zeros((out_h, out_w), np.float32)
+    for i in range(out_h):
+        for j in range(out_w):
+            counts[i, j] = padded[i * sh:i * sh + kh, j * sw:j * sw + kw].sum()
+    return counts.reshape(1, out_h, out_w, 1)
