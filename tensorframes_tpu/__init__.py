@@ -81,6 +81,7 @@ from .program import (  # noqa: E402,F401
 )
 from .graphdef import (  # noqa: E402,F401
     load_graphdef,
+    load_saved_model,
     parse_graphdef,
     program_from_graphdef,
 )

@@ -665,3 +665,42 @@ def load_graphdef(
         parse_graphdef(data), fetches=fetches, relax_lead_dim=relax_lead_dim
     )
     return analyze_program(program)
+
+
+def load_saved_model(
+    path: str,
+    signature: str = "serving_default",
+    fetches: Optional[Sequence[str]] = None,
+    relax_lead_dim: bool = False,
+) -> Program:
+    """Import a TF SavedModel signature: freeze its variables to
+    constants (requires tensorflow at CONVERSION time only — scoring is
+    TF-free) and lower the frozen graph like :func:`load_graphdef`.
+
+    Migration affordance beyond the reference (which took raw GraphDefs
+    only): modern TF users hold SavedModels. Without tensorflow
+    installed, freeze offline and ship the ``GraphDef`` instead.
+    """
+    try:
+        import tensorflow as tf
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+    except ImportError as e:
+        raise ImportError(
+            "load_saved_model needs tensorflow to freeze the signature's "
+            "variables; freeze offline (convert_variables_to_constants_v2) "
+            "and use load_graphdef on the result instead"
+        ) from e
+    m = tf.saved_model.load(path)
+    if signature not in m.signatures:
+        raise KeyError(
+            f"SavedModel has no signature {signature!r}; available: "
+            f"{sorted(m.signatures)}"
+        )
+    frozen = convert_variables_to_constants_v2(m.signatures[signature])
+    data = frozen.graph.as_graph_def().SerializeToString()
+    program = program_from_graphdef(
+        parse_graphdef(data), fetches=fetches, relax_lead_dim=relax_lead_dim
+    )
+    return analyze_program(program)

@@ -144,5 +144,7 @@ def test_two_process_psum(tmp_path):
 def test_four_process_psum(tmp_path):
     """4 processes ≙ 4 hosts: multi-hop collectives, 4-writer sharded
     save/load, and the device-aggregate merge at process_count=4
-    (VERDICT r1 next-step 7: scale the multi-process story past 2)."""
-    _run_workers(tmp_path, 4, timeout=150)
+    (VERDICT r1 next-step 7: scale the multi-process story past 2).
+    Generous timeout: each worker pays the full jax import + compile,
+    and the suite may be sharing the machine."""
+    _run_workers(tmp_path, 4, timeout=420)

@@ -66,6 +66,79 @@ def test_frozen_inception_v3_matches_tf(frozen_inception):
     assert (got.argmax(1) == want.argmax(1)).all()
 
 
+@pytest.mark.parametrize(
+    "ctor_name,shape",
+    [("MobileNetV2", (96, 96, 3)), ("ResNet50", (64, 64, 3))],
+)
+def test_frozen_model_zoo_matches_tf(ctor_name, shape):
+    """Importer generality across frozen keras families: MobileNetV2
+    (depthwise convs, Relu6, residual AddV2, Pad) and ResNet50 (strided
+    convs, MaxPool, Pad, Squeeze) — golden-compared against TF."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(3)
+    model = getattr(tf.keras.applications, ctor_name)(
+        weights=None, input_shape=shape
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, *shape], tf.float32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+
+    prog = program_from_graphdef(parse_graphdef(data), relax_lead_dim=True)
+    [inp] = prog.inputs
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, *shape)).astype(np.float32)
+    got = np.asarray(prog.fn({inp.name: x})[prog.fetch_order[0]])
+
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(data)
+    with tf.Graph().as_default() as g:
+        tf.import_graph_def(gd, name="")
+        with tf.compat.v1.Session(graph=g) as sess:
+            want = sess.run(f"{prog.fetch_order[0]}:0", {f"{inp.name}:0": x})
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_frozen_graph_scores_sharded_frame():
+    """An imported frozen graph runs over a SHARDED frame like any other
+    program — device plan, batch dim split over the mesh."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(5)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((8, 8, 3)),
+            tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(3),
+        ]
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, 8, 8, 3], tf.float32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+    prog = program_from_graphdef(parse_graphdef(data), relax_lead_dim=True)
+    [inp] = prog.inputs
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((64, 8, 8, 3)).astype(np.float32)
+
+    host = tfs.frame_from_arrays({inp.name: x})
+    dev = host.to_device()
+    assert dev.is_sharded
+    out_h = np.asarray(
+        tfs.map_blocks(prog, host).column_values(prog.fetch_order[0])
+    )
+    out_d = np.asarray(
+        tfs.map_blocks(prog, dev).column_values(prog.fetch_order[0])
+    )
+    np.testing.assert_allclose(out_d, out_h, atol=1e-5)
+
+
 def test_frozen_small_cnn_with_pools_matches_tf():
     """A compact CNN covering the conv-op family the big model misses:
     DepthwiseConv2d, MaxPool+AvgPool both paddings, BiasAdd, Relu6."""
@@ -105,3 +178,36 @@ def test_frozen_small_cnn_with_pools_matches_tf():
         with tf.compat.v1.Session(graph=g) as sess:
             want = sess.run(f"{prog.fetch_order[0]}:0", {f"{inp.name}:0": x})
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_load_saved_model_roundtrip(tmp_path):
+    """SavedModel → frozen signature → importer → matches the live keras
+    model (tensorflow used only at conversion time)."""
+    tf.keras.utils.set_random_seed(7)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(4, activation="relu"),
+            tf.keras.layers.Dense(2),
+        ]
+    )
+    sm_dir = str(tmp_path / "sm")
+    tf.saved_model.save(model, sm_dir)
+    prog = tfs.load_saved_model(sm_dir, relax_lead_dim=True)
+    [inp] = prog.inputs
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((5, 6)).astype(np.float32)
+    got = np.asarray(prog.fn({inp.name: x})[prog.fetch_order[0]])
+    want = model(x, training=False).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_load_saved_model_unknown_signature(tmp_path):
+    tf.keras.utils.set_random_seed(9)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input((3,)), tf.keras.layers.Dense(1)]
+    )
+    sm_dir = str(tmp_path / "sm2")
+    tf.saved_model.save(model, sm_dir)
+    with pytest.raises(KeyError, match="serving_default|available"):
+        tfs.load_saved_model(sm_dir, signature="nope")
