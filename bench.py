@@ -121,7 +121,7 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
 
 
 def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
-                            side: int = 299):
+                            side: int = 299, int8: bool = False):
     """BASELINE config 4 in its literal form: a frozen TF GraphDef of
     Inception-v3 scored over an image frame — decoded by the bundled
     clean-room importer, lowered to jax, executed via map_blocks.
@@ -145,7 +145,9 @@ def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
     )
     data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
     ).SerializeToString()
-    prog = program_from_graphdef(parse_graphdef(data), relax_lead_dim=True)
+    prog = program_from_graphdef(
+        parse_graphdef(data), relax_lead_dim=True, quantize_weights=int8
+    )
     [inp] = prog.inputs
     rng = np.random.default_rng(0)
     x = rng.standard_normal((n_rows, side, side, 3)).astype(np.float32)
@@ -158,7 +160,10 @@ def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
         _sync(b[prog.fetch_order[0]])
 
     rps = _time_rows_per_sec(run_once, n_rows, iters)
-    _record_mfu("bench.inception_v3_frozen", program, rps, n_rows)
+    _record_mfu(
+        f"bench.inception_v3_frozen{'_int8' if int8 else ''}",
+        program, rps, n_rows,
+    )
     return rps
 
 
@@ -492,6 +497,16 @@ def main():
         ),
         0.0,
     )
+    inception_frozen_rps_q = _try(
+        "inception_frozen_int8",
+        lambda: _bench_inception_frozen(
+            n_rows=64 if on_tpu else 8,
+            iters=3 if on_tpu else 1,
+            side=299 if on_tpu else 75,
+            int8=True,
+        ),
+        0.0,
+    )
     bert_rps = _try(
         "bert",
         lambda: _bench_bert_embed(
@@ -549,6 +564,10 @@ def main():
     print(f"# inception_v3_int8_map_blocks_rows_per_sec={inception_rps_q:.0f}")
     print(
         f"# inception_v3_frozen_graphdef_rows_per_sec={inception_frozen_rps:.0f}"
+    )
+    print(
+        "# inception_v3_frozen_int8_graphdef_rows_per_sec="
+        f"{inception_frozen_rps_q:.0f}"
     )
     print(
         f"# bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec={bert_rps:.0f}"

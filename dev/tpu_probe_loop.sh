@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon TPU backend on a 2-6 min cadence (120s sleep + up to
+# 240s probe timeout when the backend hangs); write status to
+# dev/tpu_probe.log and touch dev/TPU_ALIVE when a probe succeeds.
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 240 python -c "import jax; jax.devices(); print('ok')" >/dev/null 2>&1; then
+    echo "$ts ALIVE" >> /root/repo/dev/tpu_probe.log
+    touch /root/repo/dev/TPU_ALIVE
+    exit 0
+  else
+    echo "$ts wedged" >> /root/repo/dev/tpu_probe.log
+  fi
+  sleep 120
+done

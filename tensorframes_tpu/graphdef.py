@@ -41,6 +41,12 @@ from .shape import Shape, Unknown
 # ---------------------------------------------------------------------------
 
 
+class _WireError(ValueError):
+    """Byte-level decoding failure (malformed wire format) — distinct
+    from semantic ValueErrors (unsupported dtype, string Const, …) so
+    :func:`parse_graphdef` can re-label only true corruption."""
+
+
 def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
     result = 0
     shift = 0
@@ -52,7 +58,7 @@ def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
             return result, pos
         shift += 7
         if shift > 70:
-            raise ValueError("malformed varint")
+            raise _WireError("malformed varint")
 
 
 def _signed(v: int) -> int:
@@ -84,7 +90,7 @@ def _iter_fields(data: bytes):
             yield field, wire, data[pos:pos + 4]
             pos += 4
         else:
-            raise ValueError(f"unsupported wire type {wire}")
+            raise _WireError(f"unsupported wire type {wire}")
 
 
 # ---------------------------------------------------------------------------
@@ -295,7 +301,20 @@ def parse_graphdef(data: bytes) -> List[GraphNode]:
     """Decode a serialized ``GraphDef`` (graph.proto: field 1 = repeated
     NodeDef) into :class:`GraphNode` records. Unknown fields are skipped —
     version stamps, device placements, and library functions don't affect
-    the frozen-inference subset."""
+    the frozen-inference subset. Malformed bytes raise ``ValueError``
+    ("not a valid GraphDef"), never a bare index/struct error."""
+    try:
+        return _parse_graphdef_inner(data)
+    except (IndexError, struct.error, UnicodeDecodeError, _WireError) as e:
+        # only true wire-level corruption re-labels; semantic errors
+        # (unsupported dtype enum, string Const) keep their own message
+        raise ValueError(
+            f"not a valid serialized GraphDef ({type(e).__name__} while "
+            f"decoding: {e})"
+        ) from e
+
+
+def _parse_graphdef_inner(data: bytes) -> List[GraphNode]:
     nodes: List[GraphNode] = []
     for field, _, v in _iter_fields(data):
         if field != 1:
@@ -465,6 +484,7 @@ def program_from_graphdef(
     nodes: Sequence[GraphNode],
     fetches: Optional[Sequence[str]] = None,
     relax_lead_dim: bool = False,
+    quantize_weights: bool = False,
 ) -> Program:
     """Lower decoded GraphDef nodes to a :class:`Program`.
 
@@ -473,7 +493,10 @@ def program_from_graphdef(
     via ShapeDescription). ``relax_lead_dim=True`` widens each
     placeholder's leading dim to Unknown so fixed-shape frozen graphs run
     over arbitrary block row counts (≙ extractPlaceholder's block-shape
-    widening, dsl/DslImpl.scala:90-107).
+    widening, dsl/DslImpl.scala:90-107). ``quantize_weights=True``
+    stores float Const filters feeding Conv2D/depthwise/MatMul as
+    symmetric per-channel int8 (ops/quantize.py — 4× less weight HBM
+    traffic; XLA fuses the dequantize into the consuming conv/matmul).
     """
     by_name = {n.name: n for n in nodes}
     consumed = set()
@@ -534,9 +557,62 @@ def program_from_graphdef(
             f"{sorted(_BINARY)}, {sorted(_UNARY)}, {sorted(_REDUCERS)}"
         )
 
+    if quantize_weights:
+        from .ops.quantize import quantize
+
+        def resolve_const(name: str) -> Optional[str]:
+            """Follow Identity chains (the freezer leaves
+            ReadVariableOp→Identity wrappers over each folded Const)."""
+            seen = set()
+            while name in by_name and name not in seen:
+                seen.add(name)
+                node = by_name[name]
+                if node.op != "Identity":
+                    break
+                refs = [r for r in node.inputs if not r.startswith("^")]
+                if not refs:
+                    break
+                name = _base(refs[0])
+            return name if name in consts else None
+
+        # per-consumer channel spec: Conv2D filters [H,W,I,O] keep the
+        # output axis; depthwise [H,W,C,M] channels span BOTH trailing
+        # axes (one scale per (channel, multiplier) — axis -1 alone
+        # would collapse to per-tensor when M==1, the classic MobileNet
+        # int8 accuracy failure); MatMul honors transpose_b. Conflicting
+        # specs for a shared weight skip quantization.
+        weight_plan: Dict[str, object] = {}
+        conflicted = set()
+        for n in nodes:
+            if n.op in ("Conv2D", "DepthwiseConv2dNative", "MatMul"):
+                data_refs = [r for r in n.inputs if not r.startswith("^")]
+                if len(data_refs) < 2:
+                    continue
+                wn = resolve_const(_base(data_refs[1]))
+                if wn is None:
+                    continue
+                w = consts[wn]
+                if w.ndim < 2 or not np.issubdtype(w.dtype, np.floating):
+                    continue
+                if n.op == "DepthwiseConv2dNative":
+                    spec: object = (2, 3)
+                elif n.op == "MatMul":
+                    tb = n.attrs.get("transpose_b")
+                    spec = 0 if (tb and tb.b) else -1
+                else:
+                    spec = -1
+                if wn in weight_plan and weight_plan[wn] != spec:
+                    conflicted.add(wn)
+                weight_plan[wn] = spec
+        for wn, spec in weight_plan.items():
+            if wn not in conflicted:
+                consts[wn] = quantize(consts[wn], channel_axis=spec)
+
     fetch_list = list(fetches)
 
     def fn(feeds: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        from .ops.quantize import QuantizedTensor
+
         values: Dict[str, jnp.ndarray] = {}
 
         def ev(name: str):
@@ -546,7 +622,13 @@ def program_from_graphdef(
             if n.op == "Placeholder":
                 v = feeds[name]
             elif n.op == "Const":
-                v = jnp.asarray(consts[name])
+                c = consts[name]
+                if isinstance(c, QuantizedTensor):
+                    # dequantize at use; XLA fuses the scale-multiply
+                    # into the consuming conv/matmul
+                    v = c.dequantize(jnp.float32)
+                else:
+                    v = jnp.asarray(c)  # keep the const's own dtype
             else:
                 args = [ev(_base(r)) for r in n.inputs if not r.startswith("^")]
                 if n.op in _BINARY:
@@ -654,6 +736,7 @@ def load_graphdef(
     path: str,
     fetches: Optional[Sequence[str]] = None,
     relax_lead_dim: bool = False,
+    quantize_weights: bool = False,
 ) -> Program:
     """Load a frozen TF ``GraphDef`` file as an analyzed Program
     (≙ ``graphFromFile``, PythonInterface.scala:115-118 — but static:
@@ -662,7 +745,10 @@ def load_graphdef(
     with open(path, "rb") as f:
         data = f.read()
     program = program_from_graphdef(
-        parse_graphdef(data), fetches=fetches, relax_lead_dim=relax_lead_dim
+        parse_graphdef(data),
+        fetches=fetches,
+        relax_lead_dim=relax_lead_dim,
+        quantize_weights=quantize_weights,
     )
     return analyze_program(program)
 

@@ -59,15 +59,20 @@ class QuantizedTensor:
         return cls(*children)
 
 
-def quantize(w, channel_axis: int = -1) -> QuantizedTensor:
+def quantize(w, channel_axis=-1) -> QuantizedTensor:
     """Symmetric per-channel int8: scales are per-slice max/127 along
     every axis EXCEPT ``channel_axis`` (the output-feature axis, whose
-    per-channel dynamic range is what matters for matmul accuracy)."""
+    per-channel dynamic range is what matters for matmul accuracy).
+    ``channel_axis`` may be a tuple for weights whose channels span
+    several axes (depthwise filters ``[H,W,C,M]`` keep ``(2, 3)``)."""
     w = jnp.asarray(w)
     if not jnp.issubdtype(w.dtype, jnp.floating):
         raise TypeError(f"quantize expects a floating array, got {w.dtype}")
-    axis = channel_axis % w.ndim
-    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    axes = (
+        (channel_axis,) if isinstance(channel_axis, int) else tuple(channel_axis)
+    )
+    keep = {a % w.ndim for a in axes}
+    reduce_axes = tuple(i for i in range(w.ndim) if i not in keep)
     w32 = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)

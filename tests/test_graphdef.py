@@ -156,3 +156,21 @@ def test_string_const_raises():
     proto = b"\x08\x07" + b"\x42\x02hi"  # dtype=DT_STRING, string_val="hi"
     with pytest.raises(ValueError, match="string"):
         _parse_tensor(proto)
+
+
+def test_malformed_bytes_raise_value_error():
+    """Corrupt/truncated input surfaces as ValueError naming the format,
+    not a bare IndexError from the wire decoder."""
+    from tensorframes_tpu.graphdef import parse_graphdef
+
+    with pytest.raises(ValueError, match="GraphDef"):
+        parse_graphdef(b"\x0a\xff\xff\xff")  # truncated LEN field
+    with pytest.raises(ValueError, match="GraphDef"):
+        parse_graphdef(bytes(range(1, 64)))  # arbitrary junk
+
+
+def test_load_graphdef_on_non_proto_file(tmp_path):
+    p = tmp_path / "junk.pb"
+    p.write_bytes(b"this is not a protobuf at all \xff\xfe")
+    with pytest.raises(ValueError, match="GraphDef"):
+        tfs.load_graphdef(str(p))

@@ -211,3 +211,41 @@ def test_load_saved_model_unknown_signature(tmp_path):
     tf.saved_model.save(model, sm_dir)
     with pytest.raises(KeyError, match="serving_default|available"):
         tfs.load_saved_model(sm_dir, signature="nope")
+
+
+def test_quantized_import_close_to_f32(tmp_path):
+    """quantize_weights=True stores conv/dense filters as per-channel
+    int8; outputs stay close to the f32 import and the weight consts
+    actually shrink."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    from tensorframes_tpu.graphdef import load_graphdef
+
+    tf.keras.utils.set_random_seed(11)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((12, 12, 3)),
+            tf.keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(4),
+        ]
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, 12, 12, 3], tf.float32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+    p = tmp_path / "m.pb"
+    p.write_bytes(data)
+
+    full = tfs.load_graphdef(str(p), relax_lead_dim=True)
+    quant = load_graphdef(str(p), relax_lead_dim=True, quantize_weights=True)
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((4, 12, 12, 3)).astype(np.float32)
+    [inp] = full.inputs
+    out_f = np.asarray(full.fn({inp.name: x})[full.fetch_order[0]])
+    out_q = np.asarray(quant.fn({inp.name: x})[quant.fetch_order[0]])
+    # int8 weight error is small but nonzero
+    assert not np.array_equal(out_f, out_q)
+    np.testing.assert_allclose(out_q, out_f, atol=0.05, rtol=0.1)
