@@ -29,6 +29,8 @@ BOUNDS = {
     "bert_tiny_map_rows_rows_per_sec": ("min", 500.0),
     "aggregate_strings_1M_512groups_wall_s": ("max", 30.0),
     "map_rows_ragged_rows_per_sec": ("min", 1000.0),
+    "inception_v3_frozen_graphdef_rows_per_sec": ("min", 5.0),
+    "inception_v3_frozen_int8_graphdef_rows_per_sec": ("min", 5.0),
 }
 
 

@@ -249,3 +249,42 @@ def test_quantized_import_close_to_f32(tmp_path):
     # int8 weight error is small but nonzero
     assert not np.array_equal(out_f, out_q)
     np.testing.assert_allclose(out_q, out_f, atol=0.05, rtol=0.1)
+
+
+def test_imported_graph_exports_to_stablehlo(tmp_path):
+    """Conversion pipeline: frozen TF GraphDef → Program → StableHLO
+    artifact (save_program/jax.export) → reload → same results. The
+    artifact needs neither TF nor the original graph — the TF-to-TPU
+    redistribution story in one round-trip."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(13)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((10, 10, 3)),
+            tf.keras.layers.Conv2D(6, 3, padding="same", activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(4),
+        ]
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, 10, 10, 3], tf.float32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+    p = tmp_path / "m.pb"
+    p.write_bytes(data)
+
+    prog = tfs.load_graphdef(str(p), relax_lead_dim=True)
+    art = str(tmp_path / "m.stablehlo")
+    tfs.save_program(prog, art)
+    back = tfs.load_program(art)
+
+    rng = np.random.default_rng(14)
+    for n in (3, 7):  # symbolic batch dim survives the round-trip
+        x = rng.standard_normal((n, 10, 10, 3)).astype(np.float32)
+        [inp] = prog.inputs
+        want = np.asarray(prog.fn({inp.name: x})[prog.fetch_order[0]])
+        got = np.asarray(back.fn({inp.name: x})[prog.fetch_order[0]])
+        np.testing.assert_allclose(got, want, atol=1e-6)
