@@ -15,11 +15,13 @@ Supported ops cover the surface the reference's own DSL emits
 the obvious neighbours (Sub/Mul/Neg/Max/Mean/Prod/Maximum/Minimum/
 MatMul/Relu/Exp/Log/Sqrt/Rsqrt/Cast/Reshape/Squeeze/Pad/Softmax), and
 the convolutional family frozen image models need (Conv2D/
-DepthwiseConv2dNative/MaxPool/AvgPool/BiasAdd/Concat[V2] over NHWC) —
-enough that a full frozen keras Inception-v3 (~2200 nodes, batchnorm
-decomposed to Mul/Sub/Rsqrt/AddV2 by the freezer) executes bit-close to
-TF (tests/test_graphdef_frozen.py). Anything else raises with the op
-name — the honest bounded-op-subset contract.
+DepthwiseConv2dNative/MaxPool/AvgPool/BiasAdd/Concat[V2]/
+FusedBatchNorm[V2/V3] over NHWC) — enough that a full frozen keras
+Inception-v3 (~2200 nodes, batchnorm decomposed to Mul/Sub/Rsqrt/AddV2
+by the freezer) and TF1-era graphs with un-decomposed FusedBatchNorm
+execute bit-close to TF (tests/test_graphdef_frozen.py).
+``quantize_weights=True`` stores filters as per-channel int8. Anything
+else raises with the op name — the honest bounded-op-subset contract.
 """
 
 from __future__ import annotations
@@ -503,6 +505,16 @@ def program_from_graphdef(
     for n in nodes:
         for ref in n.inputs:
             consumed.add(_base(ref))
+            # single-output evaluation model: a data ref to output :k>0
+            # (FusedBatchNorm's batch stats, future multi-output ops)
+            # would silently receive output :0 — reject it up front
+            if not ref.startswith("^") and ":" in ref:
+                idx = ref.rsplit(":", 1)[1]
+                if idx.isdigit() and int(idx) > 0:
+                    raise ValueError(
+                        f"node {n.name!r} consumes output {ref!r}; only "
+                        "output :0 of each node is supported"
+                    )
     if fetches is None:
         fetches = [
             n.name
@@ -539,6 +551,7 @@ def program_from_graphdef(
         "Placeholder", "Const", "Cast", "Reshape", "MatMul", "NoOp",
         "Conv2D", "DepthwiseConv2dNative", "MaxPool", "AvgPool",
         "BiasAdd", "ConcatV2", "Concat", "Squeeze", "Pad", "PadV2",
+        "FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3",
     )
     unsupported = sorted(
         {
@@ -720,6 +733,29 @@ def program_from_graphdef(
                             )
                         cval = float(np.asarray(consts[cv_name]))
                     v = jnp.pad(args[0], pads, constant_values=cval)
+                elif n.op in (
+                    "FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"
+                ):
+                    # inference form (TF1-era frozen graphs keep the op
+                    # un-decomposed): y = (x - mean) * rsqrt(var + eps)
+                    # * scale + offset over NHWC channels. Output :0
+                    # only — consumers of :1/:2 are rejected at import
+                    # (see the multi-output check below the ev loop).
+                    # The op's is_training DEFAULT is true, so a missing
+                    # attr (strip_default_attrs) means training too.
+                    tr = n.attrs.get("is_training")
+                    if tr is None or tr.b:
+                        raise ValueError(
+                            f"{n.op} node {name!r}: is_training=true "
+                            "(explicit or by TF default) is not "
+                            "executable in a frozen graph"
+                        )
+                    _nhwc(n)
+                    eps_a = n.attrs.get("epsilon")
+                    eps = eps_a.f if eps_a and eps_a.f is not None else 1e-4
+                    xb, scale, offset, mean, var = args[:5]
+                    inv = scale * (1.0 / jnp.sqrt(var + eps))
+                    v = (xb - mean) * inv + offset
                 elif n.op == "NoOp":
                     v = None  # control-only; never consumed as data
                 else:  # pragma: no cover — filtered above

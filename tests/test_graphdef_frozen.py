@@ -288,3 +288,48 @@ def test_imported_graph_exports_to_stablehlo(tmp_path):
         want = np.asarray(prog.fn({inp.name: x})[prog.fetch_order[0]])
         got = np.asarray(back.fn({inp.name: x})[prog.fetch_order[0]])
         np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_fused_batch_norm_inference_matches_tf():
+    """TF1-era frozen graphs keep FusedBatchNorm un-decomposed; the
+    inference lowering must match TF (the published Inception frozen
+    checkpoints are exactly this shape)."""
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 5, 5, 4], name="x")
+        rng = np.random.default_rng(20)
+        scale = tf.constant(rng.uniform(0.5, 2.0, 4).astype(np.float32))
+        offset = tf.constant(rng.normal(size=4).astype(np.float32))
+        mean = tf.constant(rng.normal(size=4).astype(np.float32))
+        var = tf.constant(rng.uniform(0.2, 3.0, 4).astype(np.float32))
+        y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            x, scale, offset, mean=mean, variance=var,
+            epsilon=1e-3, is_training=False,
+        )
+        out = tf.identity(y, name="out")
+    data = g.as_graph_def().SerializeToString()
+    xv = np.random.default_rng(21).standard_normal((3, 5, 5, 4)).astype(
+        np.float32
+    )
+    prog = program_from_graphdef(parse_graphdef(data), fetches=["out"])
+    got = np.asarray(prog.fn({"x": xv})["out"])
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run("out:0", {"x:0": xv})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_secondary_outputs_rejected():
+    """Consuming a multi-output node's :1/:2 (FusedBatchNorm batch
+    stats) must raise at import — the evaluator is single-output and
+    would silently substitute :0."""
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4, 4, 2], name="x")
+        c = tf.constant(np.ones(2, np.float32))
+        y, bm, _ = tf.compat.v1.nn.fused_batch_norm(
+            x, c, c, mean=c, variance=c, is_training=False
+        )
+        tf.identity(bm, name="stats")  # consumes output :1
+    data = g.as_graph_def().SerializeToString()
+    with pytest.raises(ValueError, match="output"):
+        program_from_graphdef(parse_graphdef(data), fetches=["stats"])
