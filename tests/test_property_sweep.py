@@ -39,11 +39,15 @@ def test_map_and_reduce_sweep(dtype, cell, nb):
     assert y.dtype == dtype
     np.testing.assert_array_equal(y, x + x)
 
+    # float comparisons carry atol as well as rtol: XLA CPU's threaded
+    # reduction split varies with machine load, so f32 summation order
+    # (and hence last-ulp rounding) is not stable across runs — a
+    # near-zero component sum would flake an rtol-only assert.
     # map_rows: per-row sum cell → scalar
     if cell:
         rsum = tfs.map_rows(lambda x: {"s": x.sum()}, frame)
         np.testing.assert_allclose(
-            rsum.column_values("s"), x.sum(axis=1), rtol=1e-5
+            rsum.column_values("s"), x.sum(axis=1), rtol=1e-5, atol=1e-5
         )
 
     # reduce_blocks: total sum via the x_input contract. jnp.sum promotes
@@ -53,7 +57,7 @@ def test_map_and_reduce_sweep(dtype, cell, nb):
     tot = tfs.reduce_blocks(
         lambda x_input: {"x": x_input.sum(axis=0, dtype=x_input.dtype)}, frame
     )
-    np.testing.assert_allclose(np.asarray(tot), x.sum(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(tot), x.sum(axis=0), rtol=1e-5, atol=1e-5)
 
     # reduce_rows: pairwise max
     mx = tfs.reduce_rows(
@@ -74,7 +78,12 @@ def test_aggregate_sweep(nb):
     )
     got = {r["k"]: r["v"] for r in agg.collect()}
     for key in np.unique(k):
-        assert got[int(key)] == pytest.approx(float(v[k == key].sum()), rel=1e-5)
+        # abs slack too: group sums can land near zero, where rel-only
+        # tolerance is ~1 ulp of the partial sums (see the comment in
+        # test_map_and_reduce_sweep)
+        assert got[int(key)] == pytest.approx(
+            float(v[k == key].sum()), rel=1e-5, abs=1e-5
+        )
 
 
 def test_sweep_device_residency():
