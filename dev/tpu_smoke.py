@@ -21,22 +21,34 @@ Exit code 0 = all green (prints per-check lines).
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 import time
 
+# runnable from any cwd: the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def probe(timeout_s: float = 150.0) -> bool:
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
-        return r.returncode == 0 and "ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+
+def probe(timeout_s: float = 60.0, attempts: int = 3) -> bool:
+    """The axon tunnel intermittently hangs a NEW connection even when the
+    chip is healthy (observed round 3: one probe hung >150s, the next
+    connected in 0.09s) — so retry a few short attempts instead of one
+    long one."""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"probe attempt {i + 1}/{attempts} failed; retrying")
+    return False
 
 
 def main() -> int:

@@ -333,3 +333,27 @@ def test_secondary_outputs_rejected():
     data = g.as_graph_def().SerializeToString()
     with pytest.raises(ValueError, match="output"):
         program_from_graphdef(parse_graphdef(data), fetches=["stats"])
+
+
+def test_load_saved_model_quantize_weights(tmp_path):
+    """ADVICE r2: quantize_weights reaches the SavedModel loader too (API
+    symmetry with load_graphdef) — int8 per-channel weights, scoring
+    close to the float model."""
+    tf.keras.utils.set_random_seed(11)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(8, activation="relu"),
+            tf.keras.layers.Dense(3),
+        ]
+    )
+    sm_dir = str(tmp_path / "smq")
+    tf.saved_model.save(model, sm_dir)
+    prog = tfs.load_saved_model(sm_dir, relax_lead_dim=True, quantize_weights=True)
+    [inp] = prog.inputs
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((5, 6)).astype(np.float32)
+    got = np.asarray(prog.fn({inp.name: x})[prog.fetch_order[0]])
+    want = model(x, training=False).numpy()
+    # int8 per-channel quantization: close, not bit-equal
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.1)
