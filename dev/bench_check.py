@@ -1,60 +1,122 @@
-"""Bench regression gate for CI (CPU-fallback config).
+"""Bench regression gate.
 
-Parses the ``# key=value`` lines bench.py prints and enforces loose
-floors/ceilings (~20x headroom vs the recorded CPU-fallback table in
-BASELINE.md) — the goal is to catch order-of-magnitude regressions
-(accidental per-row dispatch, lost native marshalling, recompile storms),
-not to benchmark CI runners.
+Parses the ``# key=value`` lines bench.py prints and compares each metric
+against the recorded baseline for the SAME platform
+(``dev/bench_baseline.json`` holds one section per platform — CPU and
+TPU runs emit different metric names and incomparable values). The
+round-2 verdict showed ~20x floors cannot see a 25% regression — the
+default factor is 2.0 against a same-machine baseline, so an injected
+2x slowdown trips the gate while ordinary run-to-run variance (median
+timing in bench.py holds repeats to ~10%) does not.
 
-Usage: python dev/bench_check.py bench_output.txt
+Usage: python dev/bench_check.py bench_output.txt [--factor F]
+       [--require-all] [--refresh]
+
+* ``--factor`` widens the allowance for alien runners (CI uses 10).
+* A metric whose bench line reads ``name=ERROR ImportError...`` is
+  SKIPPED with a note unless ``--require-all``: CI installs no
+  tensorflow, so the frozen-graph fixtures legitimately can't build
+  there (ADVICE r2).
+* ``--refresh`` records this run as the baseline for its platform.
+* No baseline recorded yet for this platform → pass with a notice (the
+  first run on new hardware cannot regress against anything).
 """
 
 from __future__ import annotations
 
+import json
+import os
 import re
 import sys
 
-# metric name → (kind, bound). kind 'min' = value must be >= bound,
-# 'max' = value must be <= bound. Bounds are ~20x slack off the
-# BASELINE.md CPU-fallback rows so runner variance never flakes.
-BOUNDS = {
-    "add3_map_blocks_rows_per_sec": ("min", 2e7),
-    "logreg_map_blocks_rows_per_sec": ("min", 8e4),
-    "inception_v3_map_blocks_rows_per_sec": ("min", 3.0),
-    "convert_1M_int_rows_s": ("max", 1.0),
-    "convertback_1M_int_cells_s": ("max", 6.0),
-    "read_csv_1M_rows_s": ("max", 3.0),
-    "aggregate_1M_512groups_wall_s": ("max", 3.0),
-    "reduce_blocks_1M_wall_s": ("max", 0.5),
-    "bert_tiny_map_rows_rows_per_sec": ("min", 500.0),
-    "aggregate_strings_1M_512groups_wall_s": ("max", 30.0),
-    "map_rows_ragged_rows_per_sec": ("min", 1000.0),
-    "inception_v3_frozen_graphdef_rows_per_sec": ("min", 5.0),
-    "inception_v3_frozen_int8_graphdef_rows_per_sec": ("min", 5.0),
-}
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
+
+# metrics measured in seconds regress UPWARD; rates regress DOWNWARD
+_IS_WALL = re.compile(r"(_s|_wall_s)$")
 
 
-def main(path: str) -> int:
+def parse(text: str):
+    found = dict(re.findall(r"^# ([\w]+)=([0-9.eE+-]+)$", text, re.M))
+    errors = dict(re.findall(r"^# ([\w]+)=ERROR (\S+)", text, re.M))
+    platform = "tpu" if re.search(r"devices=\[(?!CpuDevice)", text) else "cpu"
+    return {k: float(v) for k, v in found.items()}, errors, platform
+
+
+def main(argv) -> int:
+    path = argv[0]
+    factor = 2.0
+    require_all = "--require-all" in argv
+    refresh = "--refresh" in argv
+    if "--factor" in argv:
+        factor = float(argv[argv.index("--factor") + 1])
     with open(path) as f:
         text = f.read()
-    found = dict(re.findall(r"^# (\w+)=([0-9.eE+-]+)$", text, re.M))
-    failures = []
-    checked = 0
-    for name, (kind, bound) in BOUNDS.items():
-        if name not in found:
-            failures.append(f"MISSING metric {name}")
-            continue
-        v = float(found[name])
-        checked += 1
-        if kind == "min" and v < bound:
-            failures.append(f"{name}={v:g} below floor {bound:g}")
-        elif kind == "max" and v > bound:
-            failures.append(f"{name}={v:g} above ceiling {bound:g}")
-    print(f"bench_check: {checked} metrics checked, {len(failures)} failures")
+    values, errors, platform = parse(text)
+
+    try:
+        with open(BASELINE_PATH) as f:
+            all_baselines = json.load(f)
+    except FileNotFoundError:
+        all_baselines = {}
+
+    if refresh:
+        all_baselines[platform] = values
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(all_baselines, f, indent=1, sort_keys=True)
+        print(
+            f"bench_check: {platform} baseline refreshed with "
+            f"{len(values)} metrics"
+        )
+        return 0
+
+    baseline = all_baselines.get(platform)
+    if not baseline:
+        print(
+            f"bench_check: no {platform} baseline recorded yet — nothing to "
+            "compare (record one with --refresh)"
+        )
+        return 0
+
+    failures, skipped, checked = [], [], 0
+    for name, base in baseline.items():
+        if name in values:
+            v = values[name]
+            if base == 0:
+                skipped.append(f"{name}: zero baseline (re-refresh)")
+                continue
+            checked += 1
+            if _IS_WALL.search(name):
+                if v > base * factor:
+                    failures.append(
+                        f"{name}={v:g} above {base:g}×{factor:g} ceiling"
+                    )
+            elif v < base / factor:
+                failures.append(
+                    f"{name}={v:g} below {base:g}/{factor:g} floor"
+                )
+        elif name in errors and errors[name].startswith("ImportError"):
+            # fixture deps (tensorflow) absent on this runner — a known
+            # benign configuration, not a regression (ADVICE r2)
+            if require_all:
+                failures.append(f"MISSING {name} ({errors[name]})")
+            else:
+                skipped.append(f"{name}: {errors[name]}")
+        else:
+            failures.append(
+                f"MISSING metric {name}"
+                + (f" ({errors[name]})" if name in errors else "")
+            )
+    print(
+        f"bench_check: {checked} {platform} metrics checked vs baseline "
+        f"(factor {factor:g}), {len(skipped)} skipped, "
+        f"{len(failures)} failures"
+    )
+    for s in skipped:
+        print(f"  SKIP {s}")
     for f_ in failures:
         print(f"  FAIL {f_}")
     return 1 if failures else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1]))
+    sys.exit(main(sys.argv[1:]))

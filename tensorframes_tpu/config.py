@@ -60,6 +60,13 @@ class Config:
     # processes deserialize the executable instead of recompiling
     # (empty = disabled).
     compilation_cache_dir: str = os.environ.get("TFTPU_COMPILE_CACHE", "")
+    # Lift closure-captured program constants (frozen model weights) out
+    # of the HLO and pass them as runtime arguments. Without this, XLA
+    # constant-folds through embedded weights — un-doing int8 weight
+    # quantization (measured round 3: folded back to f32, zero byte
+    # saving) and bloating every per-shape compile with literal copies
+    # of the weights.
+    hoist_constants: bool = _env_bool("TFTPU_HOIST_CONSTS", True)
     # Demote f64/i64 device columns to f32/i32 at the device boundary:
     # False = never (reference-parity precision, f64 emulated on TPU),
     # True = on TPU backends only, "always" = every backend (testing /

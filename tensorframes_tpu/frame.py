@@ -134,6 +134,16 @@ class TensorFrame:
     def column_values(self, name: str) -> np.ndarray:
         """Concatenate one column across blocks (dense columns only)."""
         info = self.schema[name]
+        import jax as _jax
+
+        if name in getattr(self, "_process_local_cols", ()) and _jax.process_count() > 1:
+            raise RuntimeError(
+                f"Column {name!r} is process-local (host-only column of a "
+                "multi-process frame); one process cannot materialize the "
+                "global column. Aggregate by it (the dictionary plan "
+                "merges per-process key dictionaries with a collective), "
+                "or persist per process with io.save_frame_sharded."
+            )
         parts = []
         for b in self.blocks():
             v = b[name]
@@ -143,8 +153,9 @@ class TensorFrame:
                 raise RuntimeError(
                     f"Column {name!r} spans processes (multi-host global "
                     "array); one process cannot materialize it. Reduce it "
-                    "with a verb (reduce_*/aggregate run as collectives), "
-                    "or persist per process with io.save_frame_sharded."
+                    "with a verb (reduce_blocks/reduce_rows/aggregate run "
+                    "as collectives without a host gather), or persist per "
+                    "process with io.save_frame_sharded."
                 )
             parts.append(v)
         if not parts:

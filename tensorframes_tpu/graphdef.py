@@ -741,16 +741,13 @@ def program_from_graphdef(
                 if node.op == "Placeholder":
                     values[nm] = feeds[nm]
                 elif node.op == "Const":
-                    c = consts[nm]
-                    if isinstance(c, QuantizedTensor):
-                        # dequantize at use; XLA fuses the scale-multiply
-                        # into the consuming conv/matmul
-                        values[nm] = c.dequantize(jnp.float32)
-                    else:
-                        # raw numpy: stays trace-time concrete so shape
-                        # arithmetic (reduction axes, Tile multiples, …)
-                        # can consume it on the host
-                        values[nm] = c
+                    # raw numpy stays trace-time concrete (shape
+                    # arithmetic consumes it on the host); a
+                    # QuantizedTensor flows INTACT to its consumer so
+                    # MatMul/Conv can contract int8 directly and scale
+                    # the output — dequantizing here would materialize a
+                    # full f32 weight copy every call
+                    values[nm] = consts[nm]
                 elif node.op == "NoOp":
                     values[nm] = None  # control-only; never consumed as data
                 else:
@@ -776,6 +773,8 @@ def program_from_graphdef(
         out = {}
         for f in fetch_list:
             v = materialize(f)
+            if isinstance(v, QuantizedTensor):  # directly-fetched weight
+                v = v.dequantize(jnp.float32)
             # shape-arith fetches come back as host numpy; normalize to
             # device arrays (matches the pre-r3 Const behavior incl. the
             # x64-off f64→f32 demotion)
@@ -791,9 +790,49 @@ def _eval_node(n: GraphNode, args: List):
     targets, Tile multiples, pad widths, …) must be trace-time concrete —
     satisfied both by Const nodes (≙ build_reducer's const child,
     DslImpl.scala:175-200) and by values derived from ``Shape`` of a
-    traced array, which is static under XLA."""
+    traced array, which is static under XLA.
+
+    Quantized weights (``QuantizedTensor``) are consumed natively by
+    MatMul/Conv2D/DepthwiseConv2dNative — int8 enters the contraction
+    and the per-channel scale multiplies the OUTPUT, so no dequantized
+    f32 weight is ever materialized; every other consumer dequantizes."""
+    from .ops.quantize import QuantizedTensor
+
     name = n.name
     op = n.op
+    if op == "MatMul":
+        a, b = args
+        ta = n.attrs.get("transpose_a")
+        tb = n.attrs.get("transpose_b")
+        if isinstance(a, QuantizedTensor):
+            a = a.dequantize(jnp.float32)
+        if ta and ta.b:
+            a = a.T
+        if isinstance(b, QuantizedTensor):
+            q = b.q.T if (tb and tb.b) else b.q
+            scale = b.scale.T if (tb and tb.b) else b.scale
+            out = jax.lax.dot_general(
+                a,
+                q,
+                dimension_numbers=(((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=a.dtype,
+            )
+            return out * jnp.asarray(scale, a.dtype)
+        if tb and tb.b:
+            b = b.T
+        return a @ b
+    if op == "Conv2D" and isinstance(args[1], QuantizedTensor):
+        x_, w_ = args
+        out = _conv2d(n, x_, w_.q.astype(x_.dtype))
+        return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), x_.dtype)
+    if op == "DepthwiseConv2dNative" and isinstance(args[1], QuantizedTensor):
+        x_, w_ = args
+        out = _depthwise_conv2d(n, x_, w_.q.astype(x_.dtype))
+        return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), x_.dtype)
+    args = [
+        a.dequantize(jnp.float32) if isinstance(a, QuantizedTensor) else a
+        for a in args
+    ]
     if op in _BINARY:
         if op in _BINARY_NP and _is_concrete(*args):
             return _BINARY_NP[op](*args)
@@ -823,15 +862,6 @@ def _eval_node(n: GraphNode, args: List):
             int(d) for d in _concrete_operand(n, "shape", args[1])
         )
         return args[0].reshape(shp)
-    if op == "MatMul":
-        a, b = args
-        ta = n.attrs.get("transpose_a")
-        tb = n.attrs.get("transpose_b")
-        if ta and ta.b:
-            a = a.T
-        if tb and tb.b:
-            b = b.T
-        return a @ b
     if op == "Conv2D":
         return _conv2d(n, *args)
     if op == "DepthwiseConv2dNative":

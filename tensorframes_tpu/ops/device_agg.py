@@ -146,6 +146,113 @@ def _run_tables(
     return sel, out_cols
 
 
+def _allgather_dicts(local_cols: List[np.ndarray]) -> Tuple[List[np.ndarray], int]:
+    """Union every process's group-key dictionary columns.
+
+    Serializes this process's dictionary (one array per key column, one
+    row per LOCAL distinct group), allgathers fixed-width byte buffers in
+    two phases (sizes, then padded payloads — ``process_allgather``
+    requires equal shapes), and returns ``(union_cols, offset)`` where
+    ``union_cols`` concatenates all processes' dictionaries in process
+    order and ``offset`` is where this process's entries start."""
+    import pickle
+
+    from jax.experimental import multihost_utils as mh
+
+    payload = np.frombuffer(
+        pickle.dumps(local_cols, protocol=pickle.HIGHEST_PROTOCOL), np.uint8
+    )
+    sizes = np.asarray(
+        mh.process_allgather(np.asarray([payload.size], np.int64))
+    ).reshape(-1)
+    width = int(sizes.max())
+    padded = np.zeros(width, np.uint8)
+    padded[: payload.size] = payload
+    bufs = np.asarray(mh.process_allgather(padded)).reshape(len(sizes), width)
+    dicts = [
+        pickle.loads(bufs[p, : int(sizes[p])].tobytes())
+        for p in range(len(sizes))
+    ]
+    me = jax.process_index()
+    offset = int(sum(len(d[0]) for d in dicts[:me]))
+    union = [
+        np.concatenate([np.asarray(d[i]) for d in dicts])
+        for i in range(len(local_cols))
+    ]
+    return union, offset
+
+
+def _aggregate_multiprocess_dict(
+    frame, keys, ops, out_names, main, feat, axis
+) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]]:
+    """Dictionary plan across processes: local encode → dictionary
+    allgather/merge → global dense ids → shared segment plan. Key columns
+    may be process-local host lists (strings) or sharded device arrays;
+    value columns stay sharded throughout."""
+    from jax.sharding import NamedSharding
+
+    from jax.experimental import multihost_utils as mh
+
+    key_local: List[np.ndarray] = []
+    ok = True
+    for k in keys:
+        v = main[k]
+        if isinstance(v, list):
+            key_local.append(np.asarray(v, dtype=object))
+        else:
+            shards = sorted(
+                v.addressable_shards, key=lambda s: s.index[0].start or 0
+            )
+            if not shards:
+                ok = False
+                break
+            key_local.append(
+                np.concatenate([np.asarray(s.data) for s in shards])
+            )
+    n_local = len(key_local[0]) if key_local else 0
+    if ok and any(len(a) != n_local for a in key_local):
+        # a host key column whose local rows disagree with this process's
+        # device shard rows cannot be aligned
+        ok = False
+    # eligibility must be decided UNIFORMLY before any further collective:
+    # one process bailing to the host path while the rest enter the
+    # dictionary allgather would deadlock them (the fallback flag is
+    # itself a collective every process reaches)
+    all_ok = np.asarray(
+        mh.process_allgather(np.asarray([1 if ok else 0], np.int32))
+    )
+    if not int(all_ok.min()):
+        return None
+    if n_local:
+        ids_local, local_dict, k_local = group_ids(key_local)
+    else:
+        ids_local = np.zeros(0, np.int64)
+        local_dict, k_local = [a[:0] for a in key_local], 0
+    union_cols, offset = _allgather_dicts(local_dict)
+    union_ids, group_key_cols, K = group_ids(union_cols)
+    if K * feat > _TABLE_ELEM_LIMIT:
+        logger.debug(
+            "device aggregate: %d groups ×%d feat exceeds the table limit "
+            "(multi-process)", K, feat,
+        )
+        return None
+    gids_local = union_ids[offset:offset + k_local][ids_local].astype(np.int32)
+    ids_global = jax.make_array_from_process_local_data(
+        NamedSharding(frame.mesh, P(axis)), gids_local
+    )
+    sel, out_cols = _run_tables(
+        frame, axis, ops, out_names, K, (1,), (ids_global,), main, None, None
+    )
+    key_cols: Dict[str, np.ndarray] = {}
+    for i, k in enumerate(keys):
+        vals = group_key_cols[i][sel]
+        info = frame.schema[k]
+        key_cols[k] = (
+            vals.astype(info.dtype.np_dtype) if info.is_device else vals
+        )
+    return key_cols, out_cols
+
+
 def try_aggregate_device(
     frame,
     keys: Sequence[str],
@@ -172,10 +279,17 @@ def try_aggregate_device(
         # columns (strings, …) are fine — the dictionary plan handles them
         if isinstance(main[k], list) and frame.schema[k].is_device:
             return None
+    # global row count reads a VALUE column: value columns are always
+    # dense device arrays here, whereas a key column may be a
+    # process-local host list whose length is only this process's rows
     main_rows = int(
-        len(main[keys[0]])
-        if isinstance(main[keys[0]], list)
-        else main[keys[0]].shape[0]
+        main[out_names[0]].shape[0]
+        if out_names
+        else (
+            len(main[keys[0]])
+            if isinstance(main[keys[0]], list)
+            else main[keys[0]].shape[0]
+        )
     )
     if main_rows == 0:
         return None  # everything in the tail → host path is already optimal
@@ -247,9 +361,19 @@ def try_aggregate_device(
     # only (values stay sharded on device). Arbitrary key types; K becomes
     # the number of distinct groups, not the key span. -----------------------
     if jax.process_count() > 1:
-        # the key-column device_get below needs fully-addressable arrays;
-        # multi-process frames keep the dense plan or the host path
-        return None
+        # multi-process: each process dictionary-encodes its LOCAL key
+        # rows, the per-process dictionaries union through one allgather
+        # (tiny: one entry per distinct group), and the merged dense ids
+        # feed the same segment plan — no process ever sees another's
+        # raw key column (≙ replacing the Catalyst shuffle at
+        # DebugRowOps.scala:583 with a dictionary exchange)
+        if tail is not None and len(tail[out_names[0] if out_names else keys[0]]):
+            # the multi-process plan has no tail fold; declining here is
+            # SPMD-uniform (block structure derives from global shapes)
+            return None
+        return _aggregate_multiprocess_dict(
+            frame, keys, ops, out_names, main, feat, axis
+        )
     key_host: List[np.ndarray] = []
     for k in keys:
         v = main[k]

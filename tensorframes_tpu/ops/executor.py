@@ -75,21 +75,54 @@ def pad_lead_dim(
     return out
 
 
+def _hoisted_for(fn, feeds: Dict[str, jnp.ndarray]):
+    """Build a :class:`HoistedProgram` (program.py — weights as runtime
+    arguments, device-committed once) at these feeds' shapes."""
+    from ..program import HoistedProgram
+
+    abstract = {
+        k: jax.ShapeDtypeStruct(np.shape(v), v.dtype) for k, v in feeds.items()
+    }
+    return HoistedProgram(fn, abstract)
+
+
 class CompiledProgram:
     """A Program plus its jitted entrypoints (block and per-row)."""
 
-    def __init__(self, program: Program):
+    def __init__(self, program: Program, hoist_consts: Optional[bool] = None):
         self.program = program
+        self.hoist = (
+            get_config().hoist_constants if hoist_consts is None else hoist_consts
+        )
         self.jit_block = jax.jit(program.fn)
         # vmapped form: maps the program over the leading axis of every
         # input — the TPU-native replacement for the reference's row loop
         # (performMapRows, DebugRowOps.scala:826-864).
         self.jit_vmap = jax.jit(jax.vmap(program.fn))
+        self._hoisted: Dict[Tuple, object] = {}
+
+    def _entry(self, kind: str, fn, feeds):
+        key = (kind,) + tuple(
+            sorted((k, np.shape(v), str(v.dtype)) for k, v in feeds.items())
+        )
+        entry = self._hoisted.get(key)
+        if entry is None:
+            try:
+                entry = _hoisted_for(fn, feeds)
+            except Exception as e:
+                # exotic programs (host callbacks, non-array consts) keep
+                # the plain closure-capture path
+                logger.debug("constant hoisting unavailable: %s", e)
+                entry = False
+            self._hoisted[key] = entry
+        return entry
 
     def run_block(
         self, feeds: Dict[str, np.ndarray], to_numpy: bool = True
     ) -> Dict[str, np.ndarray]:
-        out = self.jit_block({k: jnp.asarray(v) for k, v in feeds.items()})
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        entry = self._entry("block", self.program.fn, feeds) if self.hoist else None
+        out = entry(feeds) if entry else self.jit_block(feeds)
         if not to_numpy:
             return out  # stay in HBM: sharded frames chain without transfers
         return {k: np.asarray(v) for k, v in out.items()}
@@ -97,7 +130,13 @@ class CompiledProgram:
     def run_rows(
         self, feeds: Dict[str, np.ndarray], to_numpy: bool = True
     ) -> Dict[str, np.ndarray]:
-        out = self.jit_vmap({k: jnp.asarray(v) for k, v in feeds.items()})
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        entry = (
+            self._entry("vmap", jax.vmap(self.program.fn), feeds)
+            if self.hoist
+            else None
+        )
+        out = entry(feeds) if entry else self.jit_vmap(feeds)
         if not to_numpy:
             return out
         return {k: np.asarray(v) for k, v in out.items()}
@@ -113,7 +152,16 @@ class CompiledProgram:
             except Exception:  # pragma: no cover - jax internals moved
                 return -1
 
-        return {"block": size(self.jit_block), "vmap": size(self.jit_vmap)}
+        hoisted_block = sum(
+            1 for k, v in self._hoisted.items() if v and k[0] == "block"
+        )
+        hoisted_vmap = sum(
+            1 for k, v in self._hoisted.items() if v and k[0] == "vmap"
+        )
+        return {
+            "block": size(self.jit_block) + hoisted_block,
+            "vmap": size(self.jit_vmap) + hoisted_vmap,
+        }
 
 
 def gather_feeds(

@@ -92,36 +92,52 @@ def frame_from_process_local(data, mesh=None, axis: Optional[str] = None):
     from ..config import get_config
     from ..frame import TensorFrame
     from ..schema import ColumnInfo, Schema
-    from ..shape import Shape
+    from ..shape import Shape, Unknown
     from .mesh import batch_sharding, make_mesh
 
     mesh = mesh or make_mesh()
     axis = axis or get_config().batch_axis
     block = {}
+    host_block = {}
     infos = []
+    host_infos = []
     n_local = None
     for name, v in data.items():
-        v = np.asarray(v)
-        dtype = dt.from_numpy(v.dtype)
-        if not dtype.device:
-            raise TypeError(
-                f"Column {name!r}: host-only {dtype.name} columns cannot "
-                "span processes"
-            )
+        arr_np = np.asarray(v)
+        dtype = dt.from_numpy(arr_np.dtype)
         if n_local is None:
             n_local = len(v)
         elif len(v) != n_local:
             raise ValueError(
                 f"Column {name!r} has {len(v)} rows, expected {n_local}"
             )
+        if not dtype.device:
+            # host-only columns (strings, …) stay PROCESS-LOCAL: each
+            # process sees only its own rows. Usable as aggregate keys
+            # (the dictionary plan merges per-process dictionaries with a
+            # collective, ops/device_agg.py); a host gather of the global
+            # column is impossible by construction, and column_values
+            # raises the spans-processes error for them.
+            host_block[name] = list(v)
+            host_infos.append(ColumnInfo(name, dtype, Shape((Unknown,))))
+            continue
         arr = jax.make_array_from_process_local_data(
-            batch_sharding(mesh, v.ndim, axis), v
+            batch_sharding(mesh, arr_np.ndim, axis), arr_np
         )
         block[name] = arr
         infos.append(
             ColumnInfo(name, dtype, Shape(arr.shape).with_leading_unknown())
         )
-    frame = TensorFrame([block], Schema(infos))
+    if not block:
+        raise ValueError(
+            "frame_from_process_local needs at least one device column "
+            "(host-only columns cannot define the global row count)"
+        )
+    # device columns FIRST: the frame's row count reads the first column,
+    # which must be a global array (host columns hold local rows only)
+    block.update(host_block)
+    frame = TensorFrame([block], Schema(infos + host_infos))
     frame._mesh = mesh
     frame._axis = axis
+    frame._process_local_cols = frozenset(host_block)
     return frame

@@ -35,6 +35,7 @@ class SpanStats:
     seconds: float = 0.0
     rows: int = 0
     flops: float = 0.0  # model FLOPs executed under this span (if known)
+    bytes: float = 0.0  # XLA-cost-model bytes accessed (if known)
 
     @property
     def rows_per_sec(self) -> float:
@@ -43,6 +44,10 @@ class SpanStats:
     @property
     def flops_per_sec(self) -> float:
         return self.flops / self.seconds if self.seconds > 0 else 0.0
+
+    @property
+    def bytes_per_sec(self) -> float:
+        return self.bytes / self.seconds if self.seconds > 0 else 0.0
 
 
 _lock = threading.Lock()
@@ -64,17 +69,25 @@ def span(name: str, rows: int = 0) -> Iterator[None]:
             s.rows += rows
 
 
-def record(name: str, seconds: float, rows: int = 0, flops: float = 0.0) -> None:
+def record(
+    name: str,
+    seconds: float,
+    rows: int = 0,
+    flops: float = 0.0,
+    bytes: float = 0.0,
+) -> None:
     """Directly accumulate one measurement (for code that times itself).
-    ``flops`` lets callers attach a model-FLOP count (e.g. from
-    ``Program.flops_per_row``) so :func:`report` can print achieved
-    FLOP/s and — when ``config.peak_flops`` is set — MFU."""
+    ``flops``/``bytes`` let callers attach XLA cost-model counts (e.g.
+    from ``Program.flops_per_row``/``bytes_per_row``) so :func:`report`
+    can print achieved FLOP/s, HBM GB/s, and — when ``config.peak_flops``
+    is set — MFU."""
     with _lock:
         s = _stats.setdefault(name, SpanStats())
         s.calls += 1
         s.seconds += seconds
         s.rows += rows
         s.flops += flops
+        s.bytes += bytes
 
 
 def metrics() -> Dict[str, SpanStats]:
@@ -100,10 +113,14 @@ def report() -> str:
         return "no spans recorded"
     peak = float(getattr(get_config(), "peak_flops", 0.0) or 0.0)
     any_flops = any(s.flops for s in snap.values())
+    any_bytes = any(s.bytes for s in snap.values())
     name_w = max(len(k) for k in snap) + 2
     hdr = f"{'span':<{name_w}}{'calls':>7}{'seconds':>12}{'rows':>12}{'rows/s':>14}"
     if any_flops:
         hdr += f"{'GFLOP/s':>12}" + (f"{'MFU%':>8}" if peak else "")
+    if any_bytes:
+        hdr += f"{'GB/s':>10}"
+
     lines = [hdr]
     for name in sorted(snap):
         s = snap[name]
@@ -120,6 +137,10 @@ def report() -> str:
                     if s.flops
                     else f"{'-':>8}"
                 )
+        if any_bytes:
+            line += (
+                f"{s.bytes_per_sec / 1e9:>10,.1f}" if s.bytes else f"{'-':>10}"
+            )
         lines.append(line)
     return "\n".join(lines)
 

@@ -83,6 +83,23 @@ for p in range(NPROC):
     want[p] = want.get(p, 0.0) + 10.0 * p + 1.0
     want[p + 1] = want.get(p + 1, 0.0) + 10.0 * p + 2.0
 assert got == want, (got, want)
+# STRING keys across processes (VERDICT r2 #4): host-only key columns are
+# process-local; the dictionary plan unions the per-process dictionaries
+# with one allgather and reduces through the same segment plan — no
+# process ever gathers another's raw keys
+skf = frame_from_process_local(
+    {{"k": ["shared", "p%d" % pid], "v": local}}, mesh=mesh, axis="dp"
+)
+with tfs.with_graph():
+    v_input = tfs.block(skf, "v", tf_name="v_input")
+    sagg = tfs.aggregate(
+        tfs.reduce_sum(v_input, axis=0, name="v"), skf.group_by("k")
+    )
+sgot = {{str(r["k"]): r["v"] for r in sagg.collect()}}
+swant = {{"shared": float(sum(10.0 * p + 1.0 for p in range(NPROC)))}}
+for p in range(NPROC):
+    swant["p%d" % p] = 10.0 * p + 2.0
+assert sgot == swant, (sgot, swant)
 # sharded persistence: each process writes its part, reloads, and the
 # reassembled global frame reduces to the same total across hosts
 sf_dir = {sf_dir!r}

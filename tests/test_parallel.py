@@ -236,8 +236,30 @@ def test_frame_from_process_local_single_process():
             {"a": np.arange(16, dtype=np.float32), "b": np.arange(8.0)},
             mesh=mesh,
         )
-    with pytest.raises(TypeError, match="host-only"):
+    # host-only columns are accepted PROCESS-LOCAL since round 3 (string
+    # aggregate keys across processes) — but cannot define the global row
+    # count on their own
+    with pytest.raises(ValueError, match="at least one device column"):
         frame_from_process_local({"s": np.array(["x", "y"])}, mesh=mesh)
+    fr2 = frame_from_process_local(
+        {"v": np.arange(16, dtype=np.float32),
+         "s": [f"g{i % 2}" for i in range(16)]},
+        mesh=mesh, axis="dp",
+    )
+    assert fr2.num_rows == 16
+    # single process: local rows ARE the global rows, so materializing
+    # the host column is fine
+    assert list(fr2.column_values("s")) == [f"g{i % 2}" for i in range(16)]
+    with tfs.with_graph():
+        v_input = tfs.block(fr2, "v", tf_name="v_input")
+        agg = tfs.aggregate(
+            tfs.reduce_sum(v_input, axis=0, name="v"), fr2.group_by("s")
+        )
+    got = {str(r["s"]): r["v"] for r in agg.collect()}
+    assert got == {
+        "g0": float(sum(range(0, 16, 2))),
+        "g1": float(sum(range(1, 16, 2))),
+    }
 
 
 def test_sharded_reduce_rows_on_device():

@@ -148,3 +148,63 @@ def test_quantized_conv_models_close():
         cos = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
         assert cos > 0.98, (mod.__name__, cos)
         assert qt.tree_nbytes(qparams) < 0.5 * qt.tree_nbytes(params)
+
+
+def test_int8_frozen_weights_survive_to_executable():
+    """VERDICT r2 #7: make the int8 claim a NUMBER before TPU validates
+    it. Round 3 found the serious bug hiding here: with weights embedded
+    as HLO literals, XLA CONSTANT-FOLDED the dequantize back into a full
+    f32 weight — the quantized program had byte-identical cost to f32,
+    i.e. int8 did nothing. The fix is two-part: (a) the executor hoists
+    program constants to runtime arguments (config.hoist_constants), and
+    (b) MatMul/Conv consume QuantizedTensor natively — int8 enters the
+    contraction, the per-channel scale multiplies the output, no f32
+    weight is ever materialized.
+
+    This test pins the structural facts any backend must preserve:
+    the int8 weight reaches the compiled executable as ``s8`` (not
+    folded), the program's hoisted parameter bytes are ~4x smaller, and
+    the numerics hold. (The HBM *traffic* number is a TPU measurement —
+    the CPU backend materializes the convert regardless; see BASELINE.md
+    TPU checklist.)"""
+    import numpy as np
+    import jax
+
+    from tensorframes_tpu.graphdef import GraphNode, _Attr, program_from_graphdef
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((512, 512)).astype(np.float32)
+
+    def build(quant):
+        dtype_a = _Attr()
+        dtype_a.type = 1
+        shape_a = _Attr()
+        shape_a.shape = [-1, 512]
+        val_a = _Attr()
+        val_a.tensor = w
+        nodes = [
+            GraphNode("x", "Placeholder", [], {"dtype": dtype_a, "shape": shape_a}),
+            GraphNode("w", "Const", [], {"value": val_a}),
+            GraphNode("m", "MatMul", ["x", "w"], {}),
+        ]
+        return program_from_graphdef(nodes, fetches=["m"], quantize_weights=quant)
+
+    def hoisted_compile(prog):
+        from tensorframes_tpu.program import HoistedProgram
+
+        hp = HoistedProgram(
+            prog.fn, {"x": jax.ShapeDtypeStruct((8, 512), np.float32)}
+        )
+        return hp.aot_compile().as_text(), hp.const_bytes()
+
+    hlo_f32, bytes_f32 = hoisted_compile(build(False))
+    hlo_q, bytes_q = hoisted_compile(build(True))
+    assert "s8[512,512]" in hlo_q, "int8 weight was folded out of the HLO"
+    assert "s8[" not in hlo_f32
+    # 1 MiB f32 weight vs 256 KiB int8 + 2 KiB f32 scales ≈ 4.0x
+    assert bytes_f32 > 3.9 * bytes_q, (bytes_f32, bytes_q)
+    # and the programs still agree numerically
+    x = rng.standard_normal((4, 512)).astype(np.float32)
+    got_q = np.asarray(build(True).fn({"x": x})["m"])
+    want = x @ w
+    np.testing.assert_allclose(got_q, want, rtol=0.05, atol=0.05 * np.abs(want).max())
