@@ -147,6 +147,19 @@ def gather_column(
     return np.frombuffer(buf, dtype=dtype)
 
 
+def dict_encode(values) -> Optional[tuple]:
+    """One native hash pass over arbitrary hashable cells: returns
+    ``(codes int32 ndarray, uniques list)`` with codes in FIRST-APPEARANCE
+    order (caller remaps to lexicographic). None when unavailable."""
+    mod = _load()
+    if mod is None:
+        return None
+    buf, uniques = mod.dict_encode(
+        values if isinstance(values, (list, tuple)) else list(values)
+    )
+    return np.frombuffer(buf, dtype=np.int32), uniques
+
+
 def columns_to_rows(
     names: Sequence[str], arrays: Sequence[np.ndarray]
 ) -> Optional[List[Dict[str, object]]]:
