@@ -38,6 +38,16 @@ logger = get_logger(__name__)
 NEG_INF = -1e30
 
 
+def _enable_x64_ctx():
+    """The x64 context manager moved from ``jax.experimental.enable_x64``
+    to ``jax.enable_x64`` (jax >= 0.9); support both spellings."""
+    try:
+        from jax.experimental import enable_x64  # jax < 0.9
+    except ImportError:
+        enable_x64 = jax.enable_x64
+    return enable_x64
+
+
 def _online_block(
     q: jnp.ndarray,  # [b, h, sq, d] (pre-scaled)
     k: jnp.ndarray,  # [b, h, sk, d]
@@ -125,7 +135,7 @@ def flash_attention(
     """TPU pallas flash kernel when available, else blockwise fallback."""
     if jax.default_backend() in ("tpu", "axon") and _pallas_flash_usable():
         try:
-            from jax.experimental import enable_x64
+            enable_x64 = _enable_x64_ctx()
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 flash_attention as pallas_flash,
             )
@@ -158,7 +168,7 @@ def _pallas_flash_usable() -> bool:
     it fails (the same self-healing contract as the segment kernel's
     kill-switch, ops/segment.py)."""
     try:
-        from jax.experimental import enable_x64
+        enable_x64 = _enable_x64_ctx()
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as pallas_flash,
         )

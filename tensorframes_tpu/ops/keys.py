@@ -97,8 +97,9 @@ def _unique_inverse(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         if a.dtype == object:
             # Catalyst's grouping convention: NaN keys compare EQUAL
             # (one group). Canonicalize float-NaN cells to one singleton
-            # so the hash pass AND the numpy fallback agree — otherwise
-            # grouping semantics would depend on whether the optional
+            # so every downstream encode (native hash or python dict —
+            # both resolve the singleton by identity) sees one key;
+            # grouping semantics must not depend on whether the optional
             # native build succeeded (and could diverge across hosts)
             mask = a != a  # elementwise: only NaN cells are != themselves
             if np.any(mask):
@@ -106,32 +107,53 @@ def _unique_inverse(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
                 a[mask] = math.nan
         from .. import native
 
-        enc = native.dict_encode(a.tolist())
+        cells = a.tolist()
+        enc = native.dict_encode(cells)
         if enc is not None:
             codes, uniques = enc
-            k = len(uniques)
-            uniq_arr = np.empty(k, dtype=object)
-            uniq_arr[:] = uniques
-            try:
-                order = np.argsort(uniq_arr, kind="stable")
-            except TypeError:
-                # mixed-type keys (e.g. NaN float among strings) have no
-                # '<' order; fall back to a deterministic total order by
-                # (type name, repr) — np.unique would just raise here
-                order = np.asarray(
-                    sorted(
-                        range(k),
-                        key=lambda i: (
-                            type(uniques[i]).__name__, repr(uniques[i])
-                        ),
+        elif a.dtype != object:
+            # U/S fixed-width strings have no NaN/mixed-type hazards —
+            # numpy's sort-based unique is semantically identical and
+            # far faster than a python loop
+            return np.unique(a, return_inverse=True)
+        else:
+            # pure-python first-appearance encode with IDENTICAL
+            # semantics to the native hash pass (np.unique is no
+            # substitute here: object-dtype unique compares by == so
+            # NaNs never collapse, and mixed-type keys raise on '<')
+            table: Dict[object, int] = {}
+            codes = np.empty(len(cells), np.int64)
+            uniques = []
+            for i, v in enumerate(cells):
+                code = table.get(v)
+                if code is None:
+                    code = len(uniques)
+                    table[v] = code
+                    uniques.append(v)
+                codes[i] = code
+        k = len(uniques)
+        uniq_arr = np.empty(k, dtype=object)
+        uniq_arr[:] = uniques
+        try:
+            order = np.argsort(uniq_arr, kind="stable")
+        except TypeError:
+            # mixed-type keys (e.g. NaN float among strings) have no
+            # '<' order; fall back to a deterministic total order by
+            # (type name, repr) — np.unique would just raise here
+            order = np.asarray(
+                sorted(
+                    range(k),
+                    key=lambda i: (
+                        type(uniques[i]).__name__, repr(uniques[i])
                     ),
-                    np.int64,
-                )
-            rank = np.empty(k, np.int64)
-            rank[order] = np.arange(k)
-            if a.dtype != object:  # keep U/S dtype for callers
-                uniq_arr = uniq_arr.astype(a.dtype)
-            return uniq_arr[order], rank[codes]
+                ),
+                np.int64,
+            )
+        rank = np.empty(k, np.int64)
+        rank[order] = np.arange(k)
+        if a.dtype != object:  # keep U/S dtype for callers
+            uniq_arr = uniq_arr.astype(a.dtype)
+        return uniq_arr[order], rank[codes]
     return np.unique(a, return_inverse=True)
 
 

@@ -357,3 +357,74 @@ def test_load_saved_model_quantize_weights(tmp_path):
     want = model(x, training=False).numpy()
     # int8 per-channel quantization: close, not bit-equal
     np.testing.assert_allclose(got, want, rtol=0.1, atol=0.1)
+
+
+def test_quantized_import_shrinks_bytes_accessed(tmp_path):
+    """VERDICT r2 #7: the int8 story as a NUMBER before TPU counters can
+    validate it — XLA's cost model must report substantially fewer bytes
+    accessed for the int8-quantized import of a weight-dominated model
+    (weights are ~all the traffic at a tiny probe batch; int8 storage is
+    4x smaller, and the dequantize fuses into the matmul).
+
+    The probe runs in a clean-env subprocess: under this suite's
+    in-process platform override (``jax.config.update("jax_platforms",
+    "cpu")``, conftest.py) the bundled jax build's CPU compiler stops
+    fusing the all-constant dequantize into the matmul, so the quantized
+    program's cost-model bytes INFLATE (s8 read + materialized f32
+    write/read) — an artifact of the override, not of the import. A
+    plain ``JAX_PLATFORMS=cpu`` interpreter shows the real profile; the
+    same probe on the TPU backend is emitted by bench.py's ``# int8 |``
+    row."""
+    import os
+    import subprocess
+    import sys
+
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(21)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((512,)),
+            tf.keras.layers.Dense(2048, activation="relu"),
+            tf.keras.layers.Dense(2048, activation="relu"),
+            tf.keras.layers.Dense(512),
+        ]
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, 512], tf.float32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+    p = tmp_path / "dense.pb"
+    p.write_bytes(data)
+
+    probe = (
+        "import tensorframes_tpu as tfs\n"
+        f"full = tfs.load_graphdef({str(p)!r}, relax_lead_dim=True)\n"
+        f"quant = tfs.load_graphdef({str(p)!r}, relax_lead_dim=True,"
+        " quantize_weights=True)\n"
+        "print('BYTES', full.total_bytes_accessed(probe=2),"
+        " quant.total_bytes_accessed(probe=2))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], env=env, capture_output=True,
+        text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"bytes probe subprocess failed (rc={proc.returncode}):\n"
+        f"{proc.stderr[-3000:]}"
+    )
+    out = proc.stdout
+    line = [ln for ln in out.splitlines() if ln.startswith("BYTES")][0]
+    bf, bq = (float(v) for v in line.split()[1:])
+    assert bf > 0 and bq > 0
+    # claimed ~4x; in practice >4x (the fused int8 program also skips
+    # the f32 weights' own read-back) — assert >=3x for cost-model slack
+    assert bf / bq >= 3.0, f"f32={bf:.0f}B int8={bq:.0f}B ratio={bf/bq:.2f}"
