@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import time
+from typing import Sequence
 
 import numpy as np
 
@@ -101,9 +102,12 @@ def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
 
 
 def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0,
-                     int8: bool = False):
+                     int8: bool = False, sweep: Sequence[int] = ()):
     """Inception-v3 batch inference via map_blocks (BASELINE config 4) —
-    the headline metric named in BASELINE.json."""
+    the headline metric named in BASELINE.json. ``sweep`` (TPU runs)
+    times additional per-call batch sizes at 1 iter each and reports
+    them as ``# sweep |`` rows; the headline batch keeps full iters so
+    the published number is both the tuned-batch AND reproducible."""
     import tensorframes_tpu as tfs
     from tensorframes_tpu.models import inception as inc
 
@@ -111,19 +115,50 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
     params = inc.init_params(cfg, seed=0)
     if int8:
         params = inc.quantize_params(params)
-    images = inc.synthetic_images(cfg, n_rows, seed=0)
-    frame = tfs.frame_from_arrays({"images": images}, num_blocks=1).to_device()
     prog = inc.scoring_program(cfg, params)
-    program = tfs.compile_program(lambda images: prog(images), frame)
 
-    def run_once():
-        out = tfs.map_blocks(program, frame)
-        [b] = out.blocks()
-        _sync(b["label"])
+    def time_batch(rows: int, n_iters: int):
+        images = inc.synthetic_images(cfg, rows, seed=0)
+        frame = tfs.frame_from_arrays(
+            {"images": images}, num_blocks=1
+        ).to_device()
+        program = tfs.compile_program(lambda images: prog(images), frame)
 
-    rps = _time_rows_per_sec(run_once, n_rows, iters)
+        def run_once():
+            out = tfs.map_blocks(program, frame)
+            [b] = out.blocks()
+            _sync(b["label"])
+
+        rps = _time_rows_per_sec(run_once, rows, n_iters)
+        return rps, program
+
+    best_rows, best_rps = n_rows, None
+    for rows in sweep:
+        if rows == n_rows:
+            continue
+        srps, _ = time_batch(rows, 1)
+        print(f"# sweep | inception_v3 batch={rows} rows_per_sec={srps:.1f}")
+        if best_rps is None or srps > best_rps:
+            best_rows, best_rps = rows, srps
+
+    final_rows = n_rows
+    rps, program = time_batch(n_rows, iters)
+    if sweep:
+        print(f"# sweep | inception_v3 batch={n_rows} rows_per_sec={rps:.1f}")
+    if best_rps is not None and best_rps > rps:
+        # a swept batch beat the default: re-time it at full iters and
+        # publish that as the headline (batch size is a legitimate
+        # serving knob; the sweep rows record the whole curve)
+        final_rows = best_rows
+        rps, program = time_batch(best_rows, iters)
+        print(
+            f"# sweep | inception_v3 headline batch={final_rows} "
+            f"rows_per_sec={rps:.1f}"
+        )
+
     _record_mfu(
-        f"bench.inception_v3{'_int8' if int8 else ''}", program, rps, n_rows
+        f"bench.inception_v3{'_int8' if int8 else ''}", program, rps,
+        final_rows,
     )
     return rps
 
@@ -156,7 +191,8 @@ def _frozen_inception_bytes(side: int) -> bytes:
 
 
 def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
-                            side: int = 299, int8: bool = False):
+                            side: int = 299, int8: bool = False,
+                            compute_dtype=None):
     """BASELINE config 4 in its literal form: a frozen TF GraphDef of
     Inception-v3 scored over an image frame — decoded by the bundled
     clean-room importer, lowered to jax, executed via map_blocks.
@@ -167,7 +203,8 @@ def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
 
     data = _frozen_inception_bytes(side)
     prog = program_from_graphdef(
-        parse_graphdef(data), relax_lead_dim=True, quantize_weights=int8
+        parse_graphdef(data), relax_lead_dim=True, quantize_weights=int8,
+        compute_dtype=compute_dtype,
     )
     [inp] = prog.inputs
     rng = np.random.default_rng(0)
@@ -181,21 +218,25 @@ def _bench_inception_frozen(n_rows: int = 64, iters: int = 3,
         _sync(b[prog.fetch_order[0]])
 
     rps = _time_rows_per_sec(run_once, n_rows, iters)
+    variant = ("_int8" if int8 else "") + ("_bf16" if compute_dtype else "")
     _record_mfu(
-        f"bench.inception_v3_frozen{'_int8' if int8 else ''}",
+        f"bench.inception_v3_frozen{variant}",
         program, rps, n_rows,
     )
-    try:
+    if compute_dtype is None:
         # XLA-cost-model absolute traffic: the number that makes the int8
         # weight-quantization claim checkable without hardware counters
-        # (VERDICT r2 #7) — weights dominate at this tiny probe batch
-        _FROZEN_BYTES["int8" if int8 else "f32"] = (
-            program.total_bytes_accessed(probe=8)
-        )
-    except Exception as e:
-        print(
-            f"# {'int8' if int8 else 'f32'} bytes accounting unavailable: {e}"
-        )
+        # (VERDICT r2 #7) — weights dominate at this tiny probe batch.
+        # (bf16-variant runs must not clobber the f32 entry.)
+        try:
+            _FROZEN_BYTES["int8" if int8 else "f32"] = (
+                program.total_bytes_accessed(probe=8)
+            )
+        except Exception as e:
+            print(
+                f"# {'int8' if int8 else 'f32'} bytes accounting "
+                f"unavailable: {e}"
+            )
     return rps
 
 
@@ -286,10 +327,15 @@ def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
         params = tr.quantize_params(params)
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
-    fn = jax.jit(lambda p: gen.generate(cfg, params, p, new))
+    # params as runtime ARGUMENTS, not closure constants: closure capture
+    # embeds the full weight tree in the HLO payload (gpt-small f32 is
+    # ~0.5 GB of literals — it crashed the remote-compile relay; it also
+    # bloats any AOT artifact), device_put once and pass through
+    d_params = jax.device_put(params)
+    fn = jax.jit(lambda prms, p: gen.generate(cfg, prms, p, new))
 
     def run_once():
-        _sync(fn(prompts))
+        _sync(fn(d_params, prompts))
 
     return _time_rows_per_sec(run_once, batch * new, iters)
 
@@ -445,19 +491,27 @@ def _bench_compile_fullscale():
     from tensorframes_tpu.models import inception as inc
     from tensorframes_tpu.models import transformer as tr
 
+    from tensorframes_tpu.program import HoistedProgram
+
+    # HoistedProgram lifts the weight trees to runtime arguments — the
+    # same path the verbs execute through, and the only way BERT-base's
+    # 440 MB of weights fit through a remote-compile relay (closure
+    # capture would embed them as HLO literals)
     out = {}
     cfg = inc.inception_v3(channel_scale=1.0)
     prog = inc.scoring_program(cfg, inc.init_params(cfg, seed=0))
     x = jax.ShapeDtypeStruct((8, 299, 299, 3), np.float32)
     t0 = time.perf_counter()
-    jax.jit(lambda im: prog(im)).lower(x).compile()
+    HoistedProgram(lambda d: prog(d["images"]), {"images": x}).aot_compile()
     out["inception299_fullwidth_compile_s"] = round(time.perf_counter() - t0, 1)
 
     cfg_b = tr.bert_base()
     rowprog = tr.embed_row_program(cfg_b, tr.init_params(cfg_b, seed=0))
     tok = jax.ShapeDtypeStruct((16, 128), np.int32)
     t0 = time.perf_counter()
-    jax.jit(jax.vmap(lambda t: rowprog(t))).lower(tok).compile()
+    HoistedProgram(
+        lambda d: jax.vmap(rowprog)(d["tokens"]), {"tokens": tok}
+    ).aot_compile()
     out["bert_base_compile_s"] = round(time.perf_counter() - t0, 1)
     return out
 
@@ -560,6 +614,9 @@ def main():
             n_rows=512 if on_tpu else 16,
             iters=4 if on_tpu else 1,
             channel_scale=1.0 if on_tpu else 0.125,
+            # batch sweep (TPU only): one timing each at the alternate
+            # per-call batches; headline re-times the winner at full iters
+            sweep=(128, 1024) if on_tpu else (),
         ),
         0.0,
         metric_keys=("inception_v3_map_blocks_rows_per_sec",),
@@ -578,7 +635,9 @@ def main():
     inception_frozen_rps = _try(
         "inception_frozen",
         lambda: _bench_inception_frozen(
-            n_rows=64 if on_tpu else 8,
+            # 256 rows/call: the r3 TPU run showed batch 64 leaving the
+            # MXU ~5x under-fed next to the native model's 512-row calls
+            n_rows=256 if on_tpu else 8,
             iters=3 if on_tpu else 1,
             side=299 if on_tpu else 75,
         ),
@@ -588,13 +647,24 @@ def main():
     inception_frozen_rps_q = _try(
         "inception_frozen_int8",
         lambda: _bench_inception_frozen(
-            n_rows=64 if on_tpu else 8,
+            n_rows=256 if on_tpu else 8,
             iters=3 if on_tpu else 1,
             side=299 if on_tpu else 75,
             int8=True,
         ),
         0.0,
         metric_keys=("inception_v3_frozen_int8_graphdef_rows_per_sec",),
+    )
+    inception_frozen_rps_bf16 = _try(
+        "inception_frozen_bf16",
+        lambda: _bench_inception_frozen(
+            n_rows=256 if on_tpu else 8,
+            iters=3 if on_tpu else 1,
+            side=299 if on_tpu else 75,
+            compute_dtype="bfloat16",
+        ),
+        0.0,
+        metric_keys=("inception_v3_frozen_bf16_graphdef_rows_per_sec",),
     )
     if "f32" in _FROZEN_BYTES and "int8" in _FROZEN_BYTES:
         bf, bq = _FROZEN_BYTES["f32"], _FROZEN_BYTES["int8"]
@@ -674,6 +744,9 @@ def main():
         "inception_v3_frozen_graphdef_rows_per_sec": round(inception_frozen_rps),
         "inception_v3_frozen_int8_graphdef_rows_per_sec": round(
             inception_frozen_rps_q
+        ),
+        "inception_v3_frozen_bf16_graphdef_rows_per_sec": round(
+            inception_frozen_rps_bf16
         ),
         f"bert_{'base' if on_tpu else 'tiny'}_map_rows_rows_per_sec": round(
             bert_rps

@@ -471,9 +471,11 @@ def _pad_str(n: "GraphNode") -> str:
     return pad
 
 
-def _conv2d(n: "GraphNode", x, w):
+def _conv2d(n: "GraphNode", x, w, preferred=None):
     """Conv2D (NHWC, HWIO weights — TF's native layouts, which are also
-    the TPU-friendly ones)."""
+    the TPU-friendly ones). ``preferred`` sets the accumulation dtype
+    (f32 under a reduced-precision compute policy); None keeps the
+    operands' own dtype — f64/bf16 graphs stay faithful."""
     _nhwc(n)
     strides = (n.attrs["strides"].ints or [1, 1, 1, 1])[1:3]
     dil = n.attrs.get("dilations")
@@ -485,10 +487,11 @@ def _conv2d(n: "GraphNode", x, w):
         padding=_pad_str(n),
         rhs_dilation=rhs_dilation,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=preferred,
     )
 
 
-def _depthwise_conv2d(n: "GraphNode", x, w):
+def _depthwise_conv2d(n: "GraphNode", x, w, preferred=None):
     """DepthwiseConv2dNative: [H,W,C,M] filter → grouped conv with
     feature_group_count=C and an [H,W,1,C*M] kernel."""
     _nhwc(n)
@@ -504,6 +507,7 @@ def _depthwise_conv2d(n: "GraphNode", x, w):
         rhs_dilation=rhs_dilation,
         feature_group_count=c,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=preferred,
     )
 
 
@@ -576,6 +580,7 @@ def program_from_graphdef(
     fetches: Optional[Sequence[str]] = None,
     relax_lead_dim: bool = False,
     quantize_weights: bool = False,
+    compute_dtype: Optional[str] = None,
 ) -> Program:
     """Lower decoded GraphDef nodes to a :class:`Program`.
 
@@ -588,6 +593,12 @@ def program_from_graphdef(
     stores float Const filters feeding Conv2D/depthwise/MatMul as
     symmetric per-channel int8 (ops/quantize.py — 4× less weight HBM
     traffic; XLA fuses the dequantize into the consuming conv/matmul).
+
+    ``compute_dtype`` (e.g. ``"bfloat16"``) is a serving-precision
+    policy for the MXU ops only: MatMul/Conv2D/depthwise contract in
+    that dtype with float32 accumulation (``preferred_element_type``),
+    all other ops stay exact — the idiomatic TPU inference mode (the
+    imported graph is f32-faithful by default).
     """
     by_name = {n.name: n for n in nodes}
     consumed = set()
@@ -766,7 +777,10 @@ def program_from_graphdef(
                         expanded.add(nm)
                         stack.extend(pending)
                         continue
-                    values[nm] = _eval_node(node, [values[d] for d in deps])
+                    values[nm] = _eval_node(
+                        node, [values[d] for d in deps],
+                        compute_dtype=compute_dtype,
+                    )
                 stack.pop()
             return values[target]
 
@@ -784,7 +798,7 @@ def program_from_graphdef(
     return Program(fn, inputs, fetch_order=fetch_list)
 
 
-def _eval_node(n: GraphNode, args: List):
+def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
     """Evaluate one non-structural node given its already-evaluated data
     inputs. Operands that shape the *program* (reduction axes, reshape
     targets, Tile multiples, pad widths, …) must be trace-time concrete —
@@ -800,12 +814,25 @@ def _eval_node(n: GraphNode, args: List):
 
     name = n.name
     op = n.op
+
+    def mxu(x):
+        """Serving-precision cast for MXU operands: f32 → compute_dtype
+        (accumulation stays f32 via preferred_element_type below)."""
+        if compute_dtype is not None and getattr(x, "dtype", None) == jnp.float32:
+            return x.astype(compute_dtype)
+        return x
+
+    # accumulation override ONLY under the reduced-precision policy;
+    # None keeps every graph (f64, native-bf16, int) exactly faithful
+    pet = jnp.float32 if compute_dtype is not None else None
+
     if op == "MatMul":
         a, b = args
         ta = n.attrs.get("transpose_a")
         tb = n.attrs.get("transpose_b")
         if isinstance(a, QuantizedTensor):
             a = a.dequantize(jnp.float32)
+        a = mxu(a)
         if ta and ta.b:
             a = a.T
         if isinstance(b, QuantizedTensor):
@@ -815,20 +842,25 @@ def _eval_node(n: GraphNode, args: List):
                 a,
                 q,
                 dimension_numbers=(((a.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=a.dtype,
+                preferred_element_type=pet if pet is not None else a.dtype,
             )
-            return out * jnp.asarray(scale, a.dtype)
+            return out * jnp.asarray(scale, out.dtype)
         if tb and tb.b:
             b = b.T
+        b = mxu(b)
+        if pet is not None:
+            return jnp.matmul(a, b, preferred_element_type=pet)
         return a @ b
     if op == "Conv2D" and isinstance(args[1], QuantizedTensor):
         x_, w_ = args
-        out = _conv2d(n, x_, w_.q.astype(x_.dtype))
-        return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), x_.dtype)
+        x_ = mxu(x_)
+        out = _conv2d(n, x_, w_.q.astype(x_.dtype), preferred=pet)
+        return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), out.dtype)
     if op == "DepthwiseConv2dNative" and isinstance(args[1], QuantizedTensor):
         x_, w_ = args
-        out = _depthwise_conv2d(n, x_, w_.q.astype(x_.dtype))
-        return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), x_.dtype)
+        x_ = mxu(x_)
+        out = _depthwise_conv2d(n, x_, w_.q.astype(x_.dtype), preferred=pet)
+        return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), out.dtype)
     args = [
         a.dequantize(jnp.float32) if isinstance(a, QuantizedTensor) else a
         for a in args
@@ -863,9 +895,9 @@ def _eval_node(n: GraphNode, args: List):
         )
         return args[0].reshape(shp)
     if op == "Conv2D":
-        return _conv2d(n, *args)
+        return _conv2d(n, mxu(args[0]), mxu(args[1]), preferred=pet)
     if op == "DepthwiseConv2dNative":
-        return _depthwise_conv2d(n, *args)
+        return _depthwise_conv2d(n, mxu(args[0]), mxu(args[1]), preferred=pet)
     if op in ("MaxPool", "AvgPool"):
         return _pool(n, args[0])
     if op == "BiasAdd":
@@ -968,6 +1000,7 @@ def load_graphdef(
     fetches: Optional[Sequence[str]] = None,
     relax_lead_dim: bool = False,
     quantize_weights: bool = False,
+    compute_dtype: Optional[str] = None,
 ) -> Program:
     """Load a frozen TF ``GraphDef`` file as an analyzed Program
     (≙ ``graphFromFile``, PythonInterface.scala:115-118 — but static:
@@ -980,6 +1013,7 @@ def load_graphdef(
         fetches=fetches,
         relax_lead_dim=relax_lead_dim,
         quantize_weights=quantize_weights,
+        compute_dtype=compute_dtype,
     )
     return analyze_program(program)
 
@@ -990,6 +1024,7 @@ def load_saved_model(
     fetches: Optional[Sequence[str]] = None,
     relax_lead_dim: bool = False,
     quantize_weights: bool = False,
+    compute_dtype: Optional[str] = None,
 ) -> Program:
     """Import a TF SavedModel signature: freeze its variables to
     constants (requires tensorflow at CONVERSION time only — scoring is
@@ -1023,5 +1058,6 @@ def load_saved_model(
         fetches=fetches,
         relax_lead_dim=relax_lead_dim,
         quantize_weights=quantize_weights,
+        compute_dtype=compute_dtype,
     )
     return analyze_program(program)

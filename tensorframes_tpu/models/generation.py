@@ -113,7 +113,7 @@ def _forward_cached(
         # attend q against the whole (static) cache, masked to valid slots
         scores = jnp.einsum(
             "bntd,bnsd->bnts", q, ck, preferred_element_type=jnp.float32
-        ) / np.sqrt(hd)
+        ) / float(np.sqrt(hd))
         scores = jnp.where(valid[None, None], scores, neg)
         w = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         ctx = jnp.einsum("bnts,bnsd->bntd", w, cv)

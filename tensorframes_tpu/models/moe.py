@@ -52,7 +52,9 @@ class MoEConfig:
 def init_moe_params(cfg: MoEConfig, seed: int = 0) -> Dict:
     k0, k1, k2 = jax.random.split(jax.random.PRNGKey(seed), 3)
     h, m, e = cfg.hidden, cfg.mlp_hidden, cfg.num_experts
-    s_in, s_out = 1.0 / np.sqrt(h), 1.0 / np.sqrt(m)
+    # python floats (weak-typed): numpy f64 scalars would promote the
+    # f32 weights to f64 under the package's global x64 mode
+    s_in, s_out = float(1.0 / np.sqrt(h)), float(1.0 / np.sqrt(m))
     return {
         "router": jax.random.normal(k0, (h, e), jnp.float32) * s_in,
         "w_in": jax.random.normal(k1, (e, h, m), jnp.float32) * s_in,

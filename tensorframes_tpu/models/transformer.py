@@ -82,7 +82,10 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict:
     h, m = cfg.hidden, cfg.mlp_hidden
 
     def dense(key, shape, scale=None):
-        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        # float(): a numpy f64 scalar would promote the f32 weights
+        # to f64 under the package's global x64 mode — f64 transformers
+        # crash/stall the TPU compiler (no native f64)
+        scale = float(scale if scale is not None else 1.0 / np.sqrt(shape[0]))
         return jax.random.normal(key, shape, jnp.float32) * scale
 
     params = {
@@ -90,24 +93,27 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict:
             "tok": dense(keys[0], (cfg.vocab_size, h), 0.02),
             "pos": dense(keys[1], (cfg.max_seq_len, h), 0.02),
         },
-        "final_ln": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+        "final_ln": {"scale": jnp.ones((h,), jnp.float32),
+                     "bias": jnp.zeros((h,), jnp.float32)},
         "layers": [],
     }
     for i in range(cfg.num_layers):
         ka, kb, kc, kd = keys[4 + 4 * i : 8 + 4 * i]
         params["layers"].append(
             {
-                "ln1": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
-                "ln2": {"scale": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+                "ln1": {"scale": jnp.ones((h,), jnp.float32),
+                        "bias": jnp.zeros((h,), jnp.float32)},
+                "ln2": {"scale": jnp.ones((h,), jnp.float32),
+                        "bias": jnp.zeros((h,), jnp.float32)},
                 "attn": {
                     "qkv": dense(ka, (h, 3 * h)),
                     "out": dense(kb, (h, h)),
                 },
                 "mlp": {
                     "in": dense(kc, (h, m)),
-                    "in_bias": jnp.zeros((m,)),
+                    "in_bias": jnp.zeros((m,), jnp.float32),
                     "out": dense(kd, (m, h)),
-                    "out_bias": jnp.zeros((h,)),
+                    "out_bias": jnp.zeros((h,), jnp.float32),
                 },
             }
         )

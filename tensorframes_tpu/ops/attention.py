@@ -94,7 +94,7 @@ def blockwise_attention(
     if pad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-    scale = 1.0 / np.sqrt(d)
+    scale = float(1.0 / np.sqrt(d))  # python float: weak-typed, no f64 promotion under x64
     qs = (q * scale).astype(q.dtype)
 
     kb = k.reshape(b, h, num_blocks, block_size, d).transpose(2, 0, 1, 3, 4)
@@ -148,7 +148,7 @@ def flash_attention(
             # segment kernel hit (see ops/segment.py)
             with enable_x64(False):
                 return pallas_flash(
-                    q, k, v, causal=causal, sm_scale=1.0 / np.sqrt(d)
+                    q, k, v, causal=causal, sm_scale=float(1.0 / np.sqrt(d))
                 )
         except Exception:
             # per-call trace-time rejections (seq not divisible by the
@@ -204,7 +204,7 @@ def _ring_attention_local(
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
-    scale = 1.0 / np.sqrt(d)
+    scale = float(1.0 / np.sqrt(d))  # weak-typed: no f64 promotion under x64
     qs = (q * scale).astype(q.dtype)
     q_pos = my * s_loc + jnp.arange(s_loc)
 
@@ -359,7 +359,8 @@ def dense_attention(
     """
     d = q.shape[-1]
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q / np.sqrt(d), k, preferred_element_type=jnp.float32
+        "bhqd,bhkd->bhqk", q / float(np.sqrt(d)), k,
+        preferred_element_type=jnp.float32,
     )
     if causal:
         sq, sk = s.shape[-2:]

@@ -343,3 +343,33 @@ def test_partial_val_fill_pads_with_last_value():
     np.testing.assert_array_equal(
         arr, np.asarray([1.0, 2.0, 2.0, 2.0], np.float32)
     )
+
+
+def test_f64_conv_graph_stays_faithful():
+    """Regression (r3 review): with no ``compute_dtype`` policy the
+    importer must keep a DT_DOUBLE conv/matmul graph exactly f64 — an
+    unconditional f32 ``preferred_element_type`` is narrower than the
+    operands and raises at trace time on this jax build."""
+    tf = pytest.importorskip("tensorflow")
+
+    from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal((3, 3, 2, 4))
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float64, [None, 8, 8, 2], name="x")
+        c = tf.constant(w, dtype=tf.float64, name="w")
+        y = tf.nn.conv2d(x, c, strides=[1, 1, 1, 1], padding="SAME", name="y")
+        m = tf.linalg.matmul(
+            tf.reshape(y, [-1, 8 * 8 * 4]),
+            tf.constant(rng.standard_normal((8 * 8 * 4, 3)), tf.float64),
+            name="out",
+        )
+    data = g.as_graph_def().SerializeToString()
+    prog = program_from_graphdef(parse_graphdef(data), fetches=["out"])
+    xv = rng.standard_normal((2, 8, 8, 2))
+    got = prog.fn({"x": xv})["out"]
+    assert got.dtype == np.float64
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run("out:0", {"x:0": xv})
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-10)
