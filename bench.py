@@ -146,11 +146,13 @@ def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1
     if sweep:
         print(f"# sweep | inception_v3 batch={n_rows} rows_per_sec={rps:.1f}")
     if best_rps is not None and best_rps > rps:
-        # a swept batch beat the default: re-time it at full iters and
-        # publish that as the headline (batch size is a legitimate
-        # serving knob; the sweep rows record the whole curve)
-        final_rows = best_rows
-        rps, program = time_batch(best_rows, iters)
+        # a swept batch beat the default at 1 iter: re-time it at full
+        # iters, but publish it only if it STILL beats the default's
+        # full-iters number (a lucky 1-iter sample must not downgrade
+        # the headline)
+        re_rps, re_program = time_batch(best_rows, iters)
+        if re_rps > rps:
+            final_rows, rps, program = best_rows, re_rps, re_program
         print(
             f"# sweep | inception_v3 headline batch={final_rows} "
             f"rows_per_sec={rps:.1f}"

@@ -16,9 +16,12 @@ the obvious neighbours (Sub/Mul/Neg/Max/Mean/Prod/Maximum/Minimum/
 MatMul/Relu/Exp/Log/Sqrt/Rsqrt/Cast/Reshape/Squeeze/Pad/Softmax), and
 the convolutional family frozen image models need (Conv2D/
 DepthwiseConv2dNative/MaxPool/AvgPool/BiasAdd/Concat[V2]/
-FusedBatchNorm[V2/V3] over NHWC) — enough that a full frozen keras
-Inception-v3 (~2200 nodes, batchnorm decomposed to Mul/Sub/Rsqrt/AddV2
-by the freezer) and TF1-era graphs with un-decomposed FusedBatchNorm
+FusedBatchNorm[V2/V3] over NHWC), and the transformer family
+(GatherV2 embeddings, Einsum/BatchMatMulV2 attention, SelectV2
+masking, LayerNorm moments, Erf/Erfc gelu) — enough that a full frozen
+keras Inception-v3 (~2200 nodes, batchnorm decomposed to
+Mul/Sub/Rsqrt/AddV2 by the freezer), TF1-era graphs with un-decomposed
+FusedBatchNorm, and a frozen keras MultiHeadAttention encoder block
 execute bit-close to TF (tests/test_graphdef_frozen.py).
 ``quantize_weights=True`` stores filters as per-channel int8. Anything
 else raises with the op name — the honest bounded-op-subset contract.
@@ -371,6 +374,15 @@ _BINARY = {
     "FloorDiv": jnp.floor_divide,
     "FloorMod": jnp.mod,
     "Pow": jnp.power,
+    "SquaredDifference": lambda a, b: jnp.square(a - b),
+    "Greater": jnp.greater,
+    "GreaterEqual": jnp.greater_equal,
+    "Less": jnp.less,
+    "LessEqual": jnp.less_equal,
+    "Equal": jnp.equal,
+    "NotEqual": jnp.not_equal,
+    "LogicalAnd": jnp.logical_and,
+    "LogicalOr": jnp.logical_or,
 }
 _UNARY = {
     "Identity": lambda x: x,
@@ -387,6 +399,13 @@ _UNARY = {
     "Sigmoid": lambda x: 1.0 / (1.0 + jnp.exp(-x)),
     "Softmax": lambda x: jnp.exp(x - x.max(-1, keepdims=True))
     / jnp.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+    "Erf": lambda x: jax.lax.erf(x),
+    "Erfc": lambda x: jax.lax.erfc(x),  # keras gelu lowers through erfc
+    "Floor": jnp.floor,
+    "Ceil": jnp.ceil,
+    "Round": jnp.round,
+    "LogicalNot": jnp.logical_not,
+    "StopGradient": lambda x: x,  # inference import: gradient-free
 }
 # reducers: name → jnp reduction
 _REDUCERS = {
@@ -406,6 +425,15 @@ _REDUCERS = {
 # shapes: `tf.shape` of a traced array is static at trace time, so the
 # whole multiples chain folds to host integers before jnp.tile sees it.
 _BINARY_NP = {
+    "SquaredDifference": lambda a, b: np.square(a - b),
+    "Greater": np.greater,
+    "GreaterEqual": np.greater_equal,
+    "Less": np.less,
+    "LessEqual": np.less_equal,
+    "Equal": np.equal,
+    "NotEqual": np.not_equal,
+    "LogicalAnd": np.logical_and,
+    "LogicalOr": np.logical_or,
     "Add": np.add,
     "AddV2": np.add,
     "Sub": np.subtract,
@@ -657,6 +685,11 @@ def program_from_graphdef(
         # folds to trace-time constants under XLA's static shapes.
         "Shape", "Pack", "Tile", "ExpandDims", "StridedSlice",
         "Fill", "Range", "ArgMin", "ArgMax",
+        # transformer tier (round 3): the op family frozen keras/TF2
+        # attention models emit (Embedding gather, einsum attention,
+        # layernorm moments, gelu's Erf, masking selects)
+        "GatherV2", "Einsum", "Transpose", "Select", "SelectV2",
+        "BatchMatMulV2", "BatchMatMul",
     )
     unsupported = sorted(
         {
@@ -822,9 +855,17 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
             return x.astype(compute_dtype)
         return x
 
-    # accumulation override ONLY under the reduced-precision policy;
-    # None keeps every graph (f64, native-bf16, int) exactly faithful
-    pet = jnp.float32 if compute_dtype is not None else None
+    def pet_for(*ops_):
+        """f32 accumulation ONLY when the policy is on AND every
+        operand is a <=32-bit float (the ones mxu() may have reduced);
+        f64/int contractions keep their exact dtype — preferred_element_
+        type must never narrow, and 'all other ops stay exact'."""
+        if compute_dtype is None:
+            return None
+        ok = (jnp.bfloat16, jnp.float16, jnp.float32)
+        if all(jnp.asarray(o).dtype in ok for o in ops_):
+            return jnp.float32
+        return None
 
     if op == "MatMul":
         a, b = args
@@ -838,28 +879,32 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
         if isinstance(b, QuantizedTensor):
             q = b.q.T if (tb and tb.b) else b.q
             scale = b.scale.T if (tb and tb.b) else b.scale
+            p = pet_for(a)
             out = jax.lax.dot_general(
                 a,
                 q,
                 dimension_numbers=(((a.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=pet if pet is not None else a.dtype,
+                preferred_element_type=p if p is not None else a.dtype,
             )
             return out * jnp.asarray(scale, out.dtype)
         if tb and tb.b:
             b = b.T
         b = mxu(b)
-        if pet is not None:
-            return jnp.matmul(a, b, preferred_element_type=pet)
+        p = pet_for(a, b)
+        if p is not None:
+            return jnp.matmul(a, b, preferred_element_type=p)
         return a @ b
     if op == "Conv2D" and isinstance(args[1], QuantizedTensor):
         x_, w_ = args
         x_ = mxu(x_)
-        out = _conv2d(n, x_, w_.q.astype(x_.dtype), preferred=pet)
+        out = _conv2d(n, x_, w_.q.astype(x_.dtype), preferred=pet_for(x_))
         return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), out.dtype)
     if op == "DepthwiseConv2dNative" and isinstance(args[1], QuantizedTensor):
         x_, w_ = args
         x_ = mxu(x_)
-        out = _depthwise_conv2d(n, x_, w_.q.astype(x_.dtype), preferred=pet)
+        out = _depthwise_conv2d(
+            n, x_, w_.q.astype(x_.dtype), preferred=pet_for(x_)
+        )
         return out * jnp.asarray(w_.scale.reshape(1, 1, 1, -1), out.dtype)
     args = [
         a.dequantize(jnp.float32) if isinstance(a, QuantizedTensor) else a
@@ -894,10 +939,54 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
             int(d) for d in _concrete_operand(n, "shape", args[1])
         )
         return args[0].reshape(shp)
+    if op == "GatherV2":
+        params_, indices, axis = args
+        bd = n.attrs.get("batch_dims")
+        if bd and bd.i:
+            raise ValueError(
+                f"GatherV2 node {name!r}: batch_dims != 0 is unsupported"
+            )
+        ax = int(np.asarray(_concrete_operand(n, "axis", axis)))
+        if _is_concrete(params_, indices):
+            return np.take(params_, np.asarray(indices), axis=ax)
+        return jnp.take(params_, jnp.asarray(indices), axis=ax)
+    if op == "Einsum":
+        eq = n.attrs["equation"].s.decode()
+        ops_ = [mxu(a) for a in args]
+        p = pet_for(*ops_)
+        if p is not None:
+            return jnp.einsum(eq, *ops_, preferred_element_type=p)
+        return jnp.einsum(eq, *ops_)
+    if op == "Transpose":
+        perm = tuple(
+            int(d) for d in np.asarray(_concrete_operand(n, "perm", args[1]))
+        )
+        return jnp.transpose(args[0], perm)
+    if op in ("Select", "SelectV2"):
+        c, xv, yv = args
+        if op == "Select" and getattr(c, "ndim", 0) == 1 and (
+            getattr(xv, "ndim", 0) > 1
+        ):
+            # v1 Select: a vector condition picks whole ROWS of x/y
+            c = c.reshape((-1,) + (1,) * (xv.ndim - 1))
+        return jnp.where(c, xv, yv)
+    if op in ("BatchMatMulV2", "BatchMatMul"):
+        a, b = (mxu(v) for v in args)
+        adj_x, adj_y = n.attrs.get("adj_x"), n.attrs.get("adj_y")
+        if adj_x and adj_x.b:
+            a = jnp.swapaxes(a, -1, -2)
+        if adj_y and adj_y.b:
+            b = jnp.swapaxes(b, -1, -2)
+        p = pet_for(a, b)
+        if p is not None:
+            return jnp.matmul(a, b, preferred_element_type=p)
+        return a @ b
     if op == "Conv2D":
-        return _conv2d(n, mxu(args[0]), mxu(args[1]), preferred=pet)
+        x_, w_ = mxu(args[0]), mxu(args[1])
+        return _conv2d(n, x_, w_, preferred=pet_for(x_, w_))
     if op == "DepthwiseConv2dNative":
-        return _depthwise_conv2d(n, mxu(args[0]), mxu(args[1]), preferred=pet)
+        x_, w_ = mxu(args[0]), mxu(args[1])
+        return _depthwise_conv2d(n, x_, w_, preferred=pet_for(x_, w_))
     if op in ("MaxPool", "AvgPool"):
         return _pool(n, args[0])
     if op == "BiasAdd":

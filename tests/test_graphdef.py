@@ -373,3 +373,12 @@ def test_f64_conv_graph_stays_faithful():
     with tf.compat.v1.Session(graph=g) as sess:
         want = sess.run("out:0", {"x:0": xv})
     np.testing.assert_allclose(np.asarray(got), want, atol=1e-10)
+
+    # the bf16 policy must leave f64 graphs untouched too (its cast and
+    # its f32-accumulation override are both f32-operand-only)
+    prog_b = program_from_graphdef(
+        parse_graphdef(data), fetches=["out"], compute_dtype="bfloat16"
+    )
+    got_b = prog_b.fn({"x": xv})["out"]
+    assert got_b.dtype == np.float64
+    np.testing.assert_allclose(np.asarray(got_b), want, atol=1e-10)

@@ -451,3 +451,53 @@ def test_compute_dtype_bf16_close_to_f32(tmp_path):
                              quantize_weights=True, compute_dtype="bfloat16")
     got2 = np.asarray(both.fn({both.inputs[0].name: x})[both.fetch_order[0]])
     np.testing.assert_allclose(got2, want, atol=2e-2, rtol=0.1)
+
+
+def test_frozen_keras_transformer_matches_tf():
+    """Transformer-family import (round 3): a frozen keras encoder block —
+    Embedding (GatherV2), MultiHeadAttention (Einsum/BatchMatMulV2/
+    SelectV2), LayerNormalization (Mean/SquaredDifference/Rsqrt), gelu
+    (Erfc) — golden-compared against TF executing the same frozen bytes.
+    The reference's "any TF program" claim (PythonInterface.scala:115-118)
+    extended past CNNs to the attention family."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(0)
+    seq, vocab, dim, heads = 16, 100, 32, 4
+    inp = tf.keras.Input((seq,), dtype=tf.int32)
+    x = tf.keras.layers.Embedding(vocab, dim)(inp)
+    att = tf.keras.layers.MultiHeadAttention(heads, dim // heads)(x, x)
+    x = tf.keras.layers.LayerNormalization()(x + att)
+    h = tf.keras.layers.Dense(dim * 2, activation="gelu")(x)
+    x = tf.keras.layers.LayerNormalization()(x + tf.keras.layers.Dense(dim)(h))
+    out = tf.keras.layers.Dense(8)(x[:, 0])
+    model = tf.keras.Model(inp, out)
+    fn = tf.function(lambda t: model(t, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, seq], tf.int32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+
+    prog = program_from_graphdef(parse_graphdef(data), relax_lead_dim=True)
+    rng = np.random.default_rng(1)
+    t = rng.integers(0, vocab, (3, seq)).astype(np.int32)
+    got = np.asarray(prog.fn({prog.inputs[0].name: t})[prog.fetch_order[0]])
+
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(data)
+    with tf.Graph().as_default() as g:
+        tf.import_graph_def(gd, name="")
+        with tf.compat.v1.Session(graph=g) as sess:
+            want = sess.run(
+                f"{prog.fetch_order[0]}:0", {f"{prog.inputs[0].name}:0": t}
+            )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # the bf16 serving policy reaches einsum/batched-matmul attention too
+    p2 = program_from_graphdef(
+        parse_graphdef(data), relax_lead_dim=True, compute_dtype="bfloat16"
+    )
+    got2 = np.asarray(p2.fn({p2.inputs[0].name: t})[p2.fetch_order[0]])
+    assert got2.dtype == np.float32
+    np.testing.assert_allclose(got2, want, atol=5e-2, rtol=5e-2)
