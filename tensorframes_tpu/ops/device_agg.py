@@ -182,6 +182,36 @@ def _allgather_dicts(local_cols: List[np.ndarray]) -> Tuple[List[np.ndarray], in
     return union, offset
 
 
+def extract_local_rows(v):
+    """This process's rows of one frame column: host lists are already
+    process-local; sharded device arrays concatenate their addressable
+    shards in global-index order. Returns None when no shard is
+    addressable (caller must treat as ineligible). Shared by the
+    dictionary plan and the generic multiprocess aggregate (verbs.py)."""
+    if isinstance(v, list):
+        return np.asarray(v, dtype=object)
+    if isinstance(v, np.ndarray):
+        return v
+    shards = sorted(
+        v.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    if not shards:
+        return None
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def uniform_ok(ok: bool) -> bool:
+    """Collective eligibility vote: every process must take the same
+    branch BEFORE any further collective — one process falling back to a
+    host path while the rest allgather would deadlock both groups."""
+    from jax.experimental import multihost_utils as mh
+
+    all_ok = np.asarray(
+        mh.process_allgather(np.asarray([1 if ok else 0], np.int32))
+    )
+    return bool(int(all_ok.min()))
+
+
 def _aggregate_multiprocess_dict(
     frame, keys, ops, out_names, main, feat, axis
 ) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]]:
@@ -196,32 +226,17 @@ def _aggregate_multiprocess_dict(
     key_local: List[np.ndarray] = []
     ok = True
     for k in keys:
-        v = main[k]
-        if isinstance(v, list):
-            key_local.append(np.asarray(v, dtype=object))
-        else:
-            shards = sorted(
-                v.addressable_shards, key=lambda s: s.index[0].start or 0
-            )
-            if not shards:
-                ok = False
-                break
-            key_local.append(
-                np.concatenate([np.asarray(s.data) for s in shards])
-            )
+        v = extract_local_rows(main[k])
+        if v is None:
+            ok = False
+            break
+        key_local.append(v)
     n_local = len(key_local[0]) if key_local else 0
     if ok and any(len(a) != n_local for a in key_local):
         # a host key column whose local rows disagree with this process's
         # device shard rows cannot be aligned
         ok = False
-    # eligibility must be decided UNIFORMLY before any further collective:
-    # one process bailing to the host path while the rest enter the
-    # dictionary allgather would deadlock them (the fallback flag is
-    # itself a collective every process reaches)
-    all_ok = np.asarray(
-        mh.process_allgather(np.asarray([1 if ok else 0], np.int32))
-    )
-    if not int(all_ok.min()):
+    if not uniform_ok(ok):
         return None
     if n_local:
         ids_local, local_dict, k_local = group_ids(key_local)
