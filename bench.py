@@ -386,9 +386,13 @@ def _bench_convert(n_rows: int = 1_000_000):
     return convert_s, convertback_s
 
 
-def _bench_aggregate_keyed(keys: "np.ndarray", n_rows: int):
+def _bench_aggregate_keyed(keys: "np.ndarray", n_rows: int,
+                           device: bool = False):
     """Shared keyed-aggregate timing harness: reduce_sum over a float
-    column grouped by ``keys``, warmup excluded."""
+    column grouped by ``keys``, warmup excluded. ``device=True`` shards
+    the frame first, so the dense on-device plan runs with keys never
+    leaving HBM (the host-frame variant pays a key+value upload per
+    call — the dominant cost on relay-attached chips)."""
     import tensorframes_tpu as tfs
 
     rng = np.random.default_rng(0)
@@ -396,6 +400,8 @@ def _bench_aggregate_keyed(keys: "np.ndarray", n_rows: int):
         {"k": keys, "v": rng.standard_normal(n_rows).astype(np.float32)},
         num_blocks=1,
     )
+    if device:
+        frame = frame.to_device()
     with tfs.with_graph():
         v_input = tfs.block(frame, "v", tf_name="v_input")
         fetch = tfs.reduce_sum(v_input, axis=0, name="v")
@@ -418,6 +424,16 @@ def _bench_aggregate(n_rows: int = 1_000_000, n_groups: int = 512):
     one-hot MXU kernel on TPU, XLA segment scatter elsewhere)."""
     rng = np.random.default_rng(0)
     return _bench_aggregate_keyed(rng.integers(0, n_groups, n_rows), n_rows)
+
+
+def _bench_aggregate_device(n_rows: int = 1_000_000, n_groups: int = 512):
+    """Keyed aggregate over a DEVICE-sharded frame: the dense span plan
+    (ops/device_agg.py) — per-shard one-hot reduce + one collective, no
+    per-call host transfers."""
+    rng = np.random.default_rng(0)
+    return _bench_aggregate_keyed(
+        rng.integers(0, n_groups, n_rows), n_rows, device=True
+    )
 
 
 def _bench_aggregate_strings(n_rows: int = 1_000_000, n_groups: int = 512):
@@ -601,6 +617,10 @@ def main():
                     metric_keys=("reduce_blocks_1M_wall_s",))
     aggregate_s = _try("aggregate", _bench_aggregate, float("nan"),
                        metric_keys=("aggregate_1M_512groups_wall_s",))
+    aggregate_dev_s = _try(
+        "aggregate_device", _bench_aggregate_device, float("nan"),
+        metric_keys=("aggregate_device_1M_512groups_wall_s",),
+    )
     aggregate_str_s = _try(
         "aggregate_strings", _bench_aggregate_strings, float("nan"),
         metric_keys=("aggregate_strings_1M_512groups_wall_s",),
@@ -738,6 +758,7 @@ def main():
         "add3_map_blocks_rows_per_sec": round(add3_rps),
         "reduce_blocks_1M_wall_s": round(reduce_s, 6),
         "aggregate_1M_512groups_wall_s": round(aggregate_s, 6),
+        "aggregate_device_1M_512groups_wall_s": round(aggregate_dev_s, 6),
         "aggregate_strings_1M_512groups_wall_s": round(aggregate_str_s, 6),
         "map_rows_ragged_rows_per_sec": round(ragged_rps),
         "logreg_map_blocks_rows_per_sec": round(logreg_rps),

@@ -200,6 +200,21 @@ def extract_local_rows(v):
     return np.concatenate([np.asarray(s.data) for s in shards])
 
 
+def assemble_key_cols(frame, keys, group_key_cols, sel=None):
+    """Result key columns from per-key group arrays: optional group
+    selection, cast device keys back to their schema dtype (host keys —
+    strings — pass through). Shared result epilogue of the dictionary
+    plan and the generic multiprocess aggregate (verbs.py)."""
+    key_cols = {}
+    for i, k in enumerate(keys):
+        vals = group_key_cols[i] if sel is None else group_key_cols[i][sel]
+        info = frame.schema[k]
+        key_cols[k] = (
+            vals.astype(info.dtype.np_dtype) if info.is_device else vals
+        )
+    return key_cols
+
+
 def uniform_ok(ok: bool) -> bool:
     """Collective eligibility vote: every process must take the same
     branch BEFORE any further collective — one process falling back to a
@@ -220,8 +235,6 @@ def _aggregate_multiprocess_dict(
     may be process-local host lists (strings) or sharded device arrays;
     value columns stay sharded throughout."""
     from jax.sharding import NamedSharding
-
-    from jax.experimental import multihost_utils as mh
 
     key_local: List[np.ndarray] = []
     ok = True
@@ -258,14 +271,7 @@ def _aggregate_multiprocess_dict(
     sel, out_cols = _run_tables(
         frame, axis, ops, out_names, K, (1,), (ids_global,), main, None, None
     )
-    key_cols: Dict[str, np.ndarray] = {}
-    for i, k in enumerate(keys):
-        vals = group_key_cols[i][sel]
-        info = frame.schema[k]
-        key_cols[k] = (
-            vals.astype(info.dtype.np_dtype) if info.is_device else vals
-        )
-    return key_cols, out_cols
+    return assemble_key_cols(frame, keys, group_key_cols, sel), out_cols
 
 
 def try_aggregate_device(

@@ -811,8 +811,9 @@ def _batched_compaction(program, val_cols, seg_ids, num_groups, out_names):
             mat = np.concatenate(
                 [mat, np.repeat(mat[-1:], target - n_chunks, axis=0)]
             )
+        idx = jnp.asarray(mat.astype(np.int32))  # halve the index upload
         feeds = {
-            f"{x}_input": jnp.take(cur[x], jnp.asarray(mat), axis=0)
+            f"{x}_input": jnp.take(cur[x], idx, axis=0)
             for x in out_names
         }
         res = compiled.run_rows(feeds, to_numpy=False)
@@ -864,16 +865,19 @@ def _batched_compaction(program, val_cols, seg_ids, num_groups, out_names):
     return {x: np.asarray(finals[x]) for x in out_names}
 
 
-def _allgather_rows(arr: np.ndarray) -> np.ndarray:
+def _allgather_rows(arr: np.ndarray, ks: Optional[np.ndarray] = None) -> np.ndarray:
     """Allgather variable-row-count per-process arrays: the local
     ``[k_p, *cell]`` partials concatenate over processes in process-index
     order (matching ``_allgather_dicts``' union ordering). Two phases —
-    row counts, then payloads padded to the max count."""
+    row counts (pass precomputed ``ks`` to skip this collective when
+    gathering several same-length columns), then payloads padded to the
+    max count."""
     from jax.experimental import multihost_utils as mh
 
-    ks = np.asarray(
-        mh.process_allgather(np.asarray([arr.shape[0]], np.int64))
-    ).ravel()
+    if ks is None:
+        ks = np.asarray(
+            mh.process_allgather(np.asarray([arr.shape[0]], np.int64))
+        ).ravel()
     kmax = int(ks.max())
     padded = np.zeros((kmax,) + arr.shape[1:], arr.dtype)
     padded[: arr.shape[0]] = arr
@@ -897,12 +901,23 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
     Returns None when ineligible (non-uniform or ragged columns, host
     tail, outputs with Unknown dims — an empty-shard process could not
     then shape its padded allgather buffer)."""
-    from .device_agg import _allgather_dicts, extract_local_rows, uniform_ok
+    from .device_agg import (
+        _allgather_dicts,
+        assemble_key_cols,
+        extract_local_rows,
+        uniform_ok,
+    )
     from .keys import group_ids
 
     blocks = frame.blocks()
     main = blocks[0]
     tail = blocks[1] if len(blocks) > 1 else None
+
+    if frame.num_rows == 0:
+        # group_ids cannot encode zero rows; the caller's n == 0 branch
+        # owns the empty-result layout (num_rows is global — every
+        # process takes this return together, no collective needed)
+        return None
 
     ok = True
     if tail is not None and any(
@@ -940,20 +955,20 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
     partials = _batched_compaction(
         program, val_local, ids_local, k_local, out_names,
     )
+    from jax.experimental import multihost_utils as mh
+
     union_key_cols, _ = _allgather_dicts(list(local_dict))
-    union_vals = {x: _allgather_rows(np.asarray(partials[x])) for x in out_names}
+    ks = np.asarray(
+        mh.process_allgather(np.asarray([k_local], np.int64))
+    ).ravel()  # one counts collective shared by every value column
+    union_vals = {
+        x: _allgather_rows(np.asarray(partials[x]), ks) for x in out_names
+    }
     union_ids, group_key_cols, K = group_ids(union_key_cols)
     out_cols = _batched_compaction(
         program, union_vals, union_ids, K, out_names
     )
-    key_cols = {}
-    for i, k in enumerate(keys):
-        vals = group_key_cols[i]
-        info = frame.schema[k]
-        key_cols[k] = (
-            vals.astype(info.dtype.np_dtype) if info.is_device else vals
-        )
-    return key_cols, out_cols
+    return assemble_key_cols(frame, keys, group_key_cols), out_cols
 
 
 def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
@@ -1068,7 +1083,10 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         # shapes reuse one XLA executable (no giant captured constants)
         ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
         seg_vals = {x: jnp.asarray(val_cols[x]) for x in out_names}
-        sids = jnp.asarray(seg_ids)
+        # int32 ids: halves the host→HBM id-column transfer (the hot cost
+        # on relay-attached chips); group counts can't exceed int32 — the
+        # id space is bounded by row count long before 2^31
+        sids = jnp.asarray(seg_ids.astype(np.int32))
         try:
             res = _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
         except Exception as e:
