@@ -23,8 +23,10 @@ keras Inception-v3 (~2200 nodes, batchnorm decomposed to
 Mul/Sub/Rsqrt/AddV2 by the freezer), TF1-era graphs with un-decomposed
 FusedBatchNorm, and a frozen keras MultiHeadAttention encoder block
 execute bit-close to TF (tests/test_graphdef_frozen.py).
-``quantize_weights=True`` stores filters as per-channel int8. Anything
-else raises with the op name — the honest bounded-op-subset contract.
+Multi-output ops (Split/SplitV/Unpack/TopKV2) evaluate to tuples with
+``:k`` ref selection. ``quantize_weights=True`` stores filters as
+per-channel int8. Anything else raises with the op name — the honest
+bounded-op-subset contract.
 """
 
 from __future__ import annotations
@@ -472,6 +474,29 @@ def _concrete_operand(n: "GraphNode", what: str, v) -> np.ndarray:
     return np.asarray(v)
 
 
+# ops whose evaluation yields a TUPLE of outputs; data refs ``name:k``
+# select the k-th element (everything else is single-output)
+_MULTI_OUTPUT = ("Split", "SplitV", "Unpack", "TopKV2")
+
+
+def _select_output(v, ref: str):
+    """Resolve a data ref against an evaluated node value: multi-output
+    tuples select by the ref's ``:k`` suffix (default 0)."""
+    if isinstance(v, tuple):
+        idx = 0
+        if ":" in ref:
+            suffix = ref.rsplit(":", 1)[1]
+            if suffix.isdigit():
+                idx = int(suffix)
+        if idx >= len(v):
+            raise ValueError(
+                f"ref {ref!r} selects output {idx} but the node has "
+                f"{len(v)} outputs"
+            )
+        return v[idx]
+    return v
+
+
 def _base(ref: str) -> str:
     """Strip the ':output-index' suffix and control '^' prefix from a
     NodeDef input reference."""
@@ -633,16 +658,21 @@ def program_from_graphdef(
     for n in nodes:
         for ref in n.inputs:
             consumed.add(_base(ref))
-            # single-output evaluation model: a data ref to output :k>0
-            # (FusedBatchNorm's batch stats, future multi-output ops)
-            # would silently receive output :0 — reject it up front
+            # output :k>0 is legal only for registered MULTI-OUTPUT ops
+            # (Split/SplitV/Unpack/TopKV2 return tuples); for any other
+            # producer (FusedBatchNorm's batch stats, …) it would
+            # silently receive output :0 — reject it up front
             if not ref.startswith("^") and ":" in ref:
                 idx = ref.rsplit(":", 1)[1]
                 if idx.isdigit() and int(idx) > 0:
-                    raise ValueError(
-                        f"node {n.name!r} consumes output {ref!r}; only "
-                        "output :0 of each node is supported"
-                    )
+                    producer = by_name.get(_base(ref))
+                    if producer is None or producer.op not in _MULTI_OUTPUT:
+                        raise ValueError(
+                            f"node {n.name!r} consumes output {ref!r}; "
+                            "only multi-output ops "
+                            f"({sorted(_MULTI_OUTPUT)}) expose outputs "
+                            "past :0"
+                        )
     if fetches is None:
         fetches = [
             n.name
@@ -651,11 +681,25 @@ def program_from_graphdef(
         ]
         if not fetches:
             raise ValueError("GraphDef has no sink nodes; pass fetches=")
-    missing = [f for f in fetches if f not in by_name]
+    missing = [f for f in fetches if _base(f) not in by_name]
     if missing:
         raise ValueError(
             f"fetch(es) {missing} not in graph; nodes: {sorted(by_name)}"
         )
+    for f in fetches:
+        # same producer rule as consumer refs: a ':k>0' fetch of a
+        # single-output node would silently receive output :0
+        if ":" in f:
+            suffix = f.rsplit(":", 1)[1]
+            if suffix.isdigit() and int(suffix) > 0:
+                producer = by_name[_base(f)]
+                if producer.op not in _MULTI_OUTPUT:
+                    raise ValueError(
+                        f"fetch {f!r} selects output {suffix} of "
+                        f"single-output op {producer.op!r}; only "
+                        f"multi-output ops ({sorted(_MULTI_OUTPUT)}) "
+                        "expose outputs past :0"
+                    )
 
     # placeholders → program inputs
     inputs: List[TensorSpec] = []
@@ -690,6 +734,8 @@ def program_from_graphdef(
         # layernorm moments, gelu's Erf, masking selects)
         "GatherV2", "Einsum", "Transpose", "Select", "SelectV2",
         "BatchMatMulV2", "BatchMatMul",
+        # multi-output tier: evaluate to tuples; consumers select via :k
+        "Split", "SplitV", "Unpack", "TopKV2",
     )
     unsupported = sorted(
         {
@@ -795,9 +841,10 @@ def program_from_graphdef(
                 elif node.op == "NoOp":
                     values[nm] = None  # control-only; never consumed as data
                 else:
-                    deps = [
-                        _base(r) for r in node.inputs if not r.startswith("^")
+                    refs = [
+                        r for r in node.inputs if not r.startswith("^")
                     ]
+                    deps = [_base(r) for r in refs]
                     pending = [d for d in deps if d not in values]
                     if pending:
                         if nm in expanded:
@@ -811,7 +858,8 @@ def program_from_graphdef(
                         stack.extend(pending)
                         continue
                     values[nm] = _eval_node(
-                        node, [values[d] for d in deps],
+                        node, [_select_output(values[_base(r)], r)
+                               for r in refs],
                         compute_dtype=compute_dtype,
                     )
                 stack.pop()
@@ -819,7 +867,7 @@ def program_from_graphdef(
 
         out = {}
         for f in fetch_list:
-            v = materialize(f)
+            v = _select_output(materialize(_base(f)), f)
             if isinstance(v, QuantizedTensor):  # directly-fetched weight
                 v = v.dequantize(jnp.float32)
             # shape-arith fetches come back as host numpy; normalize to
@@ -939,6 +987,37 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
             int(d) for d in _concrete_operand(n, "shape", args[1])
         )
         return args[0].reshape(shp)
+    if op == "Split":
+        # inputs: (split_dim, value); attr num_split
+        ax = int(np.asarray(_concrete_operand(n, "split_dim", args[0])))
+        num = int(n.attrs["num_split"].i)
+        return tuple(jnp.split(args[1], num, axis=ax))
+    if op == "SplitV":
+        # inputs: (value, size_splits, split_dim); attr num_split
+        sizes = [
+            int(s) for s in np.asarray(
+                _concrete_operand(n, "size_splits", args[1])
+            )
+        ]
+        ax = int(np.asarray(_concrete_operand(n, "split_dim", args[2])))
+        if any(s < 0 for s in sizes):  # one -1 infers its size
+            total = args[0].shape[ax]
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else total - known for s in sizes]
+        bounds = list(np.cumsum(sizes)[:-1])
+        return tuple(jnp.split(args[0], bounds, axis=ax))
+    if op == "Unpack":
+        ax_attr = n.attrs.get("axis")
+        ax = int(ax_attr.i) if ax_attr and ax_attr.i is not None else 0
+        num = int(n.attrs["num"].i)
+        return tuple(
+            jnp.squeeze(s, axis=ax)
+            for s in jnp.split(args[0], num, axis=ax)
+        )
+    if op == "TopKV2":
+        kk = int(np.asarray(_concrete_operand(n, "k", args[1])))
+        vals_tk, idx_tk = jax.lax.top_k(args[0], kk)
+        return (vals_tk, idx_tk.astype(jnp.int32))
     if op == "GatherV2":
         params_, indices, axis = args
         bd = n.attrs.get("batch_dims")

@@ -914,9 +914,10 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
     tail = blocks[1] if len(blocks) > 1 else None
 
     if frame.num_rows == 0:
-        # group_ids cannot encode zero rows; the caller's n == 0 branch
-        # owns the empty-result layout (num_rows is global — every
-        # process takes this return together, no collective needed)
+        # group_ids cannot encode zero rows; aggregate()'s empty-frame
+        # branch (checked BEFORE its host gather) owns the layout —
+        # num_rows is global, so every process returns together and no
+        # collective is left dangling
         return None
 
     ok = True
@@ -1042,19 +1043,10 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
             key_cols_mp, out_cols_mp = mp
             return _assemble(key_cols_mp, out_cols_mp, frame.num_rows)
 
-    # -- gather rows to host, encode group keys -----------------------------
-    key_cols = {k: frame.column_values(k) for k in keys}
-    val_cols = {}
-    for x in out_names:
-        vals = frame.column_values(x)
-        if vals.dtype == object:
-            raise ValueError(
-                f"Column {x!r} is ragged; aggregate requires uniform cells "
-                "(run analyze() first)."
-            )
-        val_cols[x] = _demote_cast(vals, program.input(f"{x}_input"))
-    n = len(next(iter(key_cols.values())))
-    if n == 0:
+    # -- empty frame: build the zero-row result BEFORE any host gather —
+    # column_values on a multi-process sharded frame raises for
+    # non-addressable columns even when there is nothing to gather
+    if frame.num_rows == 0:
         infos = [
             frame.schema[k].with_block_shape(
                 frame.schema[k].cell_shape.prepend(Unknown)
@@ -1073,6 +1065,19 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
                 empty[i.name] = []
         profiling.record("aggregate", time.perf_counter() - t0, 0)
         return TensorFrame([empty], Schema(infos))
+
+    # -- gather rows to host, encode group keys -----------------------------
+    key_cols = {k: frame.column_values(k) for k in keys}
+    val_cols = {}
+    for x in out_names:
+        vals = frame.column_values(x)
+        if vals.dtype == object:
+            raise ValueError(
+                f"Column {x!r} is ragged; aggregate requires uniform cells "
+                "(run analyze() first)."
+            )
+        val_cols[x] = _demote_cast(vals, program.input(f"{x}_input"))
+    n = len(next(iter(key_cols.values())))
     seg_ids, out_key_cols, num_groups = _host_group_ids(key_cols, keys)
 
     out_cols: Dict[str, np.ndarray] = {}

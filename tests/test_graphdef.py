@@ -382,3 +382,54 @@ def test_f64_conv_graph_stays_faithful():
     got_b = prog_b.fn({"x": xv})["out"]
     assert got_b.dtype == np.float64
     np.testing.assert_allclose(np.asarray(got_b), want, atol=1e-10)
+
+
+def test_multi_output_ops_match_tf():
+    """Multi-output tier (round 3): Split/SplitV/Unpack/TopKV2 evaluate
+    to tuples; consumers (and explicit fetches) select outputs via the
+    ``:k`` ref suffix — previously any ``:k>0`` ref was rejected. Non-
+    multi-output producers still reject ``:k>0`` refs loudly."""
+    tf = pytest.importorskip("tensorflow")
+
+    from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((4, 12)).astype(np.float32)
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 12], name="x")
+        a, b, c = tf.split(x, 3, axis=1, name="sp")
+        s1, s2 = tf.split(x, [5, 7], axis=1, name="spv")
+        u0, u1, u2, u3 = tf.unstack(x, num=4, axis=0, name="un")
+        tv, ti = tf.math.top_k(x, k=3, name="tk")
+        tf.add(a * 2.0 + b - c[:, :4], s1[:, :4], name="mix")
+        tf.add(u1, u2, name="mix2")
+        tf.identity(tv, name="tkv")
+        tf.identity(tf.cast(ti, tf.float32), name="tki")
+    data = g.as_graph_def().SerializeToString()
+
+    fetches = ["mix", "mix2", "tkv", "tki"]
+    prog = program_from_graphdef(parse_graphdef(data), fetches=fetches)
+    got = prog.fn({"x": xv})
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run([f + ":0" for f in fetches], {"x:0": xv})
+    for name, w in zip(fetches, want):
+        np.testing.assert_allclose(np.asarray(got[name]), w, atol=1e-6)
+
+    # a ':k>0' FETCH of a single-output producer is rejected too (it
+    # would otherwise silently return output :0)
+    with pytest.raises(ValueError, match="single-output"):
+        program_from_graphdef(parse_graphdef(data), fetches=["mix:1"])
+
+    # :k>0 into a single-output producer still rejected by name
+    with tf.Graph().as_default() as g2:
+        x2 = tf.compat.v1.placeholder(tf.float32, [None, 3], name="x")
+        c2 = tf.constant(np.eye(3, dtype=np.float32))
+        bm = tf.raw_ops.FusedBatchNorm(
+            x=tf.reshape(x2, [-1, 1, 1, 3]), scale=[1.0, 1.0, 1.0],
+            offset=[0.0, 0.0, 0.0], mean=[], variance=[],
+            is_training=True,
+        )
+        tf.identity(bm.batch_mean, name="stats")
+    data2 = g2.as_graph_def().SerializeToString()
+    with pytest.raises(ValueError, match="multi-output"):
+        program_from_graphdef(parse_graphdef(data2), fetches=["stats"])
