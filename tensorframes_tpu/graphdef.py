@@ -479,6 +479,18 @@ def _concrete_operand(n: "GraphNode", what: str, v) -> np.ndarray:
 _MULTI_OUTPUT = ("Split", "SplitV", "Unpack", "TopKV2")
 
 
+def _num_outputs(node) -> int:
+    """Static output arity of a multi-output node (from its attrs), so
+    out-of-range ``:k`` refs fail at IMPORT time, not first call."""
+    if node.op in ("Split", "SplitV"):
+        return int(node.attrs["num_split"].i)
+    if node.op == "Unpack":
+        return int(node.attrs["num"].i)
+    if node.op == "TopKV2":
+        return 2
+    return 1
+
+
 def _select_output(v, ref: str):
     """Resolve a data ref against an evaluated node value: multi-output
     tuples select by the ref's ``:k`` suffix (default 0)."""
@@ -673,6 +685,12 @@ def program_from_graphdef(
                             f"({sorted(_MULTI_OUTPUT)}) expose outputs "
                             "past :0"
                         )
+                    if int(idx) >= _num_outputs(producer):
+                        raise ValueError(
+                            f"node {n.name!r} consumes output {ref!r} but "
+                            f"{producer.op} node {producer.name!r} has "
+                            f"{_num_outputs(producer)} outputs"
+                        )
     if fetches is None:
         fetches = [
             n.name
@@ -691,7 +709,12 @@ def program_from_graphdef(
         # single-output node would silently receive output :0
         if ":" in f:
             suffix = f.rsplit(":", 1)[1]
-            if suffix.isdigit() and int(suffix) > 0:
+            if not suffix.isdigit():
+                raise ValueError(
+                    f"fetch {f!r}: malformed output suffix {suffix!r} "
+                    "(expected an integer, e.g. 'split:1')"
+                )
+            if int(suffix) > 0:
                 producer = by_name[_base(f)]
                 if producer.op not in _MULTI_OUTPUT:
                     raise ValueError(
@@ -699,6 +722,12 @@ def program_from_graphdef(
                         f"single-output op {producer.op!r}; only "
                         f"multi-output ops ({sorted(_MULTI_OUTPUT)}) "
                         "expose outputs past :0"
+                    )
+                if int(suffix) >= _num_outputs(producer):
+                    raise ValueError(
+                        f"fetch {f!r} selects output {suffix} but "
+                        f"{producer.op} node {producer.name!r} has "
+                        f"{_num_outputs(producer)} outputs"
                     )
 
     # placeholders → program inputs

@@ -139,6 +139,11 @@ def _run_workers(tmp_path, nproc: int, timeout: float):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    # CPU workers must not touch the accelerator plugin: with the pool
+    # var cleared the axon sitecustomize no-ops, so a wedged TPU relay
+    # can't hang or crash worker interpreter startup (the intermittent
+    # full-suite failure of the 4-process test)
+    env["PALLAS_AXON_POOL_IPS"] = ""
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(nproc)],
