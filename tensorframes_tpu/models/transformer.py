@@ -306,12 +306,20 @@ def make_sharded_train_step(
     mesh: Mesh,
     tx,
     seq_axis: Optional[str] = "sp",
+    mixed_precision: bool = False,
 ):
     """Jit the train step over a mesh with dp/tp(/sp) shardings.
 
     Data: tokens/targets [b, s] → P('dp', 'sp'). Params: Megatron tp
     layout. Optimizer state mirrors param shardings. XLA's SPMD partitioner
-    inserts the all-gathers/psums over ICI.
+    inserts the all-gathers/psums over ICI. ``mixed_precision=True`` casts
+    the LAYER params to ``cfg.dtype`` inside the differentiated function
+    — the tp all-gathers and the backward then move bf16 instead of f32
+    (forward already computes in ``cfg.dtype`` via per-use casts; the
+    flag shrinks the collective/grad traffic). The embedding table stays
+    f32: ``loss_fn`` deliberately keeps the large-vocab logits
+    contraction in f32, and the master weights the optimizer updates are
+    f32 either way (no loss scaling: bf16 keeps f32's exponent range).
     """
     if seq_axis is not None and seq_axis not in mesh.shape:
         seq_axis = None  # e.g. a pure-dp mesh: sequence stays unsharded
@@ -319,11 +327,23 @@ def make_sharded_train_step(
     data_sharding = NamedSharding(mesh, data_spec)
     shardings = param_shardings(cfg, mesh)
 
+    def run_loss(p, tokens, targets):
+        if mixed_precision:
+            from ..training import cast_float_leaves
+
+            # embed stays f32 — see docstring (f32 logits head)
+            p = {
+                **p,
+                "layers": cast_float_leaves(p["layers"], cfg.dtype),
+                "final_ln": cast_float_leaves(p["final_ln"], cfg.dtype),
+            }
+        return loss_fn(cfg, p, tokens, targets, mesh=mesh)
+
     def step(params, opt_state, tokens, targets):
         import optax
 
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, tokens, targets, mesh=mesh)
+            lambda p: run_loss(p, tokens, targets)
         )(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -96,10 +96,27 @@ def run_resumable(
     return state, ran
 
 
+def cast_float_leaves(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype`` (non-float
+    leaves pass through) — the mixed-precision parameter cast shared by
+    the grad-accum and sharded train steps."""
+    import jax
+    import jax.numpy as jnp
+
+    dt_ = jnp.dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dt_)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
 def make_grad_accum_step(
     loss_fn: Callable,
     tx,
     accum_steps: int,
+    compute_dtype: Optional[str] = None,
 ) -> Callable:
     """Gradient accumulation: one optimizer update from ``accum_steps``
     microbatches, averaged — the standard lever when the global batch
@@ -110,6 +127,14 @@ def make_grad_accum_step(
     with a leading dim divisible by ``accum_steps`` and scans over the
     microbatch splits — one compiled program, O(1) activation memory in
     the number of microbatches.
+
+    ``compute_dtype`` (e.g. ``"bfloat16"``) enables MIXED-PRECISION
+    training the TPU way: float params are cast to the compute dtype
+    inside the differentiated function, so forward+backward run on the
+    MXU at bf16 rate while the params the optimizer updates stay f32
+    master weights (autodiff through the cast yields f32 gradients).
+    bf16 shares f32's exponent range, so no loss scaling is needed —
+    the GPU-era scaled-fp16 machinery has no TPU counterpart.
     """
     import jax
     import jax.numpy as jnp
@@ -117,6 +142,12 @@ def make_grad_accum_step(
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    if compute_dtype is not None:
+        def run_loss(p, mb):
+            return loss_fn(cast_float_leaves(p, compute_dtype), mb)
+    else:
+        run_loss = loss_fn
 
     def step(params, opt_state, batch):
         def to_micro(x):
@@ -132,7 +163,7 @@ def make_grad_accum_step(
 
         def accum(carry, mb):
             g_sum, l_sum = carry
-            loss, g = jax.value_and_grad(loss_fn)(params, mb)
+            loss, g = jax.value_and_grad(run_loss)(params, mb)
             g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
             # cast into the f32 carry: under the package's default x64 a
             # float64 loss must not change the scan carry dtype
