@@ -501,3 +501,45 @@ def test_frozen_keras_transformer_matches_tf():
     got2 = np.asarray(p2.fn({p2.inputs[0].name: t})[p2.fetch_order[0]])
     assert got2.dtype == np.float32
     np.testing.assert_allclose(got2, want, atol=5e-2, rtol=5e-2)
+
+
+def test_bf16_int8_import_roundtrips_stablehlo(tmp_path):
+    """The serving-precision knobs survive the StableHLO artifact: a
+    bf16-policy int8-weight import exports via save_program and reloads
+    to the same outputs — the deployable TF-to-TPU serving artifact with
+    reduced precision baked in (weights ship as s8 + scales in the
+    artifact, contractions in bf16 with f32 accumulation)."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    tf.keras.utils.set_random_seed(17)
+    model = tf.keras.Sequential(
+        [
+            tf.keras.layers.Input((8, 8, 3)),
+            tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(3),
+        ]
+    )
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, 8, 8, 3], tf.float32))
+    p = tmp_path / "m.pb"
+    p.write_bytes(
+        convert_variables_to_constants_v2(cf).graph.as_graph_def(
+        ).SerializeToString()
+    )
+
+    prog = tfs.load_graphdef(
+        str(p), relax_lead_dim=True, quantize_weights=True,
+        compute_dtype="bfloat16",
+    )
+    art = str(tmp_path / "m.stablehlo")
+    tfs.save_program(prog, art)
+    back = tfs.load_program(art)
+
+    rng = np.random.default_rng(18)
+    x = rng.standard_normal((6, 8, 8, 3)).astype(np.float32)
+    want = np.asarray(prog.fn({prog.inputs[0].name: x})[prog.fetch_order[0]])
+    got = np.asarray(back.fn({back.inputs[0].name: x})[back.fetch_order[0]])
+    np.testing.assert_allclose(got, want, atol=1e-6)
