@@ -718,7 +718,11 @@ def main():
         0.0,
         metric_keys=("inception_v3_frozen_bf16_graphdef_rows_per_sec",),
     )
-    if "f32" in _FROZEN_BYTES and "int8" in _FROZEN_BYTES:
+    if on_tpu and "f32" in _FROZEN_BYTES and "int8" in _FROZEN_BYTES:
+        # TPU only: XLA:CPU's fusion of the all-constant dequantize is
+        # boot-sensitive (see tests/test_graphdef_frozen.py), so the CPU
+        # ratio is noise; the env-independent weight-bytes claim lives in
+        # the const_bytes unit test
         bf, bq = _FROZEN_BYTES["f32"], _FROZEN_BYTES["int8"]
         if bq > 0:
             print(
