@@ -23,7 +23,8 @@ keras Inception-v3 (~2200 nodes, batchnorm decomposed to
 Mul/Sub/Rsqrt/AddV2 by the freezer), TF1-era graphs with un-decomposed
 FusedBatchNorm, and a frozen keras MultiHeadAttention encoder block
 execute bit-close to TF (tests/test_graphdef_frozen.py).
-Multi-output ops (Split/SplitV/Unpack/TopKV2) evaluate to tuples with
+Multi-output ops (Split/SplitV/Unpack/TopKV2/IdentityN) evaluate to
+tuples with
 ``:k`` ref selection. ``quantize_weights=True`` stores filters as
 per-channel int8. Anything else raises with the op name — the honest
 bounded-op-subset contract.
@@ -476,7 +477,7 @@ def _concrete_operand(n: "GraphNode", what: str, v) -> np.ndarray:
 
 # ops whose evaluation yields a TUPLE of outputs; data refs ``name:k``
 # select the k-th element (everything else is single-output)
-_MULTI_OUTPUT = ("Split", "SplitV", "Unpack", "TopKV2")
+_MULTI_OUTPUT = ("Split", "SplitV", "Unpack", "TopKV2", "IdentityN")
 
 
 def _num_outputs(node) -> int:
@@ -488,6 +489,8 @@ def _num_outputs(node) -> int:
         return int(node.attrs["num"].i)
     if node.op == "TopKV2":
         return 2
+    if node.op == "IdentityN":
+        return len([r for r in node.inputs if not r.startswith("^")])
     return 1
 
 
@@ -764,7 +767,7 @@ def program_from_graphdef(
         "GatherV2", "Einsum", "Transpose", "Select", "SelectV2",
         "BatchMatMulV2", "BatchMatMul",
         # multi-output tier: evaluate to tuples; consumers select via :k
-        "Split", "SplitV", "Unpack", "TopKV2",
+        "Split", "SplitV", "Unpack", "TopKV2", "IdentityN",
     )
     unsupported = sorted(
         {
@@ -1016,6 +1019,8 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
             int(d) for d in _concrete_operand(n, "shape", args[1])
         )
         return args[0].reshape(shp)
+    if op == "IdentityN":
+        return tuple(args)
     if op == "Split":
         # inputs: (split_dim, value); attr num_split
         ax = int(np.asarray(_concrete_operand(n, "split_dim", args[0])))

@@ -68,12 +68,18 @@ def test_frozen_inception_v3_matches_tf(frozen_inception):
 
 @pytest.mark.parametrize(
     "ctor_name,shape",
-    [("MobileNetV2", (96, 96, 3)), ("ResNet50", (64, 64, 3))],
+    [
+        ("MobileNetV2", (96, 96, 3)),
+        ("ResNet50", (64, 64, 3)),
+        ("EfficientNetB0", (64, 64, 3)),
+    ],
 )
 def test_frozen_model_zoo_matches_tf(ctor_name, shape):
     """Importer generality across frozen keras families: MobileNetV2
-    (depthwise convs, Relu6, residual AddV2, Pad) and ResNet50 (strided
-    convs, MaxPool, Pad, Squeeze) — golden-compared against TF."""
+    (depthwise convs, Relu6, residual AddV2, Pad), ResNet50 (strided
+    convs, MaxPool, Pad, Squeeze), and EfficientNetB0 (SE blocks:
+    swish Sigmoid·Mul, Mean-keepdims, IdentityN) — golden-compared
+    against TF."""
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2,
     )
