@@ -534,21 +534,65 @@ def _bench_compile_fullscale():
     return out
 
 
+_SUBBENCH_TIMEOUT_S = 1200  # generous: sweep compiles run minutes, not hours
+
+
+class _SubBenchTimeout(Exception):
+    pass
+
+
 def _try(name: str, fn, default=None, metric_keys=()):
     """Run one sub-bench; a failure becomes a comment line, never a crash —
     the driver must always receive the single JSON line. ``metric_keys``
     names the metric lines this sub-bench feeds: on failure they print
     as ``metric=ERROR <type>: …`` instead of a fake numeric fallback, so
     dev/bench_check.py can tell a missing fixture dep (ImportError on a
-    runner without tensorflow) from a regression."""
+    runner without tensorflow) from a regression.
+
+    A SIGALRM watchdog bounds each sub-bench: the axon tunnel can wedge
+    MID-RUN (observed 2026-07-31 — healthy for Inception, dead by the
+    decode benches), leaving the process in a python-level poll sleep
+    forever; the alarm breaks that sleep so the remaining sub-benches
+    and the final JSON line still happen. Main-thread/unix only — it
+    degrades to no watchdog elsewhere."""
+    import signal
+
+    global _SUBBENCH_TIMEOUT_S
+    use_alarm = hasattr(signal, "SIGALRM")
+    if use_alarm:
+        def _on_alarm(signum, frame):
+            raise _SubBenchTimeout(
+                f"sub-bench exceeded {_SUBBENCH_TIMEOUT_S}s (wedged backend?)"
+            )
+
+        try:
+            prev = signal.signal(signal.SIGALRM, _on_alarm)
+            signal.alarm(_SUBBENCH_TIMEOUT_S)
+        except ValueError:  # not the main thread
+            use_alarm = False
     try:
-        return fn()
+        try:
+            return fn()
+        finally:
+            # cancel BEFORE any error formatting below: a pending alarm
+            # firing inside the except block would escape _try and kill
+            # the run this wrapper exists to protect
+            if use_alarm:
+                signal.alarm(0)
     except Exception as e:
+        if isinstance(e, _SubBenchTimeout):
+            # one wedge means the backend is gone for the whole rest of
+            # the run — fail the remaining sub-benches fast instead of
+            # burning the full budget ~15 more times
+            _SUBBENCH_TIMEOUT_S = min(_SUBBENCH_TIMEOUT_S, 60)
         msg = f"{type(e).__name__}: {str(e).splitlines()[0][:200]}"
         print(f"# {name}=ERROR {msg}")
         for k in metric_keys:
             _ERRORS[k] = msg
         return default
+    finally:
+        if use_alarm:
+            signal.signal(signal.SIGALRM, prev)
 
 
 def _print_last_tpu_history():
