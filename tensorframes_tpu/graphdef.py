@@ -24,10 +24,12 @@ Mul/Sub/Rsqrt/AddV2 by the freezer), TF1-era graphs with un-decomposed
 FusedBatchNorm, and a frozen keras MultiHeadAttention encoder block
 execute bit-close to TF (tests/test_graphdef_frozen.py).
 Multi-output ops (Split/SplitV/Unpack/TopKV2/IdentityN) evaluate to
-tuples with
-``:k`` ref selection. ``quantize_weights=True`` stores filters as
-per-channel int8. Anything else raises with the op name — the honest
-bounded-op-subset contract.
+tuples with ``:k`` ref selection. Un-frozen ``tf.function`` exports
+import too: ``PartitionedCall``/``StatefulPartitionedCall`` bodies come
+from the graph's ``FunctionDefLibrary`` (clean-room FunctionDef decode;
+nested and multi-output calls included). ``quantize_weights=True``
+stores filters as per-channel int8. Anything else raises with the op
+name — the honest bounded-op-subset contract.
 """
 
 from __future__ import annotations
@@ -232,12 +234,13 @@ class _Attr:
     (Conv2D strides, pool ksize, Squeeze dims, …)."""
 
     __slots__ = ("s", "i", "f", "b", "type", "shape", "tensor",
-                 "ints", "floats", "bools")
+                 "ints", "floats", "bools", "func")
 
     def __init__(self):
         self.s = self.i = self.f = self.b = None
         self.type = self.shape = self.tensor = None
         self.ints = self.floats = self.bools = None
+        self.func = None  # NameAttrList name (PartitionedCall's 'f')
 
 
 def _parse_list_value(a: _Attr, data: bytes) -> None:
@@ -293,6 +296,10 @@ def _parse_attr(data: bytes) -> _Attr:
             a.shape = _parse_shape(v)
         elif field == 8:
             a.tensor = _parse_tensor(v)
+        elif field == 10:  # func: NameAttrList (field 1 = name)
+            for f2, _, v2 in _iter_fields(v):
+                if f2 == 1:
+                    a.func = v2.decode("utf-8")
     return a
 
 
@@ -311,12 +318,40 @@ class GraphNode:
         return f"GraphNode({self.name!r}, op={self.op!r}, inputs={self.inputs})"
 
 
-def parse_graphdef(data: bytes) -> List[GraphNode]:
+class FunctionDef:
+    """One decoded library function (function.proto): signature arg
+    names, body nodes (same :class:`GraphNode` records as the main
+    graph), and the ``ret`` map from output-arg name to a body ref in
+    the function convention (``node:port:index``)."""
+
+    __slots__ = ("name", "input_args", "output_args", "nodes", "ret")
+
+    def __init__(self, name, input_args, output_args, nodes, ret):
+        self.name = name
+        self.input_args = input_args
+        self.output_args = output_args
+        self.nodes = nodes
+        self.ret = ret
+
+
+class GraphNodes(list):
+    """The parsed main-graph nodes, plus the function library (name →
+    :class:`FunctionDef`) for graphs that keep ``PartitionedCall``
+    wrappers (un-frozen ``tf.function`` exports)."""
+
+    def __init__(self, nodes, library=None):
+        super().__init__(nodes)
+        self.library: Dict[str, FunctionDef] = library or {}
+
+
+def parse_graphdef(data: bytes) -> "GraphNodes":
     """Decode a serialized ``GraphDef`` (graph.proto: field 1 = repeated
-    NodeDef) into :class:`GraphNode` records. Unknown fields are skipped —
-    version stamps, device placements, and library functions don't affect
-    the frozen-inference subset. Malformed bytes raise ``ValueError``
-    ("not a valid GraphDef"), never a bare index/struct error."""
+    NodeDef, field 2 = FunctionDefLibrary) into :class:`GraphNode`
+    records plus the function library (``.library`` on the returned
+    list — PartitionedCall bodies). Unknown fields are skipped — version
+    stamps and device placements don't affect the inference subset.
+    Malformed bytes raise ``ValueError`` ("not a valid GraphDef"), never
+    a bare index/struct error."""
     try:
         return _parse_graphdef_inner(data)
     except (IndexError, struct.error, UnicodeDecodeError, _WireError) as e:
@@ -328,32 +363,75 @@ def parse_graphdef(data: bytes) -> List[GraphNode]:
         ) from e
 
 
-def _parse_graphdef_inner(data: bytes) -> List[GraphNode]:
+def _parse_node_def(v: bytes) -> GraphNode:
+    name = op = ""
+    inputs: List[str] = []
+    attrs: Dict[str, _Attr] = {}
+    for f2, _, v2 in _iter_fields(v):
+        if f2 == 1:
+            name = v2.decode("utf-8")
+        elif f2 == 2:
+            op = v2.decode("utf-8")
+        elif f2 == 3:
+            inputs.append(v2.decode("utf-8"))
+        elif f2 == 5:
+            k = av = None
+            for f3, _, v3 in _iter_fields(v2):
+                if f3 == 1:
+                    k = v3.decode("utf-8")
+                elif f3 == 2:
+                    av = _parse_attr(v3)
+            if k is not None and av is not None:
+                attrs[k] = av
+    return GraphNode(name, op, inputs, attrs)
+
+
+def _parse_function_def(data: bytes) -> FunctionDef:
+    """function.proto FunctionDef: field 1 = OpDef signature (name=1,
+    input_arg=2, output_arg=3; ArgDef name=1), field 3 = repeated
+    NodeDef, field 4 = ret map (key=1, value=2)."""
+    name = ""
+    input_args: List[str] = []
+    output_args: List[str] = []
     nodes: List[GraphNode] = []
+    ret: Dict[str, str] = {}
     for field, _, v in _iter_fields(data):
-        if field != 1:
-            continue
-        name = op = ""
-        inputs: List[str] = []
-        attrs: Dict[str, _Attr] = {}
-        for f2, _, v2 in _iter_fields(v):
-            if f2 == 1:
-                name = v2.decode("utf-8")
-            elif f2 == 2:
-                op = v2.decode("utf-8")
-            elif f2 == 3:
-                inputs.append(v2.decode("utf-8"))
-            elif f2 == 5:
-                k = av = None
-                for f3, _, v3 in _iter_fields(v2):
-                    if f3 == 1:
-                        k = v3.decode("utf-8")
-                    elif f3 == 2:
-                        av = _parse_attr(v3)
-                if k is not None and av is not None:
-                    attrs[k] = av
-        nodes.append(GraphNode(name, op, inputs, attrs))
-    return nodes
+        if field == 1:  # OpDef
+            for f2, _, v2 in _iter_fields(v):
+                if f2 == 1:
+                    name = v2.decode("utf-8")
+                elif f2 in (2, 3):  # ArgDef
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            (input_args if f2 == 2 else output_args).append(
+                                v3.decode("utf-8")
+                            )
+        elif field == 3:
+            nodes.append(_parse_node_def(v))
+        elif field == 4:  # map<string, string> entry
+            k = val = None
+            for f2, _, v2 in _iter_fields(v):
+                if f2 == 1:
+                    k = v2.decode("utf-8")
+                elif f2 == 2:
+                    val = v2.decode("utf-8")
+            if k is not None and val is not None:
+                ret[k] = val
+    return FunctionDef(name, input_args, output_args, nodes, ret)
+
+
+def _parse_graphdef_inner(data: bytes) -> "GraphNodes":
+    nodes: List[GraphNode] = []
+    library: Dict[str, FunctionDef] = {}
+    for field, _, v in _iter_fields(data):
+        if field == 1:
+            nodes.append(_parse_node_def(v))
+        elif field == 2:  # FunctionDefLibrary: field 1 = FunctionDef
+            for f2, _, v2 in _iter_fields(v):
+                if f2 == 1:
+                    fd = _parse_function_def(v2)
+                    library[fd.name] = fd
+    return GraphNodes(nodes, library)
 
 
 # ---------------------------------------------------------------------------
@@ -477,12 +555,16 @@ def _concrete_operand(n: "GraphNode", what: str, v) -> np.ndarray:
 
 # ops whose evaluation yields a TUPLE of outputs; data refs ``name:k``
 # select the k-th element (everything else is single-output)
-_MULTI_OUTPUT = ("Split", "SplitV", "Unpack", "TopKV2", "IdentityN")
+_MULTI_OUTPUT = (
+    "Split", "SplitV", "Unpack", "TopKV2", "IdentityN",
+    "PartitionedCall", "StatefulPartitionedCall",
+)
 
 
-def _num_outputs(node) -> int:
-    """Static output arity of a multi-output node (from its attrs), so
-    out-of-range ``:k`` refs fail at IMPORT time, not first call."""
+def _num_outputs(node, library=None) -> int:
+    """Static output arity of a multi-output node (from its attrs —
+    or, for function calls, the library signature), so out-of-range
+    ``:k`` refs fail at IMPORT time, not first call."""
     if node.op in ("Split", "SplitV"):
         return int(node.attrs["num_split"].i)
     if node.op == "Unpack":
@@ -491,7 +573,145 @@ def _num_outputs(node) -> int:
         return 2
     if node.op == "IdentityN":
         return len([r for r in node.inputs if not r.startswith("^")])
+    if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
+        f = node.attrs.get("f")
+        fd = (library or {}).get(f.func if f else None)
+        return len(fd.output_args) if fd else 1
     return 1
+
+
+# list-output ports: the numeric index in a function-body ref
+# ``node:port:idx`` selects directly into the tuple; named scalar ports
+# map by name
+_PORT_MAPS = {"TopKV2": {"values": 0, "indices": 1}}
+
+
+def _resolve_fn_ref(ref: str, value, op: str):
+    """Resolve a FunctionDef-convention data ref (``node:port:index``)
+    against an evaluated body-node value."""
+    if not isinstance(value, tuple):
+        return value
+    parts = ref.split(":")
+    port = parts[1] if len(parts) >= 2 else ""
+    idx = int(parts[2]) if len(parts) >= 3 and parts[2].isdigit() else 0
+    pm = _PORT_MAPS.get(op)
+    if pm is not None:
+        if port not in pm:
+            raise ValueError(
+                f"function ref {ref!r}: unknown output port {port!r} of "
+                f"{op}"
+            )
+        idx = pm[port]
+    if idx >= len(value):
+        raise ValueError(
+            f"function ref {ref!r} selects output {idx} but the node has "
+            f"{len(value)} outputs"
+        )
+    return value[idx]
+
+
+def _eval_function(fdef, call_args, library, compute_dtype):
+    """Evaluate one library function body (PartitionedCall target):
+    bind ``call_args`` to the signature's input args, run the body nodes
+    with the same work-stack discipline as the main graph (refs use the
+    FunctionDef ``node:port:index`` convention), and return the outputs
+    in ``output_args`` order via the ``ret`` map. Nested calls recurse —
+    call DEPTH is bounded by the program's nesting, unlike the node-chain
+    depth the iterative main evaluator protects against."""
+    env = dict(zip(fdef.input_args, call_args))
+    by_name = {n.name: n for n in fdef.nodes}
+    values: Dict[str, object] = {}
+
+    def resolve(ref):
+        if ref.startswith("^"):
+            return None
+        base = ref.split(":")[0]
+        if base in env and base not in by_name:
+            return env[base]
+        return _resolve_fn_ref(ref, values[base], by_name[base].op)
+
+    def materialize(target: str):
+        # NOTE: mirrors the main evaluator's DFS work stack in
+        # program_from_graphdef.fn (same push/expanded cycle discipline,
+        # Const/NoOp cases) with the FUNCTION ref convention — a change
+        # to either traversal must be applied to both
+        stack = [target]
+        expanded = set()
+        while stack:
+            nm = stack[-1]
+            if nm in values or (nm in env and nm not in by_name):
+                stack.pop()
+                continue
+            node = by_name.get(nm)
+            if node is None:
+                raise ValueError(
+                    f"function {fdef.name!r}: ref to unknown node {nm!r}"
+                )
+            if node.op == "Const":
+                values[nm] = node.attrs["value"].tensor
+            elif node.op == "NoOp":
+                values[nm] = None
+            else:
+                refs = [r for r in node.inputs if not r.startswith("^")]
+                deps = [
+                    r.split(":")[0] for r in refs
+                    if not (r.split(":")[0] in env
+                            and r.split(":")[0] not in by_name)
+                ]
+                pending = [d for d in deps if d not in values]
+                if pending:
+                    if nm in expanded:
+                        raise ValueError(
+                            f"function {fdef.name!r} contains a cycle "
+                            f"through {nm!r}"
+                        )
+                    expanded.add(nm)
+                    stack.extend(pending)
+                    continue
+                if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
+                    values[nm] = _eval_call(
+                        node, [resolve(r) for r in refs], library,
+                        compute_dtype,
+                    )
+                else:
+                    values[nm] = _eval_node(
+                        node, [resolve(r) for r in refs],
+                        compute_dtype=compute_dtype,
+                    )
+            stack.pop()
+        return None
+
+    outs = []
+    for out_name in fdef.output_args:
+        ref = fdef.ret.get(out_name)
+        if ref is None:
+            raise ValueError(
+                f"function {fdef.name!r}: output {out_name!r} missing "
+                "from the ret map"
+            )
+        base = ref.split(":")[0]
+        if not (base in env and base not in by_name):
+            materialize(base)
+        outs.append(resolve(ref))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def _eval_call(node, args, library, compute_dtype):
+    """Dispatch a PartitionedCall/StatefulPartitionedCall node to its
+    library function."""
+    f = node.attrs.get("f")
+    fd = library.get(f.func) if f and f.func else None
+    if fd is None:
+        raise ValueError(
+            f"call node {node.name!r}: function "
+            f"{(f.func if f else None)!r} not in the graph library"
+        )
+    if len(args) != len(fd.input_args):
+        raise ValueError(
+            f"call node {node.name!r}: {len(args)} args for function "
+            f"{fd.name!r} expecting {len(fd.input_args)}"
+        )
+    return _eval_function(fd, args, library, compute_dtype)
 
 
 def _select_output(v, ref: str):
@@ -669,6 +889,7 @@ def program_from_graphdef(
     imported graph is f32-faithful by default).
     """
     by_name = {n.name: n for n in nodes}
+    library = getattr(nodes, "library", {}) or {}
     consumed = set()
     for n in nodes:
         for ref in n.inputs:
@@ -688,11 +909,11 @@ def program_from_graphdef(
                             f"({sorted(_MULTI_OUTPUT)}) expose outputs "
                             "past :0"
                         )
-                    if int(idx) >= _num_outputs(producer):
+                    if int(idx) >= _num_outputs(producer, library):
                         raise ValueError(
                             f"node {n.name!r} consumes output {ref!r} but "
                             f"{producer.op} node {producer.name!r} has "
-                            f"{_num_outputs(producer)} outputs"
+                            f"{_num_outputs(producer, library)} outputs"
                         )
     if fetches is None:
         fetches = [
@@ -726,11 +947,11 @@ def program_from_graphdef(
                         f"multi-output ops ({sorted(_MULTI_OUTPUT)}) "
                         "expose outputs past :0"
                     )
-                if int(suffix) >= _num_outputs(producer):
+                if int(suffix) >= _num_outputs(producer, library):
                     raise ValueError(
                         f"fetch {f!r} selects output {suffix} but "
                         f"{producer.op} node {producer.name!r} has "
-                        f"{_num_outputs(producer)} outputs"
+                        f"{_num_outputs(producer, library)} outputs"
                     )
 
     # placeholders → program inputs
@@ -768,11 +989,51 @@ def program_from_graphdef(
         "BatchMatMulV2", "BatchMatMul",
         # multi-output tier: evaluate to tuples; consumers select via :k
         "Split", "SplitV", "Unpack", "TopKV2", "IdentityN",
+        # function calls (un-frozen tf.function exports): bodies come
+        # from the graph's FunctionDefLibrary and are validated below
+        "PartitionedCall", "StatefulPartitionedCall",
     )
+    def _walk_function_nodes(seen_fns):
+        """Yield every node of every library function reachable from
+        the main graph's call nodes (nested calls included) so the
+        unsupported-op gate covers function bodies too."""
+        pending = []
+        for n in nodes:
+            if n.op in ("PartitionedCall", "StatefulPartitionedCall"):
+                fattr = n.attrs.get("f")
+                if fattr is None or not fattr.func:
+                    raise ValueError(
+                        f"call node {n.name!r} has no function attr 'f' — "
+                        "malformed call structure fails at import, not "
+                        "first execution"
+                    )
+                pending.append(fattr.func)
+        while pending:
+            fname = pending.pop()
+            if fname in seen_fns:
+                continue
+            seen_fns.add(fname)
+            fd = library.get(fname)
+            if fd is None:
+                raise ValueError(
+                    f"call to function {fname!r} but the GraphDef library "
+                    f"only defines {sorted(library)}"
+                )
+            for bn in fd.nodes:
+                if bn.op in ("PartitionedCall", "StatefulPartitionedCall"):
+                    f2 = bn.attrs.get("f")
+                    if f2 is None or not f2.func:
+                        raise ValueError(
+                            f"call node {bn.name!r} (in function "
+                            f"{fname!r}) has no function attr 'f'"
+                        )
+                    pending.append(f2.func)
+                yield bn
+
     unsupported = sorted(
         {
             n.op
-            for n in nodes
+            for n in list(nodes) + list(_walk_function_nodes(set()))
             if n.op not in structural
             and n.op not in _BINARY
             and n.op not in _UNARY
@@ -889,11 +1150,19 @@ def program_from_graphdef(
                         expanded.add(nm)
                         stack.extend(pending)
                         continue
-                    values[nm] = _eval_node(
-                        node, [_select_output(values[_base(r)], r)
-                               for r in refs],
-                        compute_dtype=compute_dtype,
-                    )
+                    call_args = [
+                        _select_output(values[_base(r)], r) for r in refs
+                    ]
+                    if node.op in (
+                        "PartitionedCall", "StatefulPartitionedCall"
+                    ):
+                        values[nm] = _eval_call(
+                            node, call_args, library, compute_dtype
+                        )
+                    else:
+                        values[nm] = _eval_node(
+                            node, call_args, compute_dtype=compute_dtype
+                        )
                 stack.pop()
             return values[target]
 
