@@ -464,6 +464,13 @@ _BINARY = {
     "NotEqual": jnp.not_equal,
     "LogicalAnd": jnp.logical_and,
     "LogicalOr": jnp.logical_or,
+    "Atan2": jnp.arctan2,
+    # TF's Mod is C-style TRUNCATED modulo (sign of the dividend);
+    # jnp.mod is floor-modulo — lax.rem has the right semantics
+    "Mod": jax.lax.rem,
+    "TruncateDiv": lambda a, b: jnp.trunc(a / b).astype(a.dtype)
+    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+    else jax.lax.div(a, b),
 }
 _UNARY = {
     "Identity": lambda x: x,
@@ -487,6 +494,28 @@ _UNARY = {
     "Round": jnp.round,
     "LogicalNot": jnp.logical_not,
     "StopGradient": lambda x: x,  # inference import: gradient-free
+    "Elu": jax.nn.elu,
+    "Selu": jax.nn.selu,
+    "Softplus": jax.nn.softplus,
+    "Softsign": jax.nn.soft_sign,
+    "Sin": jnp.sin,
+    "Cos": jnp.cos,
+    "Tan": jnp.tan,
+    "Atan": jnp.arctan,
+    "Asin": jnp.arcsin,
+    "Acos": jnp.arccos,
+    "Sinh": jnp.sinh,
+    "Cosh": jnp.cosh,
+    "Asinh": jnp.arcsinh,
+    "Acosh": jnp.arccosh,
+    "Atanh": jnp.arctanh,
+    "Log1p": jnp.log1p,
+    "Expm1": jnp.expm1,
+    "Reciprocal": lambda x: 1.0 / x,
+    "Sign": jnp.sign,
+    "IsNan": jnp.isnan,
+    "IsInf": jnp.isinf,
+    "IsFinite": jnp.isfinite,
 }
 # reducers: name → jnp reduction
 _REDUCERS = {
@@ -988,6 +1017,7 @@ def program_from_graphdef(
         "GatherV2", "Einsum", "Transpose", "Select", "SelectV2",
         "BatchMatMulV2", "BatchMatMul",
         # multi-output tier: evaluate to tuples; consumers select via :k
+        "LeakyRelu",
         "Split", "SplitV", "Unpack", "TopKV2", "IdentityN",
         # function calls (un-frozen tf.function exports): bodies come
         # from the graph's FunctionDefLibrary and are validated below
@@ -1048,6 +1078,14 @@ def program_from_graphdef(
         )
 
     if quantize_weights:
+        if library:
+            raise ValueError(
+                "quantize_weights=True is not supported for graphs with a "
+                "function library (PartitionedCall bodies): the weight "
+                "planner only sees main-graph consumers, so quantization "
+                "would silently no-op. Freeze/inline the graph first "
+                "(convert_variables_to_constants_v2)."
+            )
         from .ops.quantize import quantize
 
         def resolve_const(name: str) -> Optional[str]:
@@ -1321,6 +1359,10 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
         kk = int(np.asarray(_concrete_operand(n, "k", args[1])))
         vals_tk, idx_tk = jax.lax.top_k(args[0], kk)
         return (vals_tk, idx_tk.astype(jnp.int32))
+    if op == "LeakyRelu":
+        al = n.attrs.get("alpha")
+        alpha = float(al.f) if al is not None and al.f is not None else 0.2
+        return jnp.where(args[0] > 0, args[0], args[0] * alpha)
     if op == "GatherV2":
         params_, indices, axis = args
         bd = n.attrs.get("batch_dims")

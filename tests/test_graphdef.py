@@ -486,3 +486,69 @@ def test_partitioned_call_unfrozen_tf_function():
         program_from_graphdef(
             parse_graphdef(cf2.graph.as_graph_def().SerializeToString())
         )
+
+
+def test_extended_elementwise_ops_match_tf():
+    """The long-tail activation/math tier (Elu/Selu/Softplus/LeakyRelu
+    with its alpha attr/trig/Log1p/...) — one TF-golden sweep."""
+    tf = pytest.importorskip("tensorflow")
+
+    from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+    rng = np.random.default_rng(3)
+    xv = (rng.standard_normal((4, 6)) * 0.8).astype(np.float32)
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 6], name="x")
+        tf.nn.elu(x, name="elu")
+        tf.nn.selu(x, name="selu")
+        tf.nn.softplus(x, name="softplus")
+        tf.nn.leaky_relu(x, alpha=0.1, name="leaky")
+        tf.math.sin(x, name="sin")
+        tf.math.atan2(x, x + 2.0, name="atan2")
+        tf.math.log1p(tf.abs(x), name="log1p")
+        tf.math.reciprocal(x + 3.0, name="recip")
+        tf.math.sign(x, name="sign")
+    data = g.as_graph_def().SerializeToString()
+    fetches = ["elu", "selu", "softplus", "leaky", "sin", "atan2",
+               "log1p", "recip", "sign"]
+    prog = program_from_graphdef(parse_graphdef(data), fetches=fetches)
+    got = prog.fn({"x": xv})
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run([f + ":0" for f in fetches], {"x:0": xv})
+    for name, w in zip(fetches, want):
+        np.testing.assert_allclose(
+            np.asarray(got[name]), w, atol=1e-6, err_msg=name
+        )
+
+
+def test_mod_truncated_semantics_and_quantize_library_guard():
+    """TF's Mod is truncated (sign of dividend), not floor-modulo; and
+    quantize_weights on a library-bearing graph is rejected loudly
+    rather than silently no-opping (round-3 review)."""
+    tf = pytest.importorskip("tensorflow")
+
+    from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [None], name="x")
+        tf.raw_ops.Mod(x=x, y=tf.constant([3.0]), name="m")
+    data = g.as_graph_def().SerializeToString()
+    prog = program_from_graphdef(parse_graphdef(data), fetches=["m"])
+    xv = np.asarray([-7.5, 7.5, -6.0], np.float32)
+    got = np.asarray(prog.fn({"x": xv})["m"])
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run("m:0", {"x:0": xv})
+    np.testing.assert_allclose(got, want)  # [-1.5, 1.5, -0.0]
+
+    @tf.function
+    def wrapped(x):
+        return tf.nn.relu(x)
+
+    @tf.function
+    def outer(x):
+        return wrapped(x) + 1.0
+
+    cf = outer.get_concrete_function(tf.TensorSpec([None, 2], tf.float32))
+    nodes = parse_graphdef(cf.graph.as_graph_def().SerializeToString())
+    with pytest.raises(ValueError, match="function library"):
+        program_from_graphdef(nodes, quantize_weights=True)
