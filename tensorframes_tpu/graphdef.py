@@ -144,6 +144,20 @@ def _parse_shape(data: bytes) -> Optional[List[int]]:
     return None if unknown_rank else dims
 
 
+class _StringTensor:
+    """A parsed DT_STRING TensorProto: inert unless consumed. Dead
+    string Consts (SavedModel saver cruft) must not break the import of
+    an otherwise-numeric graph."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values):
+        self.values = values
+
+    def __repr__(self):
+        return f"_StringTensor({len(self.values)} values)"
+
+
 def _parse_tensor(data: bytes) -> np.ndarray:
     """TensorProto → numpy. Handles tensor_content (field 4) and the typed
     ``*_val`` repeated fields (packed or not); a single value fills the
@@ -199,11 +213,18 @@ def _parse_tensor(data: bytes) -> np.ndarray:
                     x, pos = _read_varint(v, pos)
                     raw.append(x)
             vals.extend(("half_bits", x) for x in raw)
-        elif field == 8:  # string_val — device programs can't hold these
-            raise ValueError(
-                "TensorProto: string Const values are not executable "
-                "(strings are host-only; ≙ datatypes.scala:577-581)"
-            )
+        elif field == 8:  # string_val — host-only; see _StringTensor
+            vals.append(("string_val", v))
+    if dtype is dt.string or any(
+        isinstance(x, tuple) and x and x[0] == "string_val" for x in vals
+    ):
+        # String Consts PARSE (SavedModel graphs carry dead saver/config
+        # strings) but are rejected the moment a device program actually
+        # CONSUMES one (strings are host-only; ≙ datatypes.scala:577-581)
+        return _StringTensor(
+            [x[1] for x in vals if isinstance(x, tuple)
+             and x and x[0] == "string_val"]
+        )
     np_dtype = dtype.np_dtype
     size = int(np.prod(shape)) if shape else 1
     if content:
@@ -466,7 +487,8 @@ _BINARY = {
     "LogicalOr": jnp.logical_or,
     "Atan2": jnp.arctan2,
     # TF's Mod is C-style TRUNCATED modulo (sign of the dividend);
-    # jnp.mod is floor-modulo — lax.rem has the right semantics
+    # jnp.mod is floor-modulo — lax.rem / np.fmod have the right
+    # semantics
     "Mod": jax.lax.rem,
     "TruncateDiv": lambda a, b: jnp.trunc(a / b).astype(a.dtype)
     if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
@@ -535,6 +557,15 @@ _REDUCERS = {
 # shapes: `tf.shape` of a traced array is static at trace time, so the
 # whole multiples chain folds to host integers before jnp.tile sees it.
 _BINARY_NP = {
+    "Atan2": np.arctan2,
+    "Mod": np.fmod,  # truncated, like lax.rem
+    "TruncateDiv": lambda a, b: np.trunc(np.true_divide(a, b)).astype(
+        np.asarray(a).dtype
+    )
+    if np.issubdtype(np.asarray(a).dtype, np.floating)
+    else (np.sign(a) * np.sign(b) * (np.abs(a) // np.abs(b))).astype(
+        np.asarray(a).dtype
+    ),
     "SquaredDifference": lambda a, b: np.square(a - b),
     "Greater": np.greater,
     "GreaterEqual": np.greater_equal,
@@ -958,6 +989,17 @@ def program_from_graphdef(
             f"fetch(es) {missing} not in graph; nodes: {sorted(by_name)}"
         )
     for f in fetches:
+        fnode = by_name[_base(f)]
+        if fnode.op == "Const" and isinstance(
+            (fnode.attrs.get("value").tensor
+             if fnode.attrs.get("value") is not None else None),
+            _StringTensor,
+        ):
+            raise ValueError(
+                f"fetch {f!r} is a string Const — string values are not "
+                "executable on device (host-only; "
+                "≙ datatypes.scala:577-581)"
+            )
         # same producer rule as consumer refs: a ':k>0' fetch of a
         # single-output node would silently receive output :0
         if ":" in f:
@@ -983,10 +1025,29 @@ def program_from_graphdef(
                         f"{_num_outputs(producer, library)} outputs"
                     )
 
-    # placeholders → program inputs
+    # restrict validation + program inputs to the nodes the evaluator
+    # can actually reach from the fetches through DATA refs (the
+    # evaluator never follows control deps) — a SavedModel main graph
+    # carries a dead saver subgraph (SaveV2/RestoreV2/StringJoin + a
+    # string filename Placeholder) that must not poison the import
+    reachable = set()
+    _stack = [_base(f) for f in fetches]
+    while _stack:
+        _nm = _stack.pop()
+        if _nm in reachable or _nm not in by_name:
+            continue
+        reachable.add(_nm)
+        _stack.extend(
+            _base(r) for r in by_name[_nm].inputs if not r.startswith("^")
+        )
+
+    # placeholders → program inputs (reachable only: a SavedModel's
+    # saver filename placeholder must not become a program input)
     inputs: List[TensorSpec] = []
     consts: Dict[str, np.ndarray] = {}
     for n in nodes:
+        if n.name not in reachable:
+            continue
         if n.op == "Placeholder":
             a = n.attrs.get("dtype")
             dtype = _TF_DTYPES.get(a.type if a else 1, dt.float32)
@@ -1029,6 +1090,8 @@ def program_from_graphdef(
         unsupported-op gate covers function bodies too."""
         pending = []
         for n in nodes:
+            if n.name not in reachable:
+                continue
             if n.op in ("PartitionedCall", "StatefulPartitionedCall"):
                 fattr = n.attrs.get("f")
                 if fattr is None or not fattr.func:
@@ -1063,7 +1126,8 @@ def program_from_graphdef(
     unsupported = sorted(
         {
             n.op
-            for n in list(nodes) + list(_walk_function_nodes(set()))
+            for n in [x for x in nodes if x.name in reachable]
+            + list(_walk_function_nodes(set()))
             if n.op not in structural
             and n.op not in _BINARY
             and n.op not in _UNARY
@@ -1207,6 +1271,12 @@ def program_from_graphdef(
         out = {}
         for f in fetch_list:
             v = _select_output(materialize(_base(f)), f)
+            if isinstance(v, _StringTensor):
+                raise ValueError(
+                    f"fetch {f!r} is a string Const — string values are "
+                    "not executable on device (host-only; "
+                    "≙ datatypes.scala:577-581)"
+                )
             if isinstance(v, QuantizedTensor):  # directly-fetched weight
                 v = v.dequantize(jnp.float32)
             # shape-arith fetches come back as host numpy; normalize to
@@ -1234,6 +1304,13 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
 
     name = n.name
     op = n.op
+    for a in args:
+        if isinstance(a, _StringTensor):
+            raise ValueError(
+                f"node {name!r} ({op}) consumes a string Const — string "
+                "values are not executable on device (host-only; "
+                "≙ datatypes.scala:577-581)"
+            )
 
     def mxu(x):
         """Serving-precision cast for MXU operands: f32 → compute_dtype
@@ -1361,7 +1438,12 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
         return (vals_tk, idx_tk.astype(jnp.int32))
     if op == "LeakyRelu":
         al = n.attrs.get("alpha")
-        alpha = float(al.f) if al is not None and al.f is not None else 0.2
+        if al is None:
+            alpha = 0.2  # attr absent entirely: TF's op-def default
+        else:
+            # proto3 omits 0.0 from the wire, so a PRESENT attr with no
+            # f field means an explicit alpha=0.0, not the default
+            alpha = float(al.f) if al.f is not None else 0.0
         return jnp.where(args[0] > 0, args[0], args[0] * alpha)
     if op == "GatherV2":
         params_, indices, axis = args
@@ -1531,6 +1613,57 @@ def load_graphdef(
     return analyze_program(program)
 
 
+def parse_saved_model(data: bytes):
+    """Decode ``saved_model.pb`` (saved_model.proto) without TensorFlow:
+    returns ``(GraphNodes, signatures)`` where ``signatures`` maps each
+    signature key to ``{"inputs": {arg: tensor_ref}, "outputs": {...}}``
+    (TensorInfo names like ``"StatefulPartitionedCall:0"``). Wire path:
+    SavedModel.meta_graphs[0] (field 2) → MetaGraphDef.graph_def
+    (field 2) + signature_def map (field 5)."""
+    nodes = None
+    signatures: Dict[str, Dict[str, Dict[str, str]]] = {}
+    try:
+        for field, _, v in _iter_fields(data):
+            if field != 2:
+                continue
+            for f2, _, v2 in _iter_fields(v):
+                if f2 == 2:
+                    nodes = parse_graphdef(v2)
+                elif f2 == 5:  # map<string, SignatureDef> entry
+                    key = None
+                    sig = {"inputs": {}, "outputs": {}}
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            key = v3.decode("utf-8")
+                        elif f3 == 2:  # SignatureDef
+                            for f4, _, v4 in _iter_fields(v3):
+                                if f4 in (1, 2):  # inputs/outputs map
+                                    io_name = ref = None
+                                    for f5, _, v5 in _iter_fields(v4):
+                                        if f5 == 1:
+                                            io_name = v5.decode("utf-8")
+                                        elif f5 == 2:  # TensorInfo
+                                            for f6, _, v6 in _iter_fields(v5):
+                                                if f6 == 1:
+                                                    ref = v6.decode("utf-8")
+                                    if io_name is not None and ref:
+                                        side = (
+                                            "inputs" if f4 == 1 else "outputs"
+                                        )
+                                        sig[side][io_name] = ref
+                    if key is not None:
+                        signatures[key] = sig
+            break  # first MetaGraphDef (the serving graph)
+    except (IndexError, struct.error, UnicodeDecodeError, _WireError) as e:
+        raise ValueError(
+            f"not a valid serialized SavedModel ({type(e).__name__} while "
+            f"decoding: {e})"
+        ) from e
+    if nodes is None:
+        raise ValueError("SavedModel contains no MetaGraphDef graph")
+    return nodes, signatures
+
+
 def load_saved_model(
     path: str,
     signature: str = "serving_default",
@@ -1539,14 +1672,72 @@ def load_saved_model(
     quantize_weights: bool = False,
     compute_dtype: Optional[str] = None,
 ) -> Program:
-    """Import a TF SavedModel signature: freeze its variables to
-    constants (requires tensorflow at CONVERSION time only — scoring is
-    TF-free) and lower the frozen graph like :func:`load_graphdef`.
+    """Import a TF SavedModel signature.
+
+    VARIABLE-FREE models (pure ``tf.function`` exports) import with NO
+    TensorFlow at all: the bundled clean-room parser reads
+    ``saved_model.pb`` directly and the PartitionedCall bodies evaluate
+    from the graph's function library. Models with variables fall back
+    to freezing via TensorFlow (required at CONVERSION time only —
+    scoring is always TF-free).
 
     Migration affordance beyond the reference (which took raw GraphDefs
     only): modern TF users hold SavedModels. Without tensorflow
-    installed, freeze offline and ship the ``GraphDef`` instead.
+    installed, variable-bearing models must be frozen offline
+    (convert_variables_to_constants_v2) and shipped as ``GraphDef``.
     """
+    import os as _os
+
+    pb = _os.path.join(path, "saved_model.pb")
+    if _os.path.exists(pb):
+        with open(pb, "rb") as fh:
+            nodes, signatures = parse_saved_model(fh.read())
+        has_vars = any(
+            n.op in ("VarHandleOp", "VariableV2", "ReadVariableOp")
+            for n in nodes
+        )
+        if not has_vars and signatures:
+            if signature not in signatures:
+                raise KeyError(
+                    f"SavedModel has no signature {signature!r}; "
+                    f"available: {sorted(signatures)}"
+                )
+            sig = signatures[signature]
+            sig_fetches = fetches
+            rename = None
+            if sig_fetches is None:
+                # fetch the signature's output tensors, then rename the
+                # result columns to the signature's output-arg names
+                sig_fetches = []
+                rename = {}
+                for out_name, ref in sorted(sig["outputs"].items()):
+                    f = ref[:-2] if ref.endswith(":0") else ref
+                    sig_fetches.append(f)
+                    rename[f] = out_name
+            program = program_from_graphdef(
+                nodes,
+                fetches=sig_fetches,
+                relax_lead_dim=relax_lead_dim,
+                quantize_weights=quantize_weights,
+                compute_dtype=compute_dtype,
+            )
+            if rename:
+                inner = program.fn
+                rmap = dict(rename)
+
+                def renamed(feeds, _inner=inner, _rmap=rmap):
+                    return {
+                        _rmap.get(k, k): v for k, v in _inner(feeds).items()
+                    }
+
+                program = Program(
+                    renamed,
+                    program.inputs,
+                    fetch_order=[
+                        rmap.get(f, f) for f in program.fetch_order
+                    ],
+                )
+            return analyze_program(program)
     try:
         import tensorflow as tf
         from tensorflow.python.framework.convert_to_constants import (
@@ -1554,9 +1745,10 @@ def load_saved_model(
         )
     except ImportError as e:
         raise ImportError(
-            "load_saved_model needs tensorflow to freeze the signature's "
-            "variables; freeze offline (convert_variables_to_constants_v2) "
-            "and use load_graphdef on the result instead"
+            "this SavedModel holds variables, and freezing them needs "
+            "tensorflow; freeze offline (convert_variables_to_constants_v2) "
+            "and use load_graphdef on the result instead (variable-FREE "
+            "SavedModels load without tensorflow)"
         ) from e
     m = tf.saved_model.load(path)
     if signature not in m.signatures:

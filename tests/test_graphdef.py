@@ -150,12 +150,30 @@ def test_half_val_const_decodes_bit_patterns():
     np.testing.assert_array_equal(arr, np.asarray([1.5, 2.5], np.float16))
 
 
-def test_string_const_raises():
-    from tensorframes_tpu.graphdef import _parse_tensor
+def test_string_const_rejected_on_consumption():
+    """String Consts PARSE (SavedModel graphs carry dead saver strings)
+    but consuming or fetching one raises — the host-only contract moved
+    from parse time to use time in round 3."""
+    from tensorframes_tpu.graphdef import _StringTensor, _parse_tensor
 
     proto = b"\x08\x07" + b"\x42\x02hi"  # dtype=DT_STRING, string_val="hi"
+    t = _parse_tensor(proto)
+    assert isinstance(t, _StringTensor) and t.values == [b"hi"]
+
+    tf = pytest.importorskip("tensorflow")
+    with tf.Graph().as_default() as g:
+        tf.constant("dead-string", name="s")        # never consumed
+        x = tf.compat.v1.placeholder(tf.float32, [None], name="x")
+        tf.identity(x * 2.0, name="y")
+    data = g.as_graph_def().SerializeToString()
+    prog = program_from_graphdef(parse_graphdef(data), fetches=["y"])
+    out = prog.fn({"x": np.asarray([1.0, 2.0], np.float32)})
+    np.testing.assert_allclose(np.asarray(out["y"]), [2.0, 4.0])
+
+    # fetching the string const raises at IMPORT with the host-only
+    # message (consts are fully known then)
     with pytest.raises(ValueError, match="string"):
-        _parse_tensor(proto)
+        program_from_graphdef(parse_graphdef(data), fetches=["s"])
 
 
 def test_malformed_bytes_raise_value_error():

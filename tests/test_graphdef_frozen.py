@@ -10,6 +10,8 @@ ops), executes through ``map_blocks``, and cross-checks against TF
 running the very same frozen bytes — the ExtractNodes-style golden
 oracle at full-model scale."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -549,3 +551,58 @@ def test_bf16_int8_import_roundtrips_stablehlo(tmp_path):
     want = np.asarray(prog.fn({prog.inputs[0].name: x})[prog.fetch_order[0]])
     got = np.asarray(back.fn({back.inputs[0].name: x})[back.fetch_order[0]])
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_saved_model_variable_free_loads_without_tensorflow(tmp_path):
+    """A VARIABLE-FREE SavedModel (pure tf.function export) loads with
+    NO TensorFlow: the clean-room parser reads saved_model.pb directly
+    (MetaGraphDef graph + signature map), prunes the dead saver
+    subgraph via data reachability, and evaluates the PartitionedCall
+    body from the function library. TF is used here only to BUILD the
+    fixture; the load runs in a subprocess with tensorflow imports
+    blocked."""
+    import subprocess
+    import sys
+
+    class M(tf.Module):
+        @tf.function(
+            input_signature=[tf.TensorSpec([None, 4], tf.float32)]
+        )
+        def score(self, x):
+            w = tf.constant(np.ones((4, 2), np.float32))
+            return {"out": tf.nn.relu(x) @ w}
+
+    m = M()
+    sm = str(tmp_path / "sm_pure")
+    tf.saved_model.save(m, sm, signatures={"serving_default": m.score})
+
+    probe = (
+        "import builtins\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    if name == 'tensorflow' or name.startswith('tensorflow.'):\n"
+        "        raise ImportError('TF BLOCKED')\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "import numpy as np\n"
+        "import tensorframes_tpu as tfs\n"
+        f"prog = tfs.load_saved_model({sm!r}, relax_lead_dim=True)\n"
+        "x = np.arange(12, dtype=np.float32).reshape(3, 4) - 5.0\n"
+        "got = np.asarray(prog.fn({prog.inputs[0].name: x})"
+        "[prog.fetch_order[0]])\n"
+        "want = np.maximum(x, 0) @ np.ones((4, 2), np.float32)\n"
+        "assert np.allclose(got, want), (got, want)\n"
+        "print('TFFREE-OK')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert proc.returncode == 0 and "TFFREE-OK" in proc.stdout, (
+        proc.stdout[-1500:] + proc.stderr[-1500:]
+    )
