@@ -954,27 +954,6 @@ def program_from_graphdef(
     for n in nodes:
         for ref in n.inputs:
             consumed.add(_base(ref))
-            # output :k>0 is legal only for registered MULTI-OUTPUT ops
-            # (Split/SplitV/Unpack/TopKV2 return tuples); for any other
-            # producer (FusedBatchNorm's batch stats, …) it would
-            # silently receive output :0 — reject it up front
-            if not ref.startswith("^") and ":" in ref:
-                idx = ref.rsplit(":", 1)[1]
-                if idx.isdigit() and int(idx) > 0:
-                    producer = by_name.get(_base(ref))
-                    if producer is None or producer.op not in _MULTI_OUTPUT:
-                        raise ValueError(
-                            f"node {n.name!r} consumes output {ref!r}; "
-                            "only multi-output ops "
-                            f"({sorted(_MULTI_OUTPUT)}) expose outputs "
-                            "past :0"
-                        )
-                    if int(idx) >= _num_outputs(producer, library):
-                        raise ValueError(
-                            f"node {n.name!r} consumes output {ref!r} but "
-                            f"{producer.op} node {producer.name!r} has "
-                            f"{_num_outputs(producer, library)} outputs"
-                        )
     if fetches is None:
         fetches = [
             n.name
@@ -1041,6 +1020,33 @@ def program_from_graphdef(
             _base(r) for r in by_name[_nm].inputs if not r.startswith("^")
         )
 
+    # output :k>0 is legal only for registered MULTI-OUTPUT ops; for any
+    # other producer (FusedBatchNorm's batch stats, …) it would silently
+    # receive output :0 — reject it up front. Only REACHABLE consumers
+    # matter: dead saver subgraphs consume :1 outputs of ops the
+    # evaluator never touches
+    for n in nodes:
+        if n.name not in reachable:
+            continue
+        for ref in n.inputs:
+            if not ref.startswith("^") and ":" in ref:
+                idx = ref.rsplit(":", 1)[1]
+                if idx.isdigit() and int(idx) > 0:
+                    producer = by_name.get(_base(ref))
+                    if producer is None or producer.op not in _MULTI_OUTPUT:
+                        raise ValueError(
+                            f"node {n.name!r} consumes output {ref!r}; "
+                            "only multi-output ops "
+                            f"({sorted(_MULTI_OUTPUT)}) expose outputs "
+                            "past :0"
+                        )
+                    if int(idx) >= _num_outputs(producer, library):
+                        raise ValueError(
+                            f"node {n.name!r} consumes output {ref!r} but "
+                            f"{producer.op} node {producer.name!r} has "
+                            f"{_num_outputs(producer, library)} outputs"
+                        )
+
     # placeholders → program inputs (reachable only: a SavedModel's
     # saver filename placeholder must not become a program input)
     inputs: List[TensorSpec] = []
@@ -1079,6 +1085,8 @@ def program_from_graphdef(
         "BatchMatMulV2", "BatchMatMul",
         # multi-output tier: evaluate to tuples; consumers select via :k
         "LeakyRelu",
+        "Slice", "ZerosLike", "OnesLike", "BroadcastTo", "OneHot",
+        "Cumsum", "Cumprod", "Rank", "Size",
         "Split", "SplitV", "Unpack", "TopKV2", "IdentityN",
         # function calls (un-frozen tf.function exports): bodies come
         # from the graph's FunctionDefLibrary and are validated below
@@ -1436,6 +1444,73 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
         kk = int(np.asarray(_concrete_operand(n, "k", args[1])))
         vals_tk, idx_tk = jax.lax.top_k(args[0], kk)
         return (vals_tk, idx_tk.astype(jnp.int32))
+    if op == "Slice":
+        begin = [int(d) for d in np.asarray(
+            _concrete_operand(n, "begin", args[1])
+        )]
+        size = [int(d) for d in np.asarray(
+            _concrete_operand(n, "size", args[2])
+        )]
+        x_ = args[0]
+        lims = []
+        for i, (b, s) in enumerate(zip(begin, size)):
+            e = b + (s if s >= 0 else x_.shape[i] - b)
+            if b < 0 or e > x_.shape[i]:
+                raise ValueError(
+                    f"Slice node {name!r}: begin+size {b}+{s} out of "
+                    f"range for dim {i} of size {x_.shape[i]} (TF "
+                    "rejects this; no silent clipping)"
+                )
+            lims.append(e)
+        sl = tuple(slice(b, e) for b, e in zip(begin, lims))
+        return x_[sl]
+    if op == "ZerosLike":
+        if _is_concrete(args[0]):
+            return np.zeros_like(args[0])
+        return jnp.zeros_like(args[0])
+    if op == "OnesLike":
+        if _is_concrete(args[0]):
+            return np.ones_like(args[0])
+        return jnp.ones_like(args[0])
+    if op == "BroadcastTo":
+        shp = tuple(
+            int(d) for d in np.asarray(
+                _concrete_operand(n, "shape", args[1])
+            )
+        )
+        if _is_concrete(args[0]):
+            return np.broadcast_to(args[0], shp)
+        return jnp.broadcast_to(args[0], shp)
+    if op == "OneHot":
+        depth = int(np.asarray(_concrete_operand(n, "depth", args[1])))
+        on_v, off_v = args[2], args[3]
+        ax_attr = n.attrs.get("axis")
+        ax = int(ax_attr.i) if ax_attr is not None and ax_attr.i is not None else -1
+        oh = jax.nn.one_hot(jnp.asarray(args[0]), depth, axis=ax)
+        return (oh * on_v + (1 - oh) * off_v).astype(
+            jnp.result_type(on_v, off_v)
+        )
+    if op in ("Cumsum", "Cumprod"):
+        ax = int(np.asarray(_concrete_operand(n, "axis", args[1])))
+        exclusive = n.attrs.get("exclusive")
+        reverse = n.attrs.get("reverse")
+        if (exclusive and exclusive.b) or (reverse and reverse.b):
+            raise ValueError(
+                f"{op} node {name!r}: exclusive/reverse modes unsupported"
+            )
+        if _is_concrete(args[0]):
+            # shape-arithmetic chains (cumprod of a Shape = strides)
+            # must stay host-concrete
+            fn_np = np.cumsum if op == "Cumsum" else np.cumprod
+            return fn_np(np.asarray(args[0]), axis=ax)
+        fn_ = jnp.cumsum if op == "Cumsum" else jnp.cumprod
+        return fn_(args[0], axis=ax)
+    if op == "Rank":
+        return np.asarray(np.ndim(args[0]), np.int32)
+    if op == "Size":
+        ot = n.attrs.get("out_type")
+        out_dt_ = _TF_DTYPES.get(ot.type, dt.int32) if ot is not None else dt.int32
+        return np.asarray(int(np.prod(np.shape(args[0]))), out_dt_.np_dtype)
     if op == "LeakyRelu":
         al = n.attrs.get("alpha")
         if al is None:
@@ -1654,7 +1729,9 @@ def parse_saved_model(data: bytes):
                     if key is not None:
                         signatures[key] = sig
             break  # first MetaGraphDef (the serving graph)
-    except (IndexError, struct.error, UnicodeDecodeError, _WireError) as e:
+    except (
+        IndexError, TypeError, struct.error, UnicodeDecodeError, _WireError,
+    ) as e:
         raise ValueError(
             f"not a valid serialized SavedModel ({type(e).__name__} while "
             f"decoding: {e})"
@@ -1707,13 +1784,17 @@ def load_saved_model(
             rename = None
             if sig_fetches is None:
                 # fetch the signature's output tensors, then rename the
-                # result columns to the signature's output-arg names
+                # result columns to the signature's output-arg names —
+                # several output names may ALIAS one tensor, so the map
+                # is fetch → [names]
                 sig_fetches = []
                 rename = {}
                 for out_name, ref in sorted(sig["outputs"].items()):
                     f = ref[:-2] if ref.endswith(":0") else ref
-                    sig_fetches.append(f)
-                    rename[f] = out_name
+                    if f not in rename:
+                        sig_fetches.append(f)
+                        rename[f] = []
+                    rename[f].append(out_name)
             program = program_from_graphdef(
                 nodes,
                 fetches=sig_fetches,
@@ -1726,17 +1807,33 @@ def load_saved_model(
                 rmap = dict(rename)
 
                 def renamed(feeds, _inner=inner, _rmap=rmap):
-                    return {
-                        _rmap.get(k, k): v for k, v in _inner(feeds).items()
-                    }
+                    out = {}
+                    for k, v in _inner(feeds).items():
+                        for nm2 in _rmap.get(k, [k]):
+                            out[nm2] = v
+                    return out
 
                 program = Program(
                     renamed,
                     program.inputs,
                     fetch_order=[
-                        rmap.get(f, f) for f in program.fetch_order
+                        nm2
+                        for f in program.fetch_order
+                        for nm2 in rmap.get(f, [f])
                     ],
                 )
+            # inputs follow the signature's declared arg names too (the
+            # TF-freeze path exposes these; graph placeholders carry
+            # mangled 'serving_default_*' names)
+            in_rename = {}
+            for arg_name, ref in sig["inputs"].items():
+                ph = ref[:-2] if ref.endswith(":0") else ref
+                if ph != arg_name and ph in [
+                    i.name for i in program.inputs
+                ]:
+                    in_rename[ph] = arg_name
+            if in_rename:
+                program = program.rename_inputs(in_rename)
             return analyze_program(program)
     try:
         import tensorflow as tf

@@ -93,10 +93,10 @@ def test_unsupported_op_raises_with_name():
     tf = pytest.importorskip("tensorflow")
     g = tf.Graph()
     with g.as_default():
-        x = tf.compat.v1.placeholder(tf.float32, shape=[2], name="x")
-        tf.raw_ops.Cumsum(x=x, axis=0, name="c")
+        x = tf.compat.v1.placeholder(tf.float32, shape=[2, 2], name="x")
+        tf.linalg.cholesky(x, name="c")
     data = g.as_graph_def().SerializeToString()
-    with pytest.raises(ValueError, match="Cumsum"):
+    with pytest.raises(ValueError, match="Cholesky"):
         program_from_graphdef(parse_graphdef(data))
 
 
@@ -493,14 +493,16 @@ def test_partitioned_call_unfrozen_tf_function():
     # unsupported ops INSIDE function bodies are named at import time
     @tf.function
     def bad(x):
-        return tf.cumsum(x, axis=0)
+        return tf.linalg.cholesky(x)
 
     @tf.function
     def calls_bad(x):
         return bad(x) + 1.0
 
-    cf2 = calls_bad.get_concrete_function(tf.TensorSpec([None, 4], tf.float32))
-    with pytest.raises(ValueError, match="Cumsum"):
+    cf2 = calls_bad.get_concrete_function(
+        tf.TensorSpec([None, 4, 4], tf.float32)
+    )
+    with pytest.raises(ValueError, match="Cholesky"):
         program_from_graphdef(
             parse_graphdef(cf2.graph.as_graph_def().SerializeToString())
         )
@@ -570,3 +572,50 @@ def test_mod_truncated_semantics_and_quantize_library_guard():
     nodes = parse_graphdef(cf.graph.as_graph_def().SerializeToString())
     with pytest.raises(ValueError, match="function library"):
         program_from_graphdef(nodes, quantize_weights=True)
+
+
+def test_shape_and_scan_op_tier_matches_tf():
+    """Slice/ZerosLike/OnesLike/BroadcastTo/OneHot/Cumsum/Cumprod/Rank/
+    Size — TF-golden sweep; Cumsum's exclusive/reverse modes reject by
+    name."""
+    tf = pytest.importorskip("tensorflow")
+
+    from tensorframes_tpu.graphdef import parse_graphdef, program_from_graphdef
+
+    rng = np.random.default_rng(5)
+    xv = rng.standard_normal((3, 6)).astype(np.float32)
+    iv = rng.integers(0, 4, (3,)).astype(np.int32)
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [None, 6], name="x")
+        idx = tf.compat.v1.placeholder(tf.int32, [None], name="idx")
+        tf.slice(x, [0, 2], [-1, 3], name="sl")
+        tf.zeros_like(x, name="zl")
+        tf.ones_like(x, name="ol")
+        tf.broadcast_to(tf.reduce_sum(x, axis=1, keepdims=True), [3, 6],
+                        name="bc")
+        tf.one_hot(idx, 4, on_value=2.0, off_value=-1.0, name="oh")
+        tf.cumsum(x, axis=1, name="cs")
+        tf.math.cumprod(tf.abs(x) + 0.5, axis=0, name="cp")
+        tf.add(tf.cast(tf.rank(x), tf.float32),
+               tf.cast(tf.size(x), tf.float32), name="rs")
+    data = g.as_graph_def().SerializeToString()
+    fetches = ["sl", "zl", "ol", "bc", "oh", "cs", "cp", "rs"]
+    prog = program_from_graphdef(parse_graphdef(data), fetches=fetches)
+    got = prog.fn({"x": xv, "idx": iv})
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run([f + ":0" for f in fetches],
+                        {"x:0": xv, "idx:0": iv})
+    for name, w in zip(fetches, want):
+        np.testing.assert_allclose(
+            np.asarray(got[name]), w, atol=1e-6, err_msg=name
+        )
+
+    with tf.Graph().as_default() as g2:
+        x2 = tf.compat.v1.placeholder(tf.float32, [None, 4], name="x")
+        tf.cumsum(x2, axis=1, exclusive=True, name="bad")
+    with pytest.raises(ValueError, match="exclusive"):
+        prog2 = program_from_graphdef(
+            parse_graphdef(g2.as_graph_def().SerializeToString()),
+            fetches=["bad"],
+        )
+        prog2.fn({"x": np.ones((2, 4), np.float32)})

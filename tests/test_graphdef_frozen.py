@@ -606,3 +606,23 @@ def test_saved_model_variable_free_loads_without_tensorflow(tmp_path):
     assert proc.returncode == 0 and "TFFREE-OK" in proc.stdout, (
         proc.stdout[-1500:] + proc.stderr[-1500:]
     )
+
+    # signature-faithful IO naming in-process too: inputs use the
+    # signature arg name ('x', not the mangled graph placeholder), and
+    # ALIASED output names both materialize
+    class M2(tf.Module):
+        @tf.function(
+            input_signature=[tf.TensorSpec([None, 3], tf.float32)]
+        )
+        def score(self, x):
+            y = x * 2.0
+            return {"a": y, "b": y}
+
+    m2 = M2()
+    sm2 = str(tmp_path / "sm_alias")
+    tf.saved_model.save(m2, sm2, signatures={"serving_default": m2.score})
+    prog = tfs.load_saved_model(sm2, relax_lead_dim=True)
+    assert [i.name for i in prog.inputs] == ["x"]
+    out = prog.fn({"x": np.ones((2, 3), np.float32)})
+    assert sorted(prog.fetch_order) == ["a", "b"]
+    np.testing.assert_allclose(np.asarray(out["a"]), np.asarray(out["b"]))
