@@ -49,6 +49,14 @@ logger = get_logger(__name__)
 Block = Dict[str, Union[np.ndarray, list]]
 
 
+def _non_addressable(v) -> bool:
+    """True for a jax Array whose shards span processes (no process can
+    materialize it alone)."""
+    return (
+        hasattr(v, "is_fully_addressable") and not v.is_fully_addressable
+    )
+
+
 def _block_num_rows(block: Block) -> int:
     for v in block.values():
         return len(v)
@@ -259,6 +267,72 @@ class TensorFrame:
         return TensorFrame(
             None, schema, pending=lambda: [{n: b[n] for n in names} for b in parent.blocks()]
         )
+
+    def filter(self, predicate) -> "TensorFrame":
+        """Keep the rows where ``predicate`` is true.
+
+        ``predicate`` is a program like any verb's — a python function
+        over block columns (parameter names select columns), DSL nodes,
+        or a Program — producing ONE boolean output of shape ``[rows]``.
+        The mask computes on device through ``map_blocks``; rows subset
+        per block (device columns boolean-gather, host columns
+        compress). Lazy like the verbs: the mask computes when the
+        frame is forced. The reference had no filter — Spark's
+        ``where`` ran before tensorframes saw the data; standalone
+        frames need it native. Sharded frames force to a host-backed
+        frame (row-dropping is data-dependent — call ``.to_device()``
+        to re-shard); multi-process frames raise with the
+        ``column_values`` guidance.
+        """
+        from .ops.verbs import map_blocks
+
+        masked = map_blocks(predicate, self)
+        out_names = [
+            c.name for c in masked.schema if c.name not in self.schema.names
+        ]
+        if len(out_names) != 1:
+            raise ValueError(
+                f"filter predicate must produce exactly one output; got "
+                f"{out_names}"
+            )
+        mname = out_names[0]
+        schema = self.schema
+        names = list(schema.names)
+
+        def compute() -> List[Block]:
+            new_blocks: List[Block] = []
+            for b in masked.blocks():
+                mv = b[mname]
+                if _non_addressable(mv):
+                    # multi-process: the mask (and the columns) span
+                    # processes — same actionable guidance as
+                    # column_values, not a raw JAX addressability error
+                    raise RuntimeError(
+                        "filter: columns span processes — one process "
+                        "cannot subset the global frame. Filter before "
+                        "frame_from_process_local, or reduce with a verb "
+                        "(verbs run as collectives)."
+                    )
+                m = np.asarray(mv)
+                if m.dtype != np.bool_ or m.ndim != 1:
+                    raise ValueError(
+                        f"filter predicate output {mname!r} must be "
+                        f"bool[rows]; got {m.dtype} with shape {m.shape}"
+                    )
+                nb: Block = {}
+                for name in names:
+                    v = b[name]
+                    if isinstance(v, list):
+                        nb[name] = [x for x, keep in zip(v, m) if keep]
+                    else:
+                        nb[name] = np.asarray(v)[m]
+                new_blocks.append(nb)
+            return new_blocks
+
+        # lazy like every sibling transform: the mask + gather run when
+        # blocks()/collect() force the frame, so chained verbs keep
+        # their one-materialization contract
+        return TensorFrame(None, schema, pending=compute)
 
     def with_column_renamed(self, old: str, new: str) -> "TensorFrame":
         schema = Schema(

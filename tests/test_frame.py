@@ -278,3 +278,52 @@ def test_take_and_groupby_count():
     got = {r["k"]: r["count"] for r in counted.collect()}
     for key in np.unique(k):
         assert got[int(key)] == int((k == key).sum())
+
+
+def test_filter_plain_function():
+    """df.filter keeps matching rows across device and host columns; the
+    mask computes on device via map_blocks (the reference had no filter
+    — Spark's `where` ran upstream; standalone frames need it native)."""
+    df = tfs.frame_from_arrays(
+        {"x": np.arange(10, dtype=np.float32)}, num_blocks=3
+    )
+    out = df.filter(lambda x: {"keep": x > 4.0})
+    vals = np.asarray(out.column_values("x"))
+    np.testing.assert_array_equal(vals, np.arange(5, 10, dtype=np.float32))
+    assert out.schema.names == df.schema.names
+
+
+def test_filter_host_columns_and_sharded():
+    rows = [{"x": float(i), "tag": f"r{i}"} for i in range(8)]
+    df = tfs.frame_from_rows(rows, num_blocks=2)
+    out = df.filter(lambda x: {"keep": (x % 2.0) == 0.0})
+    got = out.collect()
+    assert [r["tag"] for r in got] == ["r0", "r2", "r4", "r6"]
+
+    dev = tfs.frame_from_arrays(
+        {"x": np.arange(16, dtype=np.float32)}
+    ).to_device()
+    flt = dev.filter(lambda x: {"keep": x < 3.0})
+    np.testing.assert_array_equal(
+        np.asarray(flt.column_values("x")), [0.0, 1.0, 2.0]
+    )
+
+
+def test_filter_bad_predicate_errors():
+    df = tfs.frame_from_arrays({"x": np.arange(4, dtype=np.float32)})
+    with pytest.raises(ValueError, match="bool"):
+        # dtype is only knowable when the mask computes — at force time
+        df.filter(lambda x: {"keep": x * 2.0}).collect()
+    with pytest.raises(ValueError, match="exactly one"):
+        df.filter(lambda x: {"a": x > 1.0, "b": x > 2.0})
+
+
+def test_filter_is_lazy():
+    # like every sibling transform, filter returns a PENDING frame —
+    # the mask+gather run when blocks()/collect() force it (tracing for
+    # schema analysis happens eagerly; data computation does not)
+    df = tfs.frame_from_arrays({"x": np.arange(4, dtype=np.float32)})
+    flt = df.filter(lambda x: {"keep": x > 1.0})
+    assert not flt.is_materialized
+    got = np.asarray(flt.column_values("x"))
+    np.testing.assert_array_equal(got, [2.0, 3.0])
