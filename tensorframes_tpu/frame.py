@@ -334,6 +334,115 @@ class TensorFrame:
         # their one-materialization contract
         return TensorFrame(None, schema, pending=compute)
 
+    def sort_values(self, by, ascending: bool = True) -> "TensorFrame":
+        """Rows ordered by one or more key columns (stable: ties keep
+        their input order, ascending OR descending; multiple keys sort
+        lexicographically, first key primary). Global across blocks —
+        the result is one block, like ``repartition(1)``. Another
+        affordance the reference left to Spark (``orderBy``). Lazy;
+        multi-process frames raise the ``column_values`` guidance.
+        """
+        keys = [by] if isinstance(by, str) else list(by)
+        for k in keys:
+            self.schema[k]  # unknown column: raise now, not at force
+        schema = self.schema
+        names = list(schema.names)
+        parent = self
+
+        def compute() -> List[Block]:
+            blocks = parent.blocks()
+            merged: Block = {}
+            for name in names:
+                vals = [b[name] for b in blocks]
+                if any(_non_addressable(v) for v in vals):
+                    raise RuntimeError(
+                        "sort_values: columns span processes — one "
+                        "process cannot materialize the global order. "
+                        "Sort before frame_from_process_local, or reduce "
+                        "with a verb (verbs run as collectives)."
+                    )
+                if any(isinstance(v, list) for v in vals):
+                    merged[name] = [x for v in vals for x in v]
+                else:
+                    arrs = [np.asarray(v) for v in vals]
+                    merged[name] = (
+                        arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+                    )
+            key_arrs = []
+            for k in reversed(keys):  # lexsort: LAST key is primary
+                v = merged[k]
+                arr = (
+                    np.asarray(v, dtype=object)
+                    if isinstance(v, list) else np.asarray(v)
+                )
+                # dense integer codes keep DESCENDING sorts stable:
+                # negating codes (ints always negate; strings don't)
+                # sorts descending while lexsort's stability preserves
+                # tie order — order[::-1] would reverse ties
+                codes = np.unique(arr, return_inverse=True)[1]
+                key_arrs.append(codes if ascending else -codes)
+            order = np.lexsort(key_arrs)
+            out: Block = {}
+            for name in names:
+                v = merged[name]
+                if isinstance(v, list):
+                    out[name] = [v[i] for i in order]
+                else:
+                    out[name] = v[order]
+            return [out]
+
+        return TensorFrame(None, schema, pending=compute)
+
+    def limit(self, n: int) -> "TensorFrame":
+        """The first ``n`` rows, as a frame (``take`` returns rows).
+        Lazy; forcing materializes the parent's blocks (verbs are
+        all-blocks lazy thunks) but only the first ``n`` rows transfer
+        or copy.
+        """
+        if n < 0:
+            raise ValueError(f"limit must be >= 0, got {n}")
+        schema = self.schema
+        names = list(schema.names)
+        parent = self
+
+        def compute() -> List[Block]:
+            remaining = n
+            out_blocks: List[Block] = []
+            for b in parent.blocks():
+                if remaining <= 0:
+                    break
+                rows = _block_num_rows(b)
+                take_n = min(rows, remaining)
+                nb: Block = {}
+                for name in names:
+                    v = b[name]
+                    if _non_addressable(v):
+                        raise RuntimeError(
+                            "limit: columns span processes — one process "
+                            "cannot materialize the global head. Limit "
+                            "before frame_from_process_local."
+                        )
+                    # slice BEFORE np.asarray: device columns then move
+                    # only the kept rows host-ward, not the whole block
+                    nb[name] = (
+                        v[:take_n] if isinstance(v, list)
+                        else np.asarray(v[:take_n])
+                    )
+                out_blocks.append(nb)
+                remaining -= take_n
+            if not out_blocks:
+                for b in parent.blocks()[:1]:
+                    nb = {}
+                    for name in names:
+                        v = b[name]
+                        nb[name] = (
+                            [] if isinstance(v, list) else np.asarray(v[:0])
+                        )
+                    out_blocks.append(nb)
+            return out_blocks
+
+        return TensorFrame(None, schema, pending=compute)
+
     def with_column_renamed(self, old: str, new: str) -> "TensorFrame":
         schema = Schema(
             [c.with_name(new) if c.name == old else c for c in self.schema]

@@ -327,3 +327,42 @@ def test_filter_is_lazy():
     assert not flt.is_materialized
     got = np.asarray(flt.column_values("x"))
     np.testing.assert_array_equal(got, [2.0, 3.0])
+
+
+def test_sort_values_single_and_multi_key():
+    df = tfs.frame_from_rows(
+        [
+            {"k": 2.0, "g": "b", "tag": "x"},
+            {"k": 1.0, "g": "b", "tag": "y"},
+            {"k": 1.0, "g": "a", "tag": "z"},
+            {"k": 3.0, "g": "a", "tag": "w"},
+        ],
+        num_blocks=2,
+    )
+    got = df.sort_values("k").collect()
+    assert [r["k"] for r in got] == [1.0, 1.0, 2.0, 3.0]
+    # multi-key: g primary, k secondary; host string keys sort too
+    got2 = df.sort_values(["g", "k"]).collect()
+    assert [(r["g"], r["k"]) for r in got2] == [
+        ("a", 1.0), ("a", 3.0), ("b", 1.0), ("b", 2.0)
+    ]
+    got3 = df.sort_values("k", ascending=False).collect()
+    assert [r["k"] for r in got3] == [3.0, 2.0, 1.0, 1.0]
+    # DESCENDING keeps tie stability: the two k=1.0 rows stay in input
+    # order (y before z), not reversed
+    assert [r["tag"] for r in got3] == ["w", "x", "y", "z"]
+    with pytest.raises(KeyError):
+        df.sort_values("nope")
+
+
+def test_limit_spans_blocks():
+    df = tfs.frame_from_rows(
+        [{"x": float(i), "s": f"r{i}"} for i in range(10)], num_blocks=4
+    )
+    got = df.limit(5).collect()
+    assert [r["x"] for r in got] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert [r["s"] for r in got] == ["r0", "r1", "r2", "r3", "r4"]
+    assert df.limit(0).collect() == []
+    assert len(df.limit(99).collect()) == 10
+    with pytest.raises(ValueError):
+        df.limit(-1)
