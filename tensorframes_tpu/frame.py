@@ -33,7 +33,7 @@ from __future__ import annotations
 import functools as _functools
 import math
 import threading
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Union
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -55,6 +55,30 @@ def _non_addressable(v) -> bool:
     return (
         hasattr(v, "is_fully_addressable") and not v.is_fully_addressable
     )
+
+
+def _merged_global_columns(frame, names, op_name: str) -> Dict[str, object]:
+    """Concatenate every block of ``names`` into single host/device
+    columns — the global-materialization step shared by sort_values and
+    join. Raises the actionable spans-processes guidance for
+    multi-process frames."""
+    out: Dict[str, object] = {}
+    blocks = frame.blocks()
+    for name in names:
+        vals = [b[name] for b in blocks]
+        if any(_non_addressable(v) for v in vals):
+            raise RuntimeError(
+                f"{op_name}: columns span processes — one process cannot "
+                f"materialize the global frame. {op_name} before "
+                "frame_from_process_local, or reduce with a verb (verbs "
+                "run as collectives)."
+            )
+        if any(isinstance(v, list) for v in vals):
+            out[name] = [x for v in vals for x in v]
+        else:
+            arrs = [np.asarray(v) for v in vals]
+            out[name] = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+    return out
 
 
 def _block_num_rows(block: Block) -> int:
@@ -345,31 +369,24 @@ class TensorFrame:
         keys = [by] if isinstance(by, str) else list(by)
         for k in keys:
             self.schema[k]  # unknown column: raise now, not at force
+        if isinstance(ascending, bool):
+            asc = [ascending] * len(keys)
+        else:  # pandas-style per-key list
+            asc = [bool(a) for a in ascending]
+            if len(asc) != len(keys):
+                raise ValueError(
+                    f"ascending has {len(asc)} entries for {len(keys)} "
+                    "sort keys"
+                )
         schema = self.schema
         names = list(schema.names)
         parent = self
 
         def compute() -> List[Block]:
-            blocks = parent.blocks()
-            merged: Block = {}
-            for name in names:
-                vals = [b[name] for b in blocks]
-                if any(_non_addressable(v) for v in vals):
-                    raise RuntimeError(
-                        "sort_values: columns span processes — one "
-                        "process cannot materialize the global order. "
-                        "Sort before frame_from_process_local, or reduce "
-                        "with a verb (verbs run as collectives)."
-                    )
-                if any(isinstance(v, list) for v in vals):
-                    merged[name] = [x for v in vals for x in v]
-                else:
-                    arrs = [np.asarray(v) for v in vals]
-                    merged[name] = (
-                        arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
-                    )
+            merged = _merged_global_columns(parent, names, "sort_values")
             key_arrs = []
-            for k in reversed(keys):  # lexsort: LAST key is primary
+            # lexsort: LAST key is primary, so iterate reversed
+            for k, k_asc in zip(reversed(keys), reversed(asc)):
                 v = merged[k]
                 arr = (
                     np.asarray(v, dtype=object)
@@ -380,7 +397,7 @@ class TensorFrame:
                 # sorts descending while lexsort's stability preserves
                 # tie order — order[::-1] would reverse ties
                 codes = np.unique(arr, return_inverse=True)[1]
-                key_arrs.append(codes if ascending else -codes)
+                key_arrs.append(codes if k_asc else -codes)
             order = np.lexsort(key_arrs)
             out: Block = {}
             for name in names:
@@ -435,11 +452,123 @@ class TensorFrame:
                     nb = {}
                     for name in names:
                         v = b[name]
+                        if _non_addressable(v):
+                            raise RuntimeError(
+                                "limit: columns span processes — one "
+                                "process cannot materialize the global "
+                                "head. Limit before "
+                                "frame_from_process_local."
+                            )
                         nb[name] = (
                             [] if isinstance(v, list) else np.asarray(v[:0])
                         )
                     out_blocks.append(nb)
             return out_blocks
+
+        return TensorFrame(None, schema, pending=compute)
+
+    def join(
+        self,
+        other: "TensorFrame",
+        on,
+        how: str = "inner",
+        suffixes: Tuple[str, str] = ("_x", "_y"),
+    ) -> "TensorFrame":
+        """Inner hash join on one or more key columns (the last Spark
+        affordance a standalone frame needs). Key encoding rides the
+        aggregate machinery (``ops/keys.py``: native hash dictionary for
+        strings, O(n) dense codes for ints) so any key type joins; the
+        match expansion is fully vectorized (no per-key python loop).
+        Result ordering is pandas-like: left-row order, ties in the
+        right frame's stable order. Non-key columns sharing a name take
+        ``suffixes``. Lazy; returns one block.
+        """
+        if how != "inner":
+            raise NotImplementedError(
+                f"join supports how='inner' (got {how!r}); outer joins "
+                "need per-dtype null semantics the schema doesn't define"
+            )
+        keys = [on] if isinstance(on, str) else list(on)
+        for k in keys:
+            self.schema[k]
+            other.schema[k]
+        left_only = [c for c in self.schema.names if c not in keys]
+        right_only = [c for c in other.schema.names if c not in keys]
+        clashes = set(left_only) & set(right_only)
+        lname = {
+            c: (c + suffixes[0] if c in clashes else c) for c in left_only
+        }
+        rname = {
+            c: (c + suffixes[1] if c in clashes else c) for c in right_only
+        }
+        cols = (
+            [self.schema[k] for k in keys]
+            + [self.schema[c].with_name(lname[c]) for c in left_only]
+            + [other.schema[c].with_name(rname[c]) for c in right_only]
+        )
+        schema = Schema(cols)
+        left, right = self, other
+
+        def compute() -> List[Block]:
+            from .ops.keys import group_ids
+
+            lcols = _merged_global_columns(left, left.schema.names, "join")
+            rcols = _merged_global_columns(
+                right, right.schema.names, "join"
+            )
+            nl = _block_num_rows(lcols)
+            nr = _block_num_rows(rcols)
+            if nl == 0 or nr == 0:
+                # group_ids cannot encode zero rows; an empty side means
+                # an empty inner join
+                out0: Block = {}
+                for k in keys:
+                    v = lcols[k]
+                    out0[k] = [] if isinstance(v, list) else v[:0]
+                for c in left_only:
+                    v = lcols[c]
+                    out0[lname[c]] = [] if isinstance(v, list) else v[:0]
+                for c in right_only:
+                    v = rcols[c]
+                    out0[rname[c]] = [] if isinstance(v, list) else v[:0]
+                return [out0]
+            key_union = []
+            for k in keys:
+                lv, rv = lcols[k], rcols[k]
+                if isinstance(lv, list) or isinstance(rv, list):
+                    u = np.empty(len(lv) + len(rv), dtype=object)
+                    u[: len(lv)] = list(lv)
+                    u[len(lv):] = list(rv)
+                else:
+                    u = np.concatenate([lv, rv])
+                key_union.append(u)
+            codes, _, num_codes = group_ids(key_union)
+            l_codes, r_codes = codes[:nl], codes[nl:]
+
+            order_r = np.argsort(r_codes, kind="stable")
+            counts = np.bincount(r_codes, minlength=num_codes)
+            starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            cnt_l = counts[l_codes]
+            li = np.repeat(np.arange(nl), cnt_l)
+            total = int(cnt_l.sum())
+            offs = np.arange(total) - np.repeat(
+                np.cumsum(cnt_l) - cnt_l, cnt_l
+            )
+            ri = order_r[np.repeat(starts[l_codes], cnt_l) + offs]
+
+            def gather(col, idx):
+                if isinstance(col, list):
+                    return [col[i] for i in idx]
+                return col[idx]
+
+            out: Block = {}
+            for k in keys:
+                out[k] = gather(lcols[k], li)
+            for c in left_only:
+                out[lname[c]] = gather(lcols[c], li)
+            for c in right_only:
+                out[rname[c]] = gather(rcols[c], ri)
+            return [out]
 
         return TensorFrame(None, schema, pending=compute)
 

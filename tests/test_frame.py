@@ -346,6 +346,14 @@ def test_sort_values_single_and_multi_key():
     assert [(r["g"], r["k"]) for r in got2] == [
         ("a", 1.0), ("a", 3.0), ("b", 1.0), ("b", 2.0)
     ]
+    # pandas-style per-key ascending list
+    got_mixed = df.sort_values(["g", "k"], ascending=[False, True]).collect()
+    assert [(r["g"], r["k"]) for r in got_mixed] == [
+        ("b", 1.0), ("b", 2.0), ("a", 1.0), ("a", 3.0)
+    ]
+    with pytest.raises(ValueError, match="entries"):
+        df.sort_values(["g", "k"], ascending=[True])
+
     got3 = df.sort_values("k", ascending=False).collect()
     assert [r["k"] for r in got3] == [3.0, 2.0, 1.0, 1.0]
     # DESCENDING keeps tie stability: the two k=1.0 rows stay in input
@@ -366,3 +374,63 @@ def test_limit_spans_blocks():
     assert len(df.limit(99).collect()) == 10
     with pytest.raises(ValueError):
         df.limit(-1)
+
+
+def test_join_inner_matches_pandas():
+    """Inner hash join golden-matched against pandas.merge: multi-match
+    expansion, string keys through the native dictionary encode, column
+    name clashes suffixed, pandas-like ordering."""
+    import pandas as pd
+
+    left_rows = [
+        {"k": "a", "v": 1.0, "tag": "l0"},
+        {"k": "b", "v": 2.0, "tag": "l1"},
+        {"k": "a", "v": 3.0, "tag": "l2"},
+        {"k": "c", "v": 4.0, "tag": "l3"},
+    ]
+    right_rows = [
+        {"k": "a", "w": 10.0, "tag": "r0"},
+        {"k": "a", "w": 20.0, "tag": "r1"},
+        {"k": "b", "w": 30.0, "tag": "r2"},
+        {"k": "d", "w": 40.0, "tag": "r3"},
+    ]
+    lf = tfs.frame_from_rows(left_rows, num_blocks=2)
+    rf = tfs.frame_from_rows(right_rows, num_blocks=2)
+    got = lf.join(rf, on="k").collect()
+
+    want = pd.merge(
+        pd.DataFrame(left_rows), pd.DataFrame(right_rows),
+        on="k", how="inner",
+    )
+    assert len(got) == len(want) == 5
+    for g, (_, w) in zip(got, want.iterrows()):
+        assert g["k"] == w["k"]
+        assert g["v"] == w["v"]
+        assert g["w"] == w["w"]
+        assert g["tag_x"] == w["tag_x"]
+        assert g["tag_y"] == w["tag_y"]
+
+
+def test_join_int_keys_and_empty_result():
+    lf = tfs.frame_from_arrays(
+        {"id": np.asarray([1, 2, 3]), "v": np.asarray([1.0, 2.0, 3.0])}
+    )
+    rf = tfs.frame_from_arrays(
+        {"id": np.asarray([2, 3, 9]), "w": np.asarray([20.0, 30.0, 90.0])}
+    )
+    got = lf.join(rf, on="id").collect()
+    assert [(r["id"], r["v"], r["w"]) for r in got] == [
+        (2, 2.0, 20.0), (3, 3.0, 30.0)
+    ]
+    none = lf.join(
+        tfs.frame_from_arrays(
+            {"id": np.asarray([7]), "w": np.asarray([0.0])}
+        ),
+        on="id",
+    ).collect()
+    assert none == []
+    # zero-row sides must give an empty join, not a group_ids crash
+    empty = lf.filter(lambda id: {"keep": id > 99})
+    assert lf.join(empty.select(["id"]), on="id").collect() == []
+    with pytest.raises(NotImplementedError, match="inner"):
+        lf.join(rf, on="id", how="left")
