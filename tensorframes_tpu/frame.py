@@ -473,21 +473,62 @@ class TensorFrame:
         on,
         how: str = "inner",
         suffixes: Tuple[str, str] = ("_x", "_y"),
+        fill_value=None,
     ) -> "TensorFrame":
-        """Inner hash join on one or more key columns (the last Spark
+        """Hash join on one or more key columns (the last Spark
         affordance a standalone frame needs). Key encoding rides the
         aggregate machinery (``ops/keys.py``: native hash dictionary for
         strings, O(n) dense codes for ints) so any key type joins; the
         match expansion is fully vectorized (no per-key python loop).
         Result ordering is pandas-like: left-row order, ties in the
         right frame's stable order. Non-key columns sharing a name take
-        ``suffixes``. Lazy; returns one block.
+        ``suffixes``.
+
+        ``how="left"`` keeps unmatched left rows; their right-side
+        columns take ``fill_value`` (a scalar, or a dict keyed by the
+        right column's ORIGINAL name) — explicit fills instead of NaN,
+        because NaN would silently retype integer columns. Lazy;
+        returns one block.
         """
-        if how != "inner":
+        if how not in ("inner", "left"):
             raise NotImplementedError(
-                f"join supports how='inner' (got {how!r}); outer joins "
-                "need per-dtype null semantics the schema doesn't define"
+                f"join supports how='inner'/'left' (got {how!r}); outer "
+                "joins need per-dtype null semantics the schema doesn't "
+                "define"
             )
+        if how == "left" and fill_value is None:
+            raise ValueError(
+                "how='left' needs fill_value (scalar or {column: value}) "
+                "for unmatched rows' right-side columns — explicit fills "
+                "instead of NaN, which would retype integer columns"
+            )
+
+        def fill_for(col_name):
+            if isinstance(fill_value, dict):
+                if col_name not in fill_value:
+                    raise ValueError(
+                        f"how='left': fill_value has no entry for right "
+                        f"column {col_name!r}"
+                    )
+                return fill_value[col_name]
+            return fill_value
+
+        def checked_fill(col_name, np_dtype):
+            """The fill cast must be EXACT — a lossy fill (e.g. -1.5
+            into an int column) would corrupt silently, the very failure
+            mode mandatory fills exist to prevent."""
+            fv = fill_for(col_name)
+            cast = np.asarray(fv, np_dtype)
+            same = (
+                cast != cast and fv != fv  # NaN fill into a float col
+            ) or cast == np.asarray(fv)
+            if not bool(same):
+                raise ValueError(
+                    f"how='left': fill_value {fv!r} is not exactly "
+                    f"representable in column {col_name!r}'s dtype "
+                    f"{np_dtype}"
+                )
+            return cast
         keys = [on] if isinstance(on, str) else list(on)
         for k in keys:
             self.schema[k]
@@ -501,6 +542,13 @@ class TensorFrame:
         rname = {
             c: (c + suffixes[1] if c in clashes else c) for c in right_only
         }
+        if how == "left" and isinstance(fill_value, dict):
+            missing_fills = [c for c in right_only if c not in fill_value]
+            if missing_fills:
+                raise ValueError(
+                    f"how='left': fill_value has no entry for right "
+                    f"column(s) {missing_fills}"
+                )
         cols = (
             [self.schema[k] for k in keys]
             + [self.schema[c].with_name(lname[c]) for c in left_only]
@@ -518,9 +566,9 @@ class TensorFrame:
             )
             nl = _block_num_rows(lcols)
             nr = _block_num_rows(rcols)
-            if nl == 0 or nr == 0:
+            if nl == 0 or (nr == 0 and how == "inner"):
                 # group_ids cannot encode zero rows; an empty side means
-                # an empty inner join
+                # an empty inner join (a left join keeps left rows)
                 out0: Block = {}
                 for k in keys:
                     v = lcols[k]
@@ -531,6 +579,24 @@ class TensorFrame:
                 for c in right_only:
                     v = rcols[c]
                     out0[rname[c]] = [] if isinstance(v, list) else v[:0]
+                return [out0]
+            if nr == 0:
+                # left join against an empty right side: all left rows,
+                # right columns fully filled
+                out0 = {}
+                for k in keys:
+                    out0[k] = lcols[k]
+                for c in left_only:
+                    out0[lname[c]] = lcols[c]
+                for c in right_only:
+                    v = rcols[c]
+                    if isinstance(v, list):
+                        out0[rname[c]] = [fill_for(c)] * nl
+                    else:
+                        out0[rname[c]] = np.full(
+                            (nl,) + v.shape[1:], checked_fill(c, v.dtype),
+                            v.dtype,
+                        )
                 return [out0]
             key_union = []
             for k in keys:
@@ -549,17 +615,45 @@ class TensorFrame:
             counts = np.bincount(r_codes, minlength=num_codes)
             starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
             cnt_l = counts[l_codes]
-            li = np.repeat(np.arange(nl), cnt_l)
-            total = int(cnt_l.sum())
+            if how == "left":
+                # unmatched left rows still emit ONE output row, marked
+                # ri = -1 so right columns take the fill
+                cnt_eff = np.maximum(cnt_l, 1)
+            else:
+                cnt_eff = cnt_l
+            li = np.repeat(np.arange(nl), cnt_eff)
+            total = int(cnt_eff.sum())
             offs = np.arange(total) - np.repeat(
-                np.cumsum(cnt_l) - cnt_l, cnt_l
+                np.cumsum(cnt_eff) - cnt_eff, cnt_eff
             )
-            ri = order_r[np.repeat(starts[l_codes], cnt_l) + offs]
+            base = np.repeat(starts[l_codes], cnt_eff) + offs
+            if how == "left":
+                matched = np.repeat(cnt_l > 0, cnt_eff)
+                safe = np.where(
+                    matched, np.clip(base, 0, max(nr - 1, 0)), 0
+                )
+                ri = np.where(matched, order_r[safe], -1)
+            else:
+                ri = order_r[base]  # inner: every expansion matched
 
             def gather(col, idx):
                 if isinstance(col, list):
                     return [col[i] for i in idx]
                 return col[idx]
+
+            def gather_right(col, col_name):
+                if how != "left":
+                    return gather(col, ri)
+                fv = fill_for(col_name)
+                if isinstance(col, list):
+                    return [col[i] if i >= 0 else fv for i in ri]
+                safe_i = np.clip(ri, 0, None)
+                # condition broadcasts across the cell dims of
+                # multi-dim columns (embeddings etc.)
+                cond = (ri >= 0).reshape((-1,) + (1,) * (col.ndim - 1))
+                return np.where(
+                    cond, col[safe_i], checked_fill(col_name, col.dtype)
+                )
 
             out: Block = {}
             for k in keys:
@@ -567,7 +661,7 @@ class TensorFrame:
             for c in left_only:
                 out[lname[c]] = gather(lcols[c], li)
             for c in right_only:
-                out[rname[c]] = gather(rcols[c], ri)
+                out[rname[c]] = gather_right(rcols[c], c)
             return [out]
 
         return TensorFrame(None, schema, pending=compute)

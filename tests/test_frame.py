@@ -432,5 +432,54 @@ def test_join_int_keys_and_empty_result():
     # zero-row sides must give an empty join, not a group_ids crash
     empty = lf.filter(lambda id: {"keep": id > 99})
     assert lf.join(empty.select(["id"]), on="id").collect() == []
-    with pytest.raises(NotImplementedError, match="inner"):
-        lf.join(rf, on="id", how="left")
+    with pytest.raises(NotImplementedError, match="outer"):
+        lf.join(rf, on="id", how="outer")
+    with pytest.raises(ValueError, match="fill_value"):
+        lf.join(rf, on="id", how="left")  # left requires explicit fills
+
+
+def test_join_left_with_fill_matches_pandas():
+    import pandas as pd
+
+    left_rows = [{"k": i, "v": float(i)} for i in range(5)]
+    right_rows = [{"k": 1, "w": 10.0}, {"k": 1, "w": 11.0}, {"k": 3, "w": 30.0}]
+    lf = tfs.frame_from_rows(left_rows, num_blocks=2)
+    rf = tfs.frame_from_rows(right_rows)
+    got = lf.join(rf, on="k", how="left", fill_value=-1.0).collect()
+
+    want = pd.merge(
+        pd.DataFrame(left_rows), pd.DataFrame(right_rows),
+        on="k", how="left",
+    ).fillna(-1.0)
+    assert len(got) == len(want) == 6
+    for g, (_, w) in zip(got, want.iterrows()):
+        assert g["k"] == w["k"] and g["v"] == w["v"] and g["w"] == w["w"]
+
+    # per-column fill dict + empty right side
+    empty_r = tfs.frame_from_rows(right_rows).filter(
+        lambda w: {"keep": w > 99.0}
+    )
+    all_filled = lf.join(
+        empty_r, on="k", how="left", fill_value={"w": 0.0}
+    ).collect()
+    assert [r["w"] for r in all_filled] == [0.0] * 5
+
+    # a lossy fill into an int column raises instead of truncating
+    int_r = tfs.frame_from_arrays(
+        {"k": np.asarray([1]), "c": np.asarray([7])}
+    )
+    with pytest.raises(ValueError, match="representable"):
+        lf.join(int_r, on="k", how="left", fill_value=-1.5).collect()
+    # a missing dict entry raises EAGERLY at join() time
+    with pytest.raises(ValueError, match="no entry"):
+        lf.join(int_r, on="k", how="left", fill_value={"x": 0})
+
+    # multi-dim right columns broadcast the fill across cell dims
+    emb_r = tfs.frame_from_arrays(
+        {"k": np.asarray([1, 3]),
+         "e": np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)}
+    )
+    je = lf.join(emb_r, on="k", how="left", fill_value=0.0).collect()
+    assert np.asarray(je[0]["e"]).shape == (2,)
+    got_rows = {r["k"]: np.asarray(r["e"]).tolist() for r in je}
+    assert got_rows[1] == [1.0, 2.0] and got_rows[0] == [0.0, 0.0]
