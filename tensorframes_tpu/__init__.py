@@ -83,6 +83,7 @@ from .graphdef import (  # noqa: E402,F401
     load_graphdef,
     load_saved_model,
     parse_graphdef,
+    parse_saved_model,
     program_from_graphdef,
 )
 from .validation import ValidationError  # noqa: E402,F401

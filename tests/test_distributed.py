@@ -129,10 +129,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(tmp_path, nproc: int, timeout: float):
+def _run_workers(tmp_path, nproc: int, timeout: float, attempts: int = 2):
+    """Launch the worker fleet; one retry with a FRESH coordinator port.
+    The rendezvous is exposed to two load-dependent transients a retry
+    cures: the _free_port bind/close/reuse race, and slow worker
+    interpreter startup under a loaded machine blowing the distributed
+    init window (observed as rare full-suite-only failures)."""
+    last = None
+    for attempt in range(attempts):
+        try:
+            return _run_workers_once(tmp_path, nproc, timeout, attempt)
+        except AssertionError as e:
+            last = e
+    raise last
+
+
+def _run_workers_once(tmp_path, nproc: int, timeout: float, attempt: int):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     coord = f"localhost:{_free_port()}"
-    script = tmp_path / "worker.py"
+    script = tmp_path / f"worker_{attempt}.py"
     script.write_text(
         _WORKER.format(repo=repo, coord=coord, sf_dir=str(tmp_path / "sf"))
     )
