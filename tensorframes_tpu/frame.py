@@ -41,7 +41,10 @@ from . import dtypes as dt
 from .config import get_config
 from .schema import ColumnInfo, Schema
 from .shape import Shape, Unknown, shape_of_nested
+import time
+
 from .utils import get_logger
+from .utils import profiling
 
 logger = get_logger(__name__)
 
@@ -55,6 +58,21 @@ def _non_addressable(v) -> bool:
     return (
         hasattr(v, "is_fully_addressable") and not v.is_fully_addressable
     )
+
+
+def _spanned(name: str, compute, rows_fn):
+    """Wrap a pending thunk so forcing it records a profiling span.
+    ``rows_fn()`` supplies the INPUT row count at force time — the same
+    convention as the verbs (a filter that keeps 10 of 1M rows did 1M
+    rows of work, and report() throughputs must stay comparable)."""
+
+    def run():
+        t0 = time.perf_counter()
+        blocks = compute()
+        profiling.record(name, time.perf_counter() - t0, rows_fn())
+        return blocks
+
+    return run
 
 
 def _merged_global_columns(frame, names, op_name: str) -> Dict[str, object]:
@@ -322,6 +340,7 @@ class TensorFrame:
         mname = out_names[0]
         schema = self.schema
         names = list(schema.names)
+        parent = self
 
         def compute() -> List[Block]:
             new_blocks: List[Block] = []
@@ -356,7 +375,10 @@ class TensorFrame:
         # lazy like every sibling transform: the mask + gather run when
         # blocks()/collect() force the frame, so chained verbs keep
         # their one-materialization contract
-        return TensorFrame(None, schema, pending=compute)
+        return TensorFrame(
+            None, schema,
+            pending=_spanned("filter", compute, lambda: parent.num_rows),
+        )
 
     def sort_values(self, by, ascending: bool = True) -> "TensorFrame":
         """Rows ordered by one or more key columns (stable: ties keep
@@ -408,7 +430,12 @@ class TensorFrame:
                     out[name] = v[order]
             return [out]
 
-        return TensorFrame(None, schema, pending=compute)
+        return TensorFrame(
+            None, schema,
+            pending=_spanned(
+                "sort_values", compute, lambda: parent.num_rows
+            ),
+        )
 
     def limit(self, n: int) -> "TensorFrame":
         """The first ``n`` rows, as a frame (``take`` returns rows).
@@ -664,7 +691,13 @@ class TensorFrame:
                 out[rname[c]] = gather_right(rcols[c], c)
             return [out]
 
-        return TensorFrame(None, schema, pending=compute)
+        return TensorFrame(
+            None, schema,
+            pending=_spanned(
+                "join", compute,
+                lambda: left.num_rows + right.num_rows,
+            ),
+        )
 
     def with_column_renamed(self, old: str, new: str) -> "TensorFrame":
         schema = Schema(

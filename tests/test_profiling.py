@@ -87,3 +87,32 @@ def test_compilation_cache_config_plumbs_through(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert cache in r.stdout
+
+
+def test_relational_methods_record_spans():
+    """filter/sort_values/join record profiling spans when forced — the
+    same observability contract as the verbs."""
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.utils import profiling
+
+    profiling.reset_metrics()
+    lf = tfs.frame_from_arrays(
+        {"k": np.asarray([1, 2, 3]), "v": np.asarray([1.0, 2.0, 3.0])}
+    )
+    rf = tfs.frame_from_arrays(
+        {"k": np.asarray([2, 3]), "w": np.asarray([20.0, 30.0])}
+    )
+    flt = lf.filter(lambda v: {"keep": v > 1.0})
+    m0 = profiling.metrics()
+    assert "filter" not in m0  # lazy: nothing recorded before forcing
+    flt.sort_values("v").collect()
+    lf.join(rf, on="k").collect()
+    m = profiling.metrics()
+    # INPUT-rows convention, same as the verbs: a filter that kept 2 of
+    # 3 rows did 3 rows of work
+    assert m["filter"].rows == 3
+    assert m["sort_values"].rows == 2  # sort ran on the filtered frame
+    assert m["join"].rows == 5  # 3 left + 2 right
+    profiling.reset_metrics()
