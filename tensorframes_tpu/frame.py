@@ -405,6 +405,8 @@ class TensorFrame:
         parent = self
 
         def compute() -> List[Block]:
+            from .ops.keys import _unique_inverse
+
             merged = _merged_global_columns(parent, names, "sort_values")
             key_arrs = []
             # lexsort: LAST key is primary, so iterate reversed
@@ -414,11 +416,20 @@ class TensorFrame:
                     np.asarray(v, dtype=object)
                     if isinstance(v, list) else np.asarray(v)
                 )
+                if arr.ndim > 1:
+                    raise ValueError(
+                        f"sort_values: key column {k!r} has non-scalar "
+                        f"cells (shape {arr.shape[1:]}); sort keys must "
+                        "be scalar columns"
+                    )
                 # dense integer codes keep DESCENDING sorts stable:
                 # negating codes (ints always negate; strings don't)
                 # sorts descending while lexsort's stability preserves
-                # tie order — order[::-1] would reverse ties
-                codes = np.unique(arr, return_inverse=True)[1]
+                # tie order — order[::-1] would reverse ties.  Encoding
+                # rides ops/keys (same as join/aggregate) so mixed-type
+                # object keys and NaN floats order deterministically
+                # instead of raising from numpy's '<'
+                codes = _unique_inverse(arr)[1]
                 key_arrs.append(codes if k_asc else -codes)
             order = np.lexsort(key_arrs)
             out: Block = {}
@@ -545,7 +556,17 @@ class TensorFrame:
             into an int column) would corrupt silently, the very failure
             mode mandatory fills exist to prevent."""
             fv = fill_for(col_name)
-            cast = np.asarray(fv, np_dtype)
+            try:
+                cast = np.asarray(fv, np_dtype)
+            except (ValueError, TypeError, OverflowError):
+                # e.g. NaN fill into an int column: numpy raises its own
+                # 'cannot convert float NaN to integer' before the
+                # representability check below can phrase it usefully
+                raise ValueError(
+                    f"how='left': fill_value {fv!r} is not exactly "
+                    f"representable in column {col_name!r}'s dtype "
+                    f"{np_dtype}"
+                ) from None
             same = (
                 cast != cast and fv != fv  # NaN fill into a float col
             ) or cast == np.asarray(fv)

@@ -1149,6 +1149,59 @@ def program_from_graphdef(
             f"{sorted(_BINARY)}, {sorted(_UNARY)}, {sorted(_REDUCERS)}"
         )
 
+    if library:
+        # A (malformed) recursive or mutually-recursive library passes
+        # the seen-set dedup walk above but would recurse unboundedly at
+        # the first _eval_function call — surface the module's clean
+        # ValueError at import time instead of a RecursionError at run
+        # time.  DFS with an ACTIVE-CHAIN stack (not just a visited
+        # set), rooted at the main graph's call nodes.
+        def _called(fd):
+            return [
+                bn.attrs["f"].func
+                for bn in fd.nodes
+                if bn.op in ("PartitionedCall", "StatefulPartitionedCall")
+                and bn.attrs.get("f") is not None
+                and bn.attrs["f"].func
+            ]
+
+        roots = [
+            n.attrs["f"].func
+            for n in nodes
+            if n.name in reachable
+            and n.op in ("PartitionedCall", "StatefulPartitionedCall")
+        ]
+        state: Dict[str, int] = {}  # 0 = on the active chain, 1 = done
+        for root in roots:
+            if state.get(root) == 1:
+                continue
+            chain = [root]
+            stack = [(root, iter(_called(library[root])))]
+            state[root] = 0
+            while stack:
+                fname, it = stack[-1]
+                for callee in it:
+                    if callee not in library:
+                        continue  # missing fns already raised in the walk
+                    st = state.get(callee)
+                    if st == 0:
+                        cycle = chain[chain.index(callee):] + [callee]
+                        raise ValueError(
+                            "GraphDef function library has a call cycle: "
+                            + " -> ".join(cycle)
+                            + "; recursive tf.functions cannot lower to "
+                            "a static XLA graph"
+                        )
+                    if st is None:
+                        state[callee] = 0
+                        chain.append(callee)
+                        stack.append((callee, iter(_called(library[callee]))))
+                        break
+                else:
+                    state[fname] = 1
+                    stack.pop()
+                    chain.pop()
+
     if quantize_weights:
         if library:
             raise ValueError(
@@ -1688,22 +1741,31 @@ def load_graphdef(
     return analyze_program(program)
 
 
-def parse_saved_model(data: bytes):
-    """Decode ``saved_model.pb`` (saved_model.proto) without TensorFlow:
-    returns ``(GraphNodes, signatures)`` where ``signatures`` maps each
-    signature key to ``{"inputs": {arg: tensor_ref}, "outputs": {...}}``
-    (TensorInfo names like ``"StatefulPartitionedCall:0"``). Wire path:
-    SavedModel.meta_graphs[0] (field 2) → MetaGraphDef.graph_def
-    (field 2) + signature_def map (field 5)."""
-    nodes = None
-    signatures: Dict[str, Dict[str, Dict[str, str]]] = {}
+def _parse_meta_graphs_raw(data: bytes):
+    """Decode every MetaGraphDef's envelope — ``(graphdef_bytes,
+    signatures, tags)`` per meta graph, in file order — WITHOUT parsing
+    the graphs themselves.  Selection (which meta graph serves the
+    requested signature) needs only signatures and tags; a train+serve
+    SavedModel's train graph (optimizer ops, gradient subgraphs) can
+    dwarf the serve graph, so the full node decode waits until one meta
+    graph is picked. Wire path: SavedModel.meta_graphs (field 2) →
+    MetaGraphDef.meta_info_def.tags (fields 1.4) + graph_def (field 2)
+    + signature_def map (field 5)."""
+    metas = []
     try:
         for field, _, v in _iter_fields(data):
             if field != 2:
                 continue
+            graph_bytes = None
+            signatures: Dict[str, Dict[str, Dict[str, str]]] = {}
+            tags: List[str] = []
             for f2, _, v2 in _iter_fields(v):
-                if f2 == 2:
-                    nodes = parse_graphdef(v2)
+                if f2 == 1:  # MetaInfoDef
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 4 and isinstance(v3, bytes):
+                            tags.append(v3.decode("utf-8"))
+                elif f2 == 2:
+                    graph_bytes = v2
                 elif f2 == 5:  # map<string, SignatureDef> entry
                     key = None
                     sig = {"inputs": {}, "outputs": {}}
@@ -1728,17 +1790,51 @@ def parse_saved_model(data: bytes):
                                         sig[side][io_name] = ref
                     if key is not None:
                         signatures[key] = sig
-            break  # first MetaGraphDef (the serving graph)
+            if graph_bytes is not None:
+                metas.append((graph_bytes, signatures, tags))
     except (
-        IndexError, TypeError, struct.error, UnicodeDecodeError, _WireError,
+        IndexError, TypeError, AttributeError, struct.error,
+        UnicodeDecodeError, _WireError,
     ) as e:
         raise ValueError(
             f"not a valid serialized SavedModel ({type(e).__name__} while "
             f"decoding: {e})"
         ) from e
-    if nodes is None:
+    if not metas:
         raise ValueError("SavedModel contains no MetaGraphDef graph")
-    return nodes, signatures
+    return metas
+
+
+def parse_saved_model_meta_graphs(data: bytes):
+    """Decode EVERY MetaGraphDef in ``saved_model.pb`` (saved_model.proto)
+    without TensorFlow: returns a list of ``(GraphNodes, signatures,
+    tags)`` triples, one per meta graph, in file order. ``signatures``
+    maps each signature key to ``{"inputs": {arg: tensor_ref},
+    "outputs": {...}}`` (TensorInfo names like
+    ``"StatefulPartitionedCall:0"``); ``tags`` is the meta graph's
+    tag-set (e.g. ``["serve"]``, ``["train"]``).
+
+    A SavedModel may carry several meta graphs (e.g. train+serve);
+    ``load_saved_model`` picks the one holding the requested signature
+    rather than assuming it lives in the first.
+    """
+    return [
+        (parse_graphdef(gb), signatures, tags)
+        for gb, signatures, tags in _parse_meta_graphs_raw(data)
+    ]
+
+
+def parse_saved_model(data: bytes):
+    """Decode ``saved_model.pb`` and return ``(GraphNodes, signatures)``
+    for the SERVING meta graph: the one tagged ``serve`` when several
+    meta graphs are present (train+serve exports), else the first. Only
+    the selected meta graph's nodes are decoded. See
+    :func:`parse_saved_model_meta_graphs` for the full list."""
+    metas = _parse_meta_graphs_raw(data)
+    for gb, signatures, tags in metas:
+        if "serve" in tags:
+            return parse_graphdef(gb), signatures
+    return parse_graphdef(metas[0][0]), metas[0][1]
 
 
 def load_saved_model(
@@ -1768,16 +1864,28 @@ def load_saved_model(
     pb = _os.path.join(path, "saved_model.pb")
     if _os.path.exists(pb):
         with open(pb, "rb") as fh:
-            nodes, signatures = parse_saved_model(fh.read())
+            metas = _parse_meta_graphs_raw(fh.read())
+        # Pick the meta graph HOLDING the requested signature (prefer a
+        # serve-tagged one on ties): multi-meta-graph SavedModels
+        # (e.g. train+serve tag-sets) may keep the serving signature in
+        # a later entry, where first-only decoding would miss it. Only
+        # the picked graph's nodes decode — the others stay raw bytes.
+        holders = [m for m in metas if signature in m[1]]
+        pool = holders or metas
+        tagged = [m for m in pool if "serve" in m[2]]
+        graph_bytes, signatures, _tags = (tagged or pool)[0]
+        nodes = parse_graphdef(graph_bytes)
         has_vars = any(
             n.op in ("VarHandleOp", "VariableV2", "ReadVariableOp")
             for n in nodes
         )
         if not has_vars and signatures:
             if signature not in signatures:
+                every = sorted({s for _, sigs, _ in metas for s in sigs})
                 raise KeyError(
-                    f"SavedModel has no signature {signature!r}; "
-                    f"available: {sorted(signatures)}"
+                    f"SavedModel has no signature {signature!r} in any "
+                    f"of its {len(metas)} meta graph(s); available: "
+                    f"{every}"
                 )
             sig = signatures[signature]
             sig_fetches = fetches

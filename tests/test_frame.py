@@ -363,6 +363,40 @@ def test_sort_values_single_and_multi_key():
         df.sort_values("nope")
 
 
+def test_sort_values_mixed_type_and_nan_keys():
+    """ADVICE r3: sort keys now ride ops/keys._unique_inverse, the same
+    encoder join/aggregate use — a NaN float among string keys must sort
+    deterministically (type-name/repr total order), not raise numpy's
+    bare TypeError from '<'."""
+    import math
+
+    df = tfs.frame_from_rows(
+        [
+            {"k": "b", "v": 0.0},
+            {"k": math.nan, "v": 1.0},
+            {"k": "a", "v": 2.0},
+            {"k": math.nan, "v": 3.0},
+        ]
+    )
+    got = df.sort_values("k").collect()
+    # deterministic total order: float NaN ('float' < 'str' by type
+    # name) before the strings; NaN ties keep input order (stable)
+    assert [r["v"] for r in got] == [1.0, 3.0, 2.0, 0.0]
+    # descending reverses the key order (b, a, NaN) with ties stable
+    got_d = df.sort_values("k", ascending=False).collect()
+    assert [r["v"] for r in got_d] == [0.0, 2.0, 1.0, 3.0]
+
+
+def test_sort_values_non_scalar_key_raises():
+    """ADVICE r3: a vector key column must raise the actionable error,
+    not silently flatten into per-element codes before lexsort fails."""
+    df = tfs.frame_from_arrays(
+        {"emb": np.ones((4, 3), np.float32), "v": np.arange(4.0)}
+    )
+    with pytest.raises(ValueError, match="non-scalar"):
+        df.sort_values("emb").collect()
+
+
 def test_limit_spans_blocks():
     df = tfs.frame_from_rows(
         [{"x": float(i), "s": f"r{i}"} for i in range(10)], num_blocks=4
@@ -470,6 +504,12 @@ def test_join_left_with_fill_matches_pandas():
     )
     with pytest.raises(ValueError, match="representable"):
         lf.join(int_r, on="k", how="left", fill_value=-1.5).collect()
+    # ADVICE r3: a NaN fill into an int column gets the SAME friendly
+    # error, not numpy's raw 'cannot convert float NaN to integer'
+    with pytest.raises(ValueError, match="representable"):
+        lf.join(
+            int_r, on="k", how="left", fill_value=float("nan")
+        ).collect()
     # a missing dict entry raises EAGERLY at join() time
     with pytest.raises(ValueError, match="no entry"):
         lf.join(int_r, on="k", how="left", fill_value={"x": 0})

@@ -619,3 +619,147 @@ def test_shape_and_scan_op_tier_matches_tf():
             fetches=["bad"],
         )
         prog2.fn({"x": np.ones((2, 4), np.float32)})
+
+
+def test_recursive_function_library_raises_at_import():
+    """ADVICE r3: a (malformed) self- or mutually-recursive
+    FunctionDefLibrary must raise the module's clean ValueError at
+    IMPORT time — the seen-set dedup walk alone passes such graphs, and
+    the first _eval_function call would then hit Python's
+    RecursionError."""
+    from tensorframes_tpu.graphdef import (
+        FunctionDef, GraphNode, GraphNodes, _Attr,
+    )
+
+    def call_attr(fname):
+        a = _Attr()
+        a.func = fname
+        return a
+
+    # self-recursion: f's body calls f
+    fd = FunctionDef(
+        "f", ["arg"], ["out"],
+        [GraphNode("again", "PartitionedCall", ["arg"],
+                   {"f": call_attr("f")})],
+        {"out": "again:output:0"},
+    )
+    main = [
+        _float_attr_placeholder_nodes(),
+        GraphNode("call", "PartitionedCall", ["x"],
+                  {"f": call_attr("f")}),
+    ]
+    with pytest.raises(ValueError, match="call cycle"):
+        program_from_graphdef(
+            GraphNodes(main, {"f": fd}), fetches=["call"]
+        )
+
+    # mutual recursion: f -> g -> f
+    fd_f = FunctionDef(
+        "f", ["arg"], ["out"],
+        [GraphNode("cg", "PartitionedCall", ["arg"],
+                   {"f": call_attr("g")})],
+        {"out": "cg:output:0"},
+    )
+    fd_g = FunctionDef(
+        "g", ["arg"], ["out"],
+        [GraphNode("cf", "PartitionedCall", ["arg"],
+                   {"f": call_attr("f")})],
+        {"out": "cf:output:0"},
+    )
+    with pytest.raises(ValueError, match="f -> g -> f"):
+        program_from_graphdef(
+            GraphNodes(main, {"f": fd_f, "g": fd_g}), fetches=["call"]
+        )
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return bytes([(field << 3) | 2]) + _varint(len(payload)) + payload
+
+
+def _vf(field: int, value: int) -> bytes:
+    return bytes([(field << 3) | 0]) + _varint(value)
+
+
+def _node_bytes(name, op, inputs=(), attrs=()):
+    b = _ld(1, name.encode()) + _ld(2, op.encode())
+    for i in inputs:
+        b += _ld(3, i.encode())
+    for k, v in attrs:
+        b += _ld(5, _ld(1, k.encode()) + _ld(2, v))
+    return b
+
+
+def _tiny_graphdef_bytes():
+    """x = Placeholder(float, [2]); y = Identity(x)."""
+    dtype_attr = _vf(6, 1)  # AttrValue.type = DT_FLOAT
+    shape_attr = _ld(7, _ld(2, _vf(1, 2)))  # shape { dim { size: 2 } }
+    x = _node_bytes(
+        "x", "Placeholder",
+        attrs=[("dtype", dtype_attr), ("shape", shape_attr)],
+    )
+    y = _node_bytes("y", "Identity", inputs=["x"], attrs=[("T", dtype_attr)])
+    return _ld(1, x) + _ld(1, y)
+
+
+def _signature_entry(key, inputs, outputs):
+    sig = b""
+    for arg, ref in inputs.items():
+        sig += _ld(1, _ld(1, arg.encode()) + _ld(2, _ld(1, ref.encode())))
+    for arg, ref in outputs.items():
+        sig += _ld(2, _ld(1, arg.encode()) + _ld(2, _ld(1, ref.encode())))
+    return _ld(5, _ld(1, key.encode()) + _ld(2, sig))
+
+
+def _meta_graph_bytes(tags, graphdef, sig_entries):
+    info = b"".join(_ld(4, t.encode()) for t in tags)
+    return _ld(1, info) + _ld(2, graphdef) + sig_entries
+
+
+def test_saved_model_multiple_meta_graphs(tmp_path):
+    """ADVICE r3: a SavedModel carrying several meta graphs (train +
+    serve tag-sets) must serve the signature from whichever meta graph
+    HOLDS it — first-only decoding raised KeyError even though the
+    signature existed. Hand-built wire bytes: no TF dependency."""
+    from tensorframes_tpu.graphdef import (
+        parse_saved_model, parse_saved_model_meta_graphs,
+    )
+
+    gd = _tiny_graphdef_bytes()
+    train_mg = _meta_graph_bytes(
+        ["train"], gd, _signature_entry(
+            "train_step", {"inp": "x:0"}, {"out": "y:0"}
+        ),
+    )
+    serve_mg = _meta_graph_bytes(
+        ["serve"], gd, _signature_entry(
+            "serving_default", {"inp": "x:0"}, {"out": "y:0"}
+        ),
+    )
+    sm = _ld(2, train_mg) + _ld(2, serve_mg)  # train FIRST
+
+    metas = parse_saved_model_meta_graphs(sm)
+    assert [tags for _, _, tags in metas] == [["train"], ["serve"]]
+    assert list(metas[0][1]) == ["train_step"]
+    assert list(metas[1][1]) == ["serving_default"]
+
+    # parse_saved_model prefers the serve-tagged meta graph
+    _, sigs = parse_saved_model(sm)
+    assert "serving_default" in sigs
+
+    # load_saved_model finds serving_default in the SECOND meta graph
+    sm_dir = tmp_path / "sm"
+    sm_dir.mkdir()
+    (sm_dir / "saved_model.pb").write_bytes(sm)
+    prog = tfs.load_saved_model(str(sm_dir))
+    xv = np.asarray([1.5, -2.0], np.float32)
+    out = prog.fn({"x": xv})
+    np.testing.assert_array_equal(np.asarray(out["out"]), xv)
+
+    # ... and the train-tagged signature resolves too (lives in mg[0])
+    prog_t = tfs.load_saved_model(str(sm_dir), signature="train_step")
+    out_t = prog_t.fn({"x": xv})
+    np.testing.assert_array_equal(np.asarray(out_t["out"]), xv)
+
+    # an absent signature reports signatures across ALL meta graphs
+    with pytest.raises(KeyError, match="2 meta graph"):
+        tfs.load_saved_model(str(sm_dir), signature="nope")
