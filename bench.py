@@ -63,40 +63,77 @@ def _record_mfu(name: str, program, rows_per_sec: float, n_rows: int) -> None:
         print(f"# mfu accounting unavailable for {name}: {e}")
 
 
-def _bench_map_blocks_logreg(n_rows: int = 262_144, iters: int = 5):
+def _h2d_seconds(arrays, reps: int = 3) -> float:
+    """Median wall-clock to ``device_put`` these host arrays and confirm
+    arrival — the marshalling half of every transfer-bound metric,
+    measured on its own so a slow link (the relay tunnel's ~70ms/8MB)
+    is a NUMBER, not a narrative (VERDICT r3 #2)."""
+    import jax
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        bufs = [jax.device_put(a) for a in arrays]
+        for buf in bufs:
+            _sync(buf)
+        times.append(time.perf_counter() - t0)
+        del bufs
+    return float(np.median(times))
+
+
+def _print_split(name: str, h2d_s: float, nbytes: int,
+                 compute_s: float, total_s: float) -> None:
+    """One ``# split |`` line per transfer-bound metric: h2d vs compute
+    vs marshalling-included total, so blame is apportionable."""
+    print(
+        f"# split | {name} h2d_s={h2d_s:.6f} mb={nbytes / 1e6:.1f} "
+        f"compute_s={compute_s:.6f} host_total_s={total_s:.6f}"
+    )
+
+
+def _bench_map_blocks_logreg(
+    n_rows: int = 262_144, iters: int = 5, device: bool = True,
+    num_blocks: int = 1,
+):
     import tensorframes_tpu as tfs
     from tensorframes_tpu.models import logreg
 
     x, _ = logreg.make_synthetic_mnist(n_rows)
-    frame = tfs.frame_from_arrays({"features": x}, num_blocks=1).to_device()
+    frame = tfs.frame_from_arrays({"features": x}, num_blocks=num_blocks)
+    if device:
+        frame = frame.to_device()
     params = logreg.init_params()
     scoring = logreg.scoring_program(params)
     program = tfs.compile_program(lambda features: scoring(features), frame)
 
     def run_once():
         out = tfs.map_blocks(program, frame)
-        [b] = out.blocks()
-        _sync(b["scores"])
-        _sync(b["label"])
+        for b in out.blocks():
+            _sync(b["scores"])
+            _sync(b["label"])
 
     rps = _time_rows_per_sec(run_once, n_rows, iters)
-    _record_mfu("bench.logreg", program, rps, n_rows)
+    if device:
+        _record_mfu("bench.logreg", program, rps, n_rows)
     return rps
 
 
-def _bench_add3(n_rows: int = 1_000_000, iters: int = 10):
+def _bench_add3(n_rows: int = 1_000_000, iters: int = 10,
+                device: bool = True, num_blocks: int = 1):
     """README add-3 config (BASELINE config 1)."""
     import tensorframes_tpu as tfs
 
     frame = tfs.frame_from_arrays(
-        {"x": np.arange(n_rows, dtype=np.float32)}, num_blocks=1
-    ).to_device()
+        {"x": np.arange(n_rows, dtype=np.float32)}, num_blocks=num_blocks
+    )
+    if device:
+        frame = frame.to_device()
     program = tfs.compile_program(lambda x: {"z": x + 3.0}, frame)
 
     def run_once():
         out = tfs.map_blocks(program, frame)
-        [b] = out.blocks()
-        _sync(b["z"])
+        for b in out.blocks():
+            _sync(b["z"])
 
     return _time_rows_per_sec(run_once, n_rows, iters)
 
@@ -332,9 +369,13 @@ def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
     # params as runtime ARGUMENTS, not closure constants: closure capture
     # embeds the full weight tree in the HLO payload (gpt-small f32 is
     # ~0.5 GB of literals — it crashed the remote-compile relay; it also
-    # bloats any AOT artifact), device_put once and pass through
+    # bloats any AOT artifact), device_put once and pass through.
+    # int8 runs also quantize the KV cache — decode's HBM traffic that
+    # GROWS with sequence, the config where int8 must pay (VERDICT r3 #4)
     d_params = jax.device_put(params)
-    fn = jax.jit(lambda prms, p: gen.generate(cfg, prms, p, new))
+    fn = jax.jit(
+        lambda prms, p: gen.generate(cfg, prms, p, new, kv_quant=int8)
+    )
 
     def run_once():
         _sync(fn(d_params, prompts))
@@ -471,13 +512,15 @@ def _bench_map_rows_ragged(n_rows: int = 20_000, iters: int = 3):
     return _time_rows_per_sec(run_once, n_rows, iters)
 
 
-def _bench_reduce_blocks(n_rows: int = 1_000_000):
+def _bench_reduce_blocks(n_rows: int = 1_000_000, device: bool = True):
     """reduce_blocks wall-clock (BASELINE config 2 analogue)."""
     import tensorframes_tpu as tfs
     from tensorframes_tpu import dtypes as dt
 
     arr = np.stack([np.arange(n_rows, dtype=np.float32)] * 2, axis=1)
-    frame = tfs.frame_from_arrays({"y": arr}, num_blocks=1).to_device()
+    frame = tfs.frame_from_arrays({"y": arr}, num_blocks=1)
+    if device:
+        frame = frame.to_device()
     with tfs.with_graph():
         y_input = tfs.block(frame, "y", tf_name="y_input")
         y = tfs.reduce_sum(y_input, axis=0, name="y")
@@ -689,6 +732,30 @@ def main():
                     metric_keys=("add3_map_blocks_rows_per_sec",))
     reduce_s = _try("reduce_blocks", _bench_reduce_blocks, float("nan"),
                     metric_keys=("reduce_blocks_1M_wall_s",))
+    # HOST-frame variants: marshalling INCLUDED (the device-resident
+    # metrics above exclude it), so each transfer-bound metric has an
+    # included/excluded pair and `# split |` lines below apportion the
+    # difference (VERDICT r3 #2). Host logreg uses 64k rows in 4 blocks:
+    # per-block transfers stay under the relay tunnel's request limit
+    # and exercise the map_blocks prefetch overlap.
+    logreg_host_rows = 65_536
+    logreg_host_rps = _try(
+        "logreg_host",
+        lambda: _bench_map_blocks_logreg(
+            n_rows=logreg_host_rows, iters=3, device=False, num_blocks=4
+        ),
+        0.0,
+        metric_keys=("logreg_host_map_blocks_rows_per_sec",),
+    )
+    add3_host_rps = _try(
+        "add3_host", lambda: _bench_add3(device=False, num_blocks=4), 0.0,
+        metric_keys=("add3_host_map_blocks_rows_per_sec",),
+    )
+    reduce_host_s = _try(
+        "reduce_blocks_host",
+        lambda: _bench_reduce_blocks(device=False), float("nan"),
+        metric_keys=("reduce_blocks_host_1M_wall_s",),
+    )
     aggregate_s = _try("aggregate", _bench_aggregate, float("nan"),
                        metric_keys=("aggregate_1M_512groups_wall_s",))
     aggregate_dev_s = _try(
@@ -701,6 +768,66 @@ def main():
     )
     ragged_rps = _try("map_rows_ragged", _bench_map_rows_ragged, 0.0,
                       metric_keys=("map_rows_ragged_rows_per_sec",))
+
+    # transfer/compute apportionment (VERDICT r3 #2): one `# split |`
+    # line per transfer-bound metric — h2d_s measured with a standalone
+    # device_put probe of the metric's own input arrays, compute_s from
+    # the device-resident variant, host_total_s from the host variant
+    def _split(name, arrays, compute_s, total_s):
+        try:
+            nbytes = sum(int(a.nbytes) for a in arrays)
+            _print_split(
+                name, _h2d_seconds(arrays), nbytes, compute_s, total_s
+            )
+        except Exception as e:
+            print(f"# split | {name} probe failed: {e}")
+
+    _split(
+        "add3",
+        [np.arange(1_000_000, dtype=np.float32)],
+        1e6 / add3_rps if add3_rps else float("nan"),
+        1e6 / add3_host_rps if add3_host_rps else float("nan"),
+    )
+    try:
+        from tensorframes_tpu.models import logreg as _lr
+
+        # like-for-like: compute_s from a DEVICE-resident run at the
+        # host variant's exact config (64k rows, 4 blocks) — the main
+        # logreg metric's 262k/1-block rate would misattribute any
+        # per-dispatch latency to transfer
+        logreg_dev_small = _bench_map_blocks_logreg(
+            n_rows=logreg_host_rows, iters=3, device=True, num_blocks=4
+        )
+        _split(
+            "logreg",
+            [_lr.make_synthetic_mnist(logreg_host_rows)[0]],
+            (logreg_host_rows / logreg_dev_small
+             if logreg_dev_small else float("nan")),
+            (logreg_host_rows / logreg_host_rps
+             if logreg_host_rps else float("nan")),
+        )
+    except Exception as e:
+        print(f"# split | logreg probe failed: {e}")
+    _split(
+        "reduce_blocks",
+        [np.stack([np.arange(1_000_000, dtype=np.float32)] * 2, axis=1)],
+        reduce_s,
+        reduce_host_s,
+    )
+    _rng = np.random.default_rng(0)
+    _split(
+        "aggregate",
+        [_rng.integers(0, 512, 1_000_000),
+         _rng.standard_normal(1_000_000).astype(np.float32)],
+        aggregate_dev_s,
+        aggregate_s,
+    )
+    _split(
+        "map_rows_ragged",
+        [np.zeros((5_000, n), np.float32) for n in (8, 16, 24, 32)],
+        float("nan"),  # device-resident ragged variant: see ragged task
+        20_000 / ragged_rps if ragged_rps else float("nan"),
+    )
     # full-scale Inception on the real chip; CPU fallback shrinks widths so
     # the harness stays runnable anywhere
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -731,9 +858,10 @@ def main():
     inception_frozen_rps = _try(
         "inception_frozen",
         lambda: _bench_inception_frozen(
-            # 256 rows/call: the r3 TPU run showed batch 64 leaving the
-            # MXU ~5x under-fed next to the native model's 512-row calls
-            n_rows=256 if on_tpu else 8,
+            # 512 rows/call — the SAME per-call batch as the native
+            # model (the r3 TPU run showed batch 64 leaving the MXU
+            # ~5x under-fed; VERDICT r3 #3 wants like-for-like)
+            n_rows=512 if on_tpu else 8,
             iters=3 if on_tpu else 1,
             side=299 if on_tpu else 75,
         ),
@@ -743,7 +871,7 @@ def main():
     inception_frozen_rps_q = _try(
         "inception_frozen_int8",
         lambda: _bench_inception_frozen(
-            n_rows=256 if on_tpu else 8,
+            n_rows=512 if on_tpu else 8,
             iters=3 if on_tpu else 1,
             side=299 if on_tpu else 75,
             int8=True,
@@ -754,7 +882,7 @@ def main():
     inception_frozen_rps_bf16 = _try(
         "inception_frozen_bf16",
         lambda: _bench_inception_frozen(
-            n_rows=256 if on_tpu else 8,
+            n_rows=512 if on_tpu else 8,
             iters=3 if on_tpu else 1,
             side=299 if on_tpu else 75,
             compute_dtype="bfloat16",
@@ -815,7 +943,7 @@ def main():
         ),
         0.0,
         metric_keys=(
-            f"gpt_{'small' if on_tpu else 'tiny'}_int8_decode_tokens_per_sec",
+            f"gpt_{'small' if on_tpu else 'tiny'}_int8kv_decode_tokens_per_sec",
         ),
     )
 
@@ -834,7 +962,10 @@ def main():
         "convertback_1M_int_cells_s": round(convertback_s, 6),
         "read_csv_1M_rows_s": round(read_csv_s, 6),
         "add3_map_blocks_rows_per_sec": round(add3_rps),
+        "add3_host_map_blocks_rows_per_sec": round(add3_host_rps),
+        "logreg_host_map_blocks_rows_per_sec": round(logreg_host_rps),
         "reduce_blocks_1M_wall_s": round(reduce_s, 6),
+        "reduce_blocks_host_1M_wall_s": round(reduce_host_s, 6),
         "aggregate_1M_512groups_wall_s": round(aggregate_s, 6),
         "aggregate_device_1M_512groups_wall_s": round(aggregate_dev_s, 6),
         "aggregate_strings_1M_512groups_wall_s": round(aggregate_str_s, 6),
@@ -854,7 +985,7 @@ def main():
         ),
         f"flash_attention_{attn_seq}seq_tokens_per_sec": round(attn_tps),
         f"gpt_{size}_decode_tokens_per_sec": round(gen_tps),
-        f"gpt_{size}_int8_decode_tokens_per_sec": round(gen_tps_q),
+        f"gpt_{size}_int8kv_decode_tokens_per_sec": round(gen_tps_q),
     }
     print(f"# chips={n_chips} devices={jax.devices()}")
     print(f"# native_marshalling={'on' if native.available() else 'off'}")
@@ -900,32 +1031,44 @@ def main():
     for ln in mfu_rows:
         print(f"# mfu | {ln}")
 
+    # The published baseline is full-scale-on-TPU (BASELINE.json). The
+    # ratio is only meaningful TPU-vs-TPU: a CPU fallback run uses a
+    # shrunken model, so it carries the recorded TPU baseline alongside
+    # its own number and NULLS the ratio — never 1.0 against itself
+    # (VERDICT r3 #6).
     baseline = None
-    # the published baseline is full-scale-on-TPU; a CPU fallback run uses a
-    # shrunken model, so label it distinctly and never compare across configs
-    metric = "map_blocks rows/sec/chip (Inception-v3)"
-    if on_tpu:
-        try:
-            with open("BASELINE.json") as f:
-                baseline = json.load(f).get("published", {}).get(
-                    "inception_v3_map_blocks_rows_per_sec_per_chip"
-                )
-        except Exception:
-            pass
-    else:
-        metric += " [cpu-fallback, 1/8 width]"
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BASELINE.json")
+        ) as f:
+            baseline = json.load(f).get("published", {}).get(
+                "inception_v3_map_blocks_rows_per_sec_per_chip"
+            )
+    except Exception:
+        pass
     value = inception_rps / n_chips
-    vs = value / baseline if baseline else 1.0
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 1),
-                "unit": "rows/s/chip",
-                "vs_baseline": round(vs, 3),
-            }
+    out = {
+        "metric": "map_blocks rows/sec/chip (Inception-v3)",
+        "value": round(value, 1),
+        "unit": "rows/s/chip",
+    }
+    if on_tpu:
+        out["vs_baseline"] = (
+            round(value / baseline, 3) if baseline else None
         )
-    )
+    else:
+        out["metric"] += " [cpu-fallback, 1/8 width]"
+        out["value_cpu_fallback"] = out["value"]
+        if baseline:
+            out["tpu_baseline_on_record"] = baseline
+            out["note"] = (
+                "TPU baseline on record: "
+                f"{baseline:g} rows/s/chip (not comparable to the "
+                "shrunken cpu-fallback config)"
+            )
+        out["vs_baseline"] = None
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -52,6 +52,18 @@ class Config:
     # map_blocks keeps this many extra blocks in flight so transfer and
     # compute overlap (0 = fully synchronous per block).
     map_pipeline_depth: int = _env_int("TFTPU_MAP_PIPELINE_DEPTH", 2)
+    # map_blocks host-frame path: stage up to this many blocks' feeds in
+    # HBM from a background thread (io.prefetch_to_device) so the
+    # host→device transfer of block k+1 overlaps block k's compute —
+    # the answer to the reference's admitted convert bottleneck
+    # (TFDataOps.scala:32-33) on transfer-taxed links (0 = off).
+    map_prefetch_depth: int = _env_int("TFTPU_MAP_PREFETCH_DEPTH", 2)
+    # Donate freshly-transferred input buffers to the XLA executable so
+    # output HBM reuses input HBM (halves peak footprint for big
+    # blocks). Only applies where provably safe: host-sourced feeds on
+    # backends that implement donation (not XLA:CPU); device-resident
+    # frame columns are never donated.
+    donate_inputs: bool = _env_bool("TFTPU_DONATE_INPUTS", True)
     # Per-chip peak FLOP/s for MFU accounting in profiling.report()
     # (0 = unknown; bench.py sets it from the detected device kind).
     peak_flops: float = float(os.environ.get("TFTPU_PEAK_FLOPS", 0) or 0)

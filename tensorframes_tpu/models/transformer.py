@@ -163,10 +163,10 @@ def _layer_norm(x, scale, bias, eps=1e-6):
 
 def _attention(cfg: TransformerConfig, p, x, mask, mesh=None):
     from ..ops import attention as att
-    from ..ops.quantize import asarray as _w
+    from ..ops.quantize import matmul as _mm
 
     b, s, h = x.shape
-    qkv = (x @ _w(p["qkv"], x.dtype)).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+    qkv = _mm(x, p["qkv"]).reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     # [b, heads, s, d]
     q = q.transpose(0, 2, 1, 3)
@@ -202,15 +202,15 @@ def _attention(cfg: TransformerConfig, p, x, mask, mesh=None):
     else:
         raise ValueError(f"Unknown attention_impl {impl!r}")
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
-    return ctx @ _w(p["out"], x.dtype)
+    return _mm(ctx, p["out"])
 
 
 def _mlp(p, x):
-    from ..ops.quantize import asarray as _w
+    from ..ops.quantize import matmul as _mm
 
-    y = x @ _w(p["in"], x.dtype) + p["in_bias"].astype(x.dtype)
+    y = _mm(x, p["in"]) + p["in_bias"].astype(x.dtype)
     y = jax.nn.gelu(y)
-    return y @ _w(p["out"], x.dtype) + p["out_bias"].astype(x.dtype)
+    return _mm(y, p["out"]) + p["out_bias"].astype(x.dtype)
 
 
 def forward(

@@ -60,7 +60,10 @@ class HoistedProgram:
     calls reuse the committed device buffers instead of re-uploading
     weights per call."""
 
-    __slots__ = ("jitted", "consts", "in_tree", "_flat_abstract")
+    __slots__ = (
+        "jitted", "consts", "in_tree", "_flat_abstract", "_run",
+        "_jitted_donate",
+    )
 
     def __init__(self, fn: Callable, abstract_inputs):
         from jax.core import eval_jaxpr
@@ -79,12 +82,22 @@ class HoistedProgram:
             outs = eval_jaxpr(jaxpr, consts, *flat_ins)
             return jax.tree_util.tree_unflatten(out_tree, outs)
 
+        self._run = run
         self.jitted = jax.jit(run)
+        self._jitted_donate = None
 
-    def __call__(self, inputs):
+    def __call__(self, inputs, donate: bool = False):
         flat, tree = jax.tree_util.tree_flatten(inputs)
         if tree != self.in_tree:
             raise ValueError("input structure changed since tracing")
+        if donate:
+            # donate the flat INPUTS only — the hoisted consts (model
+            # weights) are reused across calls and must never be donated
+            if self._jitted_donate is None:
+                self._jitted_donate = jax.jit(
+                    self._run, donate_argnums=(1,)
+                )
+            return self._jitted_donate(self.consts, flat)
         return self.jitted(self.consts, flat)
 
     def aot_compile(self):

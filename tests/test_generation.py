@@ -88,3 +88,61 @@ def test_sampling_differs_across_blocks(setup):
     a, b = (np.asarray(blk["generated"]) for blk in blocks)
     assert a.shape == b.shape == (2, 8)
     assert not np.array_equal(a, b)
+
+
+def test_int8_kv_cache_decode_close_and_smaller():
+    """VERDICT r3 #4: the int8 KV cache must (a) shrink the cache's HBM
+    footprint (the per-step traffic that grows with sequence), and
+    (b) decode numerically close to the full-precision cache — scales
+    commute out of the score contraction and fold into the softmax
+    weights, so the math is the same modulo int8 rounding."""
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+
+    cfg = gen.gpt_tiny()
+    params = tr.init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+
+    # footprint: int8 cache well under half the bf16 cache (1 byte + the
+    # 1/head_dim scale overhead vs 2 bytes), 4x under an f32 cache
+    c_full = gen.init_kv_cache(cfg, 2, length=12)
+    c_q = gen.init_kv_cache(cfg, 2, length=12, quant=True)
+    assert gen.kv_cache_nbytes(c_q) < 0.6 * gen.kv_cache_nbytes(c_full)
+
+    # prefill hidden states: quantization noise stays small
+    hs_f, _ = gen._forward_cached(cfg, params, jnp.asarray(prompts), c_full, 0)
+    hs_q, _ = gen._forward_cached(cfg, params, jnp.asarray(prompts), c_q, 0)
+    err = float(jnp.linalg.norm(hs_q.astype(jnp.float32) - hs_f.astype(jnp.float32)))
+    ref = float(jnp.linalg.norm(hs_f.astype(jnp.float32)))
+    assert err / ref < 0.05, f"relative error {err / ref:.3f}"
+
+    # end-to-end greedy decode agrees with the full-precision cache on
+    # a large majority of tokens (greedy argmax can flip on ties)
+    out_f = np.asarray(gen.generate(cfg, params, prompts, 8))
+    out_q = np.asarray(gen.generate(cfg, params, prompts, 8, kv_quant=True))
+    assert out_q.shape == out_f.shape == (2, 8)
+    agree = float((out_f == out_q).mean())
+    assert agree >= 0.75, f"token agreement {agree:.2f}"
+
+
+def test_int8_kv_cache_with_quantized_weights():
+    """The int8 cache composes with weight-only int8 params (the bench's
+    int8 decode config): runs end to end, right shape/dtype."""
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+
+    cfg = gen.gpt_tiny()
+    params = tr.quantize_params(tr.init_params(cfg, seed=0))
+    rng = np.random.default_rng(1)
+    prompts = rng.integers(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+    out = np.asarray(
+        gen.generate(cfg, params, prompts, 6, kv_quant=True)
+    )
+    assert out.shape == (2, 6) and out.dtype == np.int32
+    # the program variant threads the flag through too
+    prog = gen.generate_program(cfg, params, 6, kv_quant=True)
+    out2 = prog(prompts)
+    assert np.asarray(out2["generated"]).shape == (2, 6)

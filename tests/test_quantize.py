@@ -208,3 +208,37 @@ def test_int8_frozen_weights_survive_to_executable():
     got_q = np.asarray(build(True).fn({"x": x})["m"])
     want = x @ w
     np.testing.assert_allclose(got_q, want, rtol=0.05, atol=0.05 * np.abs(want).max())
+
+
+def test_fused_dequant_matmul_matches_dequantize():
+    """ops/quantize.matmul: (x @ q) * s must equal x @ (q * s) — the
+    per-output-channel scale commutes out of the contraction, which is
+    what lets int8 weights stream from HBM without a materialized
+    dequantized copy (VERDICT r3 #4)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorframes_tpu.ops import quantize as qz
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 48)).astype(np.float32)
+    x = rng.standard_normal((16, 64)).astype(np.float32)
+    qt = qz.quantize(w)
+    got = qz.matmul(jnp.asarray(x), qt)
+    want = jnp.asarray(x) @ qt.dequantize(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    # plain weights pass straight through (cast to x.dtype)
+    got_plain = qz.matmul(jnp.asarray(x), w)
+    np.testing.assert_allclose(
+        np.asarray(got_plain), x @ w, rtol=1e-6, atol=1e-6
+    )
+    # a scale layout that spans contracted axes falls back to explicit
+    # dequantize (correctness over fusion)
+    qt_row = qz.quantize(w, channel_axis=0)  # scale [in, 1]: no commute
+    got_row = qz.matmul(jnp.asarray(x), qt_row)
+    want_row = jnp.asarray(x) @ qt_row.dequantize(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got_row), np.asarray(want_row), rtol=2e-5, atol=2e-5
+    )
