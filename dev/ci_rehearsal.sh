@@ -1,0 +1,76 @@
+#!/bin/bash
+# Faithful LOCAL rehearsal of .github/workflows/ci.yml (VERDICT r3 #8).
+#
+# No GitHub runner is reachable from this environment (zero egress, no
+# github.com), so this script executes the workflow's exact steps, in
+# order, against a CLEAN CLONE of HEAD (the checkout step's semantics:
+# CI must not see uncommitted files) inside a fresh venv. Documented
+# deviations from the literal yml, each forced by the sandbox:
+#
+#   * matrix python-version: only the image's python (3.12) is
+#     installed; the 3.11 leg cannot run here.
+#   * `pip install -U pip` + `pip install -e ".[test]"`: the image has
+#     no package index (zero egress). The venv is created with
+#     --system-site-packages so the baked-in deps (jax, numpy, pytest,
+#     …) satisfy the requirements, and the project itself installs with
+#     --no-deps --no-build-isolation — the same "editable install then
+#     run from the installed package" shape the workflow exercises.
+#
+# Usage: bash dev/ci_rehearsal.sh [logfile]
+set -u -o pipefail
+
+LOG=${1:-dev/ci_rehearsal.log}
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+WORK=$(mktemp -d /tmp/ci_rehearsal.XXXXXX)
+CLONE="$WORK/repo"
+VENV="$WORK/venv"
+export PALLAS_AXON_POOL_IPS=  # CPU CI: never touch the TPU relay
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+run_step() {
+  local name="$1"; shift
+  echo "=== step: $name ===" | tee -a "$LOG"
+  if ( "$@" ) >> "$LOG" 2>&1; then
+    echo "--- step OK: $name" | tee -a "$LOG"
+  else
+    echo "--- step FAILED: $name (exit $?)" | tee -a "$LOG"
+    echo "CI REHEARSAL: FAILED at '$name' — log: $LOG"
+    exit 1
+  fi
+}
+
+: > "$LOG"
+{
+  echo "ci.yml rehearsal — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "HEAD: $(git -C "$REPO" rev-parse HEAD)"
+  echo "python: $(python --version 2>&1)"
+  echo "workdir: $WORK"
+} | tee -a "$LOG"
+
+run_step "checkout (clean clone of HEAD)" \
+  git clone --quiet --no-hardlinks "$REPO" "$CLONE"
+
+run_step "setup-python (venv, system site-packages for baked-in deps)" \
+  python -m venv --system-site-packages "$VENV"
+
+cd "$CLONE"
+PY="$VENV/bin/python"
+
+run_step "Install (editable, --no-deps: zero-egress image carries deps)" \
+  "$PY" -m pip install -e . --no-deps --no-build-isolation --quiet
+
+run_step "Test (8-device virtual CPU mesh)" \
+  "$PY" -m pytest tests/ -x -q
+
+run_step "Bench smoke (CPU fallback)" bash -c \
+  "\"$PY\" -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()\" | tee bench_out.txt"
+
+run_step "Bench regression gate (factor 10, alien-runner allowance)" \
+  "$PY" dev/bench_check.py bench_out.txt --factor 10
+
+run_step "Multi-chip dryrun (8 virtual devices)" \
+  "$PY" -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI REHEARSAL: ALL STEPS GREEN — log: $LOG" | tee -a "$LOG"
+rm -rf "$WORK"

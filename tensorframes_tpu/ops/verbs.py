@@ -513,17 +513,36 @@ def map_rows(
                     a.nbytes for f in staged for a in f.values()
                 )
                 if staged_bytes <= _RAGGED_STAGE_BYTES:
-                    # one transfer, every group dispatched before the
-                    # first sync — bounded by the byte cap so a
-                    # many-GB ragged block cannot OOM HBM by holding
-                    # all groups' inputs AND outputs at once
+                    # one transfer for every group's INPUTS (byte-capped
+                    # above), then a windowed dispatch/drain: at most
+                    # map_pipeline_depth+1 groups' OUTPUTS are resident
+                    # at once — a tiny-input/large-output program (rows
+                    # of filenames producing images) must not hold every
+                    # group's outputs in HBM simultaneously
+                    from collections import deque as _deque
+
                     staged = jax.device_put(staged)
-                    outs_list = [
+                    window = max(1, get_config().map_pipeline_depth)
+                    outs_list = []
+                    in_flight_r: _deque = _deque()
+                    for f in staged:
                         # freshly-transferred private copies:
                         # donation-safe (honoring the kill switch)
-                        compiled.run_rows(f, to_numpy=False, donate=donate_r)
-                        for f in staged
-                    ]
+                        in_flight_r.append(
+                            compiled.run_rows(
+                                f, to_numpy=False, donate=donate_r
+                            )
+                        )
+                        if len(in_flight_r) > window:
+                            o = in_flight_r.popleft()
+                            outs_list.append(
+                                {k: np.asarray(v) for k, v in o.items()}
+                            )
+                    while in_flight_r:
+                        o = in_flight_r.popleft()
+                        outs_list.append(
+                            {k: np.asarray(v) for k, v in o.items()}
+                        )
                 else:
                     # huge ragged block: group-at-a-time with an eager
                     # per-group sync so only one group's inputs+outputs

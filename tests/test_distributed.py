@@ -112,6 +112,28 @@ with tfs.with_graph():
     )
 ggot = {{int(r["k"]): float(r["v"]) for r in gagg.collect()}}
 assert ggot == want, (ggot, want)
+# multi-process JOIN (VERDICT r3 #7 — replaces the spans-processes
+# raise): broadcast hash join — every process allgathers the right
+# side (device key/value columns AND a host string column), joins its
+# LOCAL left rows, and holds its share of the output process-locally
+rt = frame_from_process_local(
+    {{"k": np.asarray([pid]), "w": np.asarray([100.0 * pid]),
+      "name": ["proc%d" % pid]}},
+    mesh=mesh, axis="dp",
+)
+joined = kf.join(rt, on="k")
+jrows = joined.collect()
+jwant = [(pid, 10.0 * pid + 1.0, 100.0 * pid, "proc%d" % pid)]
+if pid + 1 < NPROC:
+    jwant.append(
+        (pid + 1, 10.0 * pid + 2.0, 100.0 * (pid + 1),
+         "proc%d" % (pid + 1))
+    )
+jgot = [
+    (int(r["k"]), float(r["v"]), float(r["w"]), str(r["name"]))
+    for r in jrows
+]
+assert jgot == jwant, (jgot, jwant)
 # sharded persistence: each process writes its part, reloads, and the
 # reassembled global frame reduces to the same total across hosts
 sf_dir = {sf_dir!r}
