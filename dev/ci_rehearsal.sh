@@ -4,26 +4,32 @@
 # No GitHub runner is reachable from this environment (zero egress, no
 # github.com), so this script executes the workflow's exact steps, in
 # order, against a CLEAN CLONE of HEAD (the checkout step's semantics:
-# CI must not see uncommitted files) inside a fresh venv. Documented
-# deviations from the literal yml, each forced by the sandbox:
+# CI must not see uncommitted files). Documented deviations from the
+# literal yml, each forced by the sandbox:
 #
 #   * matrix python-version: only the image's python (3.12) is
 #     installed; the 3.11 leg cannot run here.
 #   * `pip install -U pip` + `pip install -e ".[test]"`: the image has
-#     no package index (zero egress). The venv is created with
-#     --system-site-packages so the baked-in deps (jax, numpy, pytest,
-#     …) satisfy the requirements, and the project itself installs with
-#     --no-deps --no-build-isolation — the same "editable install then
-#     run from the installed package" shape the workflow exercises.
+#     no package index (zero egress) and the interpreter is itself a
+#     venv (a nested venv would lose its site-packages), so the project
+#     installs from the clean clone with --no-deps --no-build-isolation
+#     into a private --target directory — the same "build the package
+#     metadata, then run the suite against the checkout" shape the
+#     workflow exercises; the baked-in deps stand in for the [test]
+#     extra.
 #
 # Usage: bash dev/ci_rehearsal.sh [logfile]
 set -u -o pipefail
 
-LOG=${1:-dev/ci_rehearsal.log}
 REPO=$(cd "$(dirname "$0")/.." && pwd)
+LOG=${1:-dev/ci_rehearsal.log}
+case "$LOG" in
+  /*) : ;;
+  *) LOG="$REPO/$LOG" ;;  # absolute: the steps cd into the clone
+esac
 WORK=$(mktemp -d /tmp/ci_rehearsal.XXXXXX)
 CLONE="$WORK/repo"
-VENV="$WORK/venv"
+SITE="$WORK/site"
 export PALLAS_AXON_POOL_IPS=  # CPU CI: never touch the TPU relay
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -34,7 +40,8 @@ run_step() {
   if ( "$@" ) >> "$LOG" 2>&1; then
     echo "--- step OK: $name" | tee -a "$LOG"
   else
-    echo "--- step FAILED: $name (exit $?)" | tee -a "$LOG"
+    local rc=$?
+    echo "--- step FAILED: $name (exit $rc)" | tee -a "$LOG"
     echo "CI REHEARSAL: FAILED at '$name' — log: $LOG"
     exit 1
   fi
@@ -51,26 +58,28 @@ run_step() {
 run_step "checkout (clean clone of HEAD)" \
   git clone --quiet --no-hardlinks "$REPO" "$CLONE"
 
-run_step "setup-python (venv, system site-packages for baked-in deps)" \
-  python -m venv --system-site-packages "$VENV"
+run_step "setup-python (image interpreter; 3.11 leg unavailable here)" \
+  python -c "import sys; assert sys.version_info >= (3, 11); print(sys.version)"
 
 cd "$CLONE"
-PY="$VENV/bin/python"
 
-run_step "Install (editable, --no-deps: zero-egress image carries deps)" \
-  "$PY" -m pip install -e . --no-deps --no-build-isolation --quiet
+run_step "Install (clean-clone package, --no-deps: zero-egress image carries deps)" \
+  python -m pip install . --no-deps --no-build-isolation --quiet --target "$SITE"
+
+run_step "Install check (package metadata + import from install target)" \
+  env PYTHONPATH="$SITE" python -c "import tensorframes_tpu, importlib.metadata as md; print('installed', md.version('tensorframes-tpu'))"
 
 run_step "Test (8-device virtual CPU mesh)" \
-  "$PY" -m pytest tests/ -x -q
+  python -m pytest tests/ -x -q
 
 run_step "Bench smoke (CPU fallback)" bash -c \
-  "\"$PY\" -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()\" | tee bench_out.txt"
+  "set -o pipefail; python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()\" | tee bench_out.txt"
 
 run_step "Bench regression gate (factor 10, alien-runner allowance)" \
-  "$PY" dev/bench_check.py bench_out.txt --factor 10
+  python dev/bench_check.py bench_out.txt --factor 10
 
 run_step "Multi-chip dryrun (8 virtual devices)" \
-  "$PY" -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "CI REHEARSAL: ALL STEPS GREEN — log: $LOG" | tee -a "$LOG"
 rm -rf "$WORK"

@@ -488,15 +488,26 @@ class TensorFrame:
             import jax.numpy as jnp
 
             def _dev_key_ok(v):
-                return (
-                    _is_jax_array(v)
-                    and v.ndim == 1
-                    and v.dtype != jnp.dtype(jnp.uint64)
-                    and (
-                        v.dtype == jnp.bool_
-                        or jnp.issubdtype(v.dtype, jnp.integer)
-                        or jnp.issubdtype(v.dtype, jnp.floating)
+                if not (_is_jax_array(v) and v.ndim == 1):
+                    return False
+                if jnp.issubdtype(v.dtype, jnp.unsignedinteger):
+                    # unsigned keys widen to a signed code: uint8/16
+                    # always fit int32; uint32 needs int64, which only
+                    # exists with x64 on (astype(int64) silently
+                    # canonicalizes to int32 otherwise — 3e9 would wrap
+                    # negative and sort first); uint64 cannot widen
+                    import jax as _jax
+
+                    if v.dtype.itemsize <= 2:
+                        return True
+                    return (
+                        v.dtype.itemsize == 4
+                        and bool(_jax.config.jax_enable_x64)
                     )
+                return (
+                    v.dtype == jnp.bool_
+                    or jnp.issubdtype(v.dtype, jnp.integer)
+                    or jnp.issubdtype(v.dtype, jnp.floating)
                 )
 
             if all(_dev_key_ok(merged[k]) for k in keys) and all(
@@ -851,16 +862,18 @@ class TensorFrame:
                 )
 
                 def local_merged(fr):
+                    # returns None (not raise) when a column has no
+                    # addressable shard here: eligibility is VOTED on
+                    # below so every process raises together instead of
+                    # one bailing out while its peers sit in the
+                    # allgather collective
                     cols: Dict[str, np.ndarray] = {}
                     for name in fr.schema.names:
                         parts = []
                         for b in fr.blocks():
                             lr = extract_local_rows(b[name])
                             if lr is None:
-                                raise RuntimeError(
-                                    f"join: column {name!r} has no "
-                                    "addressable shard on this process"
-                                )
+                                return None
                             parts.append(lr)
                         cols[name] = (
                             parts[0] if len(parts) == 1
@@ -868,9 +881,19 @@ class TensorFrame:
                         )
                     return cols
 
+                from .ops.device_agg import uniform_ok
+
                 lcols = local_merged(left)
                 r_names = list(right.schema.names)
                 r_local = local_merged(right)
+                if not uniform_ok(
+                    lcols is not None and r_local is not None
+                ):
+                    raise RuntimeError(
+                        "join: some process holds no addressable shard "
+                        "of a column — re-shard so every process holds "
+                        "rows of both sides (frame_from_process_local)"
+                    )
                 union, _ = _allgather_dicts([r_local[n] for n in r_names])
                 rcols = dict(zip(r_names, union))
                 out = join_cols(lcols, rcols)

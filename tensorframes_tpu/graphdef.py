@@ -496,6 +496,9 @@ _BINARY = {
 }
 _UNARY = {
     "Identity": lambda x: x,
+    # a VarHandleOp resolves to the variable's VALUE at import (clean-room
+    # bundle restore, bundle.py), so the read is an identity
+    "ReadVariableOp": lambda x: x,
     "Neg": jnp.negative,
     "Square": jnp.square,
     "Abs": jnp.abs,
@@ -589,6 +592,7 @@ _BINARY_NP = {
 }
 _UNARY_NP = {
     "Identity": lambda x: x,
+    "ReadVariableOp": lambda x: x,
     "Neg": np.negative,
     "Square": np.square,
     "Abs": np.abs,
@@ -943,6 +947,7 @@ def program_from_graphdef(
     relax_lead_dim: bool = False,
     quantize_weights: bool = False,
     compute_dtype: Optional[str] = "auto",
+    variables: Optional[Dict[str, np.ndarray]] = None,
 ) -> Program:
     """Lower decoded GraphDef nodes to a :class:`Program`.
 
@@ -962,6 +967,14 @@ def program_from_graphdef(
     all other ops stay exact. The default ``"auto"`` serves bfloat16 on
     accelerator backends and f32-faithful on CPU; pass ``None`` for
     f32-faithful everywhere (:func:`_resolve_compute_dtype`).
+
+    ``variables`` binds VarHandleOp nodes to concrete values (keyed by
+    the op's ``shared_name``, falling back to the node name): the handle
+    evaluates to the value and ``ReadVariableOp`` is an identity —
+    un-frozen variable-bearing graphs run as pure programs.
+    ``load_saved_model`` fills this from the checkpoint bundle
+    (clean-room, ``bundle.py``) so no TensorFlow is needed even at
+    conversion time.
     """
     compute_dtype = _resolve_compute_dtype(compute_dtype)
     by_name = {n.name: n for n in nodes}
@@ -1083,9 +1096,27 @@ def program_from_graphdef(
             inputs.append(TensorSpec(n.name, dtype, Shape(dims)))
         elif n.op == "Const":
             consts[n.name] = n.attrs["value"].tensor
+        elif n.op == "VarHandleOp":
+            sn = n.attrs.get("shared_name")
+            key = (
+                sn.s.decode("utf-8") if sn is not None and sn.s else n.name
+            )
+            if variables is not None and key in variables:
+                consts[n.name] = np.asarray(variables[key])
+            elif variables is not None and n.name in variables:
+                consts[n.name] = np.asarray(variables[n.name])
+            else:
+                raise ValueError(
+                    f"graph contains variable {key!r} (VarHandleOp node "
+                    f"{n.name!r}) with no bound value; pass "
+                    "variables={name: array} — load_saved_model restores "
+                    "them from the checkpoint bundle automatically "
+                    "(tensorframes_tpu.bundle)"
+                )
 
     structural = (
         "Placeholder", "Const", "Cast", "Reshape", "MatMul", "NoOp",
+        "VarHandleOp",
         "Conv2D", "DepthwiseConv2dNative", "MaxPool", "AvgPool",
         "BiasAdd", "ConcatV2", "Concat", "Squeeze", "Pad", "PadV2",
         "FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3",
@@ -1302,13 +1333,15 @@ def program_from_graphdef(
                     )
                 if node.op == "Placeholder":
                     values[nm] = feeds[nm]
-                elif node.op == "Const":
+                elif node.op in ("Const", "VarHandleOp"):
                     # raw numpy stays trace-time concrete (shape
                     # arithmetic consumes it on the host); a
                     # QuantizedTensor flows INTACT to its consumer so
                     # MatMul/Conv can contract int8 directly and scale
                     # the output — dequantizing here would materialize a
-                    # full f32 weight copy every call
+                    # full f32 weight copy every call. A VarHandleOp's
+                    # "handle" IS its restored value (bundle.py), so
+                    # downstream ReadVariableOps are identities.
                     values[nm] = consts[nm]
                 elif node.op == "NoOp":
                     values[nm] = None  # control-only; never consumed as data
@@ -1895,7 +1928,27 @@ def load_saved_model(
             n.op in ("VarHandleOp", "VariableV2", "ReadVariableOp")
             for n in nodes
         )
-        if not has_vars and signatures:
+        variables = None
+        if has_vars and signatures and not quantize_weights:
+            # clean-room variable restore (VERDICT r3 #9): read the
+            # checkpoint bundle directly so variable-bearing SavedModels
+            # import with NO TensorFlow even at conversion time. Any
+            # malformed/unsupported bundle falls back to TF freezing.
+            # quantize_weights still routes through TF freezing: the
+            # weight planner needs an inlined (library-free) graph.
+            try:
+                from .bundle import restore_variables
+
+                variables = restore_variables(
+                    _os.path.join(path, "variables")
+                )
+            except Exception as e:
+                logger.warning(
+                    "clean-room variable restore failed (%s); falling "
+                    "back to TensorFlow freezing", e,
+                )
+                variables = None
+        if signatures and (not has_vars or variables is not None):
             if signature not in signatures:
                 every = sorted({s for _, sigs, _ in metas for s in sigs})
                 raise KeyError(
@@ -1903,62 +1956,81 @@ def load_saved_model(
                     f"of its {len(metas)} meta graph(s); available: "
                     f"{every}"
                 )
-            sig = signatures[signature]
-            sig_fetches = fetches
-            rename = None
-            if sig_fetches is None:
-                # fetch the signature's output tensors, then rename the
-                # result columns to the signature's output-arg names —
-                # several output names may ALIAS one tensor, so the map
-                # is fetch → [names]
-                sig_fetches = []
-                rename = {}
-                for out_name, ref in sorted(sig["outputs"].items()):
-                    f = ref[:-2] if ref.endswith(":0") else ref
-                    if f not in rename:
-                        sig_fetches.append(f)
-                        rename[f] = []
-                    rename[f].append(out_name)
-            program = program_from_graphdef(
-                nodes,
-                fetches=sig_fetches,
-                relax_lead_dim=relax_lead_dim,
-                quantize_weights=quantize_weights,
-                compute_dtype=compute_dtype,
-            )
-            if rename:
-                inner = program.fn
-                rmap = dict(rename)
 
-                def renamed(feeds, _inner=inner, _rmap=rmap):
-                    out = {}
-                    for k, v in _inner(feeds).items():
-                        for nm2 in _rmap.get(k, [k]):
-                            out[nm2] = v
-                    return out
-
-                program = Program(
-                    renamed,
-                    program.inputs,
-                    fetch_order=[
-                        nm2
-                        for f in program.fetch_order
-                        for nm2 in rmap.get(f, [f])
-                    ],
+            def _tf_free_import():
+                sig = signatures[signature]
+                sig_fetches = fetches
+                rename = None
+                if sig_fetches is None:
+                    # fetch the signature's output tensors, then rename the
+                    # result columns to the signature's output-arg names —
+                    # several output names may ALIAS one tensor, so the map
+                    # is fetch → [names]
+                    sig_fetches = []
+                    rename = {}
+                    for out_name, ref in sorted(sig["outputs"].items()):
+                        f = ref[:-2] if ref.endswith(":0") else ref
+                        if f not in rename:
+                            sig_fetches.append(f)
+                            rename[f] = []
+                        rename[f].append(out_name)
+                program = program_from_graphdef(
+                    nodes,
+                    fetches=sig_fetches,
+                    relax_lead_dim=relax_lead_dim,
+                    quantize_weights=quantize_weights,
+                    compute_dtype=compute_dtype,
+                    variables=variables,
                 )
-            # inputs follow the signature's declared arg names too (the
-            # TF-freeze path exposes these; graph placeholders carry
-            # mangled 'serving_default_*' names)
-            in_rename = {}
-            for arg_name, ref in sig["inputs"].items():
-                ph = ref[:-2] if ref.endswith(":0") else ref
-                if ph != arg_name and ph in [
-                    i.name for i in program.inputs
-                ]:
-                    in_rename[ph] = arg_name
-            if in_rename:
-                program = program.rename_inputs(in_rename)
-            return analyze_program(program)
+                if rename:
+                    inner = program.fn
+                    rmap = dict(rename)
+
+                    def renamed(feeds, _inner=inner, _rmap=rmap):
+                        out = {}
+                        for k, v in _inner(feeds).items():
+                            for nm2 in _rmap.get(k, [k]):
+                                out[nm2] = v
+                        return out
+
+                    program = Program(
+                        renamed,
+                        program.inputs,
+                        fetch_order=[
+                            nm2
+                            for f in program.fetch_order
+                            for nm2 in rmap.get(f, [f])
+                        ],
+                    )
+                # inputs follow the signature's declared arg names too (the
+                # TF-freeze path exposes these; graph placeholders carry
+                # mangled 'serving_default_*' names)
+                in_rename = {}
+                for arg_name, ref in sig["inputs"].items():
+                    ph = ref[:-2] if ref.endswith(":0") else ref
+                    if ph != arg_name and ph in [
+                        i.name for i in program.inputs
+                    ]:
+                        in_rename[ph] = arg_name
+                if in_rename:
+                    program = program.rename_inputs(in_rename)
+                return analyze_program(program)
+
+            if not has_vars:
+                return _tf_free_import()
+            try:
+                return _tf_free_import()
+            except ValueError as e:
+                # a resolvable BUNDLE does not guarantee a
+                # resolvable GRAPH: legacy VariableV2 nodes, or a
+                # reachable VarHandleOp whose shared_name is absent
+                # from the restored map, surface as lowering
+                # ValueErrors — those models keep the old
+                # TF-freezing behavior below
+                logger.warning(
+                    "TF-free variable import failed (%s); falling "
+                    "back to TensorFlow freezing", e,
+                )
     try:
         import tensorflow as tf
         from tensorflow.python.framework.convert_to_constants import (

@@ -626,3 +626,119 @@ def test_saved_model_variable_free_loads_without_tensorflow(tmp_path):
     out = prog.fn({"x": np.ones((2, 3), np.float32)})
     assert sorted(prog.fetch_order) == ["a", "b"]
     np.testing.assert_allclose(np.asarray(out["a"]), np.asarray(out["b"]))
+
+
+def test_saved_model_variables_restore_without_tensorflow(tmp_path):
+    """VERDICT r3 #9: a VARIABLE-BEARING SavedModel imports with NO
+    TensorFlow at all — the clean-room bundle reader
+    (tensorframes_tpu/bundle.py) parses variables.index (SSTable +
+    BundleEntryProto) and the data shard directly, VarHandleOp binds to
+    the restored value, and ReadVariableOp is an identity. TF builds
+    the fixture only; the load runs in a subprocess with tensorflow
+    imports hard-blocked, and the result golden-matches TF running the
+    same SavedModel in THIS process."""
+    import subprocess
+    import sys
+
+    w0 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b0 = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+
+    class M(tf.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = tf.Variable(w0, name="w")
+            self.b = tf.Variable(b0, name="b")
+
+        @tf.function(
+            input_signature=[tf.TensorSpec([None, 3], tf.float32)]
+        )
+        def score(self, x):
+            return {"y": tf.matmul(x, self.w) + self.b}
+
+    m = M()
+    sm = str(tmp_path / "sm_vars")
+    tf.saved_model.save(m, sm, signatures={"serving_default": m.score})
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    want = m.score(tf.constant(x))["y"].numpy()
+    np.save(str(tmp_path / "x.npy"), x)
+    np.save(str(tmp_path / "want.npy"), want)
+
+    probe = (
+        "import builtins\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    if name == 'tensorflow' or name.startswith('tensorflow.'):\n"
+        "        raise ImportError('TF BLOCKED')\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "import numpy as np\n"
+        "import tensorframes_tpu as tfs\n"
+        f"prog = tfs.load_saved_model({sm!r}, relax_lead_dim=True)\n"
+        f"x = np.load({str(tmp_path / 'x.npy')!r})\n"
+        f"want = np.load({str(tmp_path / 'want.npy')!r})\n"
+        "got = np.asarray(prog.fn({prog.inputs[0].name: x})"
+        "[prog.fetch_order[0]])\n"
+        "assert np.allclose(got, want, atol=1e-5), (got, want)\n"
+        "print('TFFREE-VARS-OK')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert proc.returncode == 0 and "TFFREE-VARS-OK" in proc.stdout, (
+        proc.stdout[-1500:] + proc.stderr[-1500:]
+    )
+
+
+def test_saved_model_keras_variables_object_path_keys(tmp_path):
+    """Keras SavedModels store variables under OBJECT-PATH checkpoint
+    keys (_operations/1/_kernel/…), not variable names — the bundle
+    reader recovers the name mapping from the checkpoint's
+    TrackableObjectGraph (full_name -> checkpoint_key) and the import
+    golden-matches TF executing the same signature. Also pins the
+    bundle reader's standalone contract."""
+    from tensorframes_tpu.bundle import restore_variables
+
+    inp = tf.keras.Input((5,), dtype="float32")
+    hid = tf.keras.layers.Dense(3, activation="relu")(inp)
+    outp = tf.keras.layers.Dense(2)(hid)
+    model = tf.keras.Model(inp, outp)
+    sm = str(tmp_path / "sm_keras")
+    tf.saved_model.save(model, sm)
+
+    vars_ = restore_variables(os.path.join(sm, "variables"))
+    # the contract the importer depends on: the GRAPH's VarHandleOp
+    # shared_names resolve in the restored map (recovered via the object
+    # graph's full_name -> checkpoint_key entries; keras checkpoint keys
+    # themselves are object paths like _operations/1/_kernel)
+    from tensorframes_tpu.graphdef import parse_saved_model
+
+    with open(os.path.join(sm, "saved_model.pb"), "rb") as fh:
+        g_nodes, _sigs = parse_saved_model(fh.read())
+    shared = [
+        n.attrs["shared_name"].s.decode("utf-8")
+        for n in g_nodes
+        if n.op == "VarHandleOp" and n.attrs.get("shared_name") is not None
+        and n.attrs["shared_name"].s
+    ]
+    resolved = [s for s in shared if s in vars_]
+    # two Dense layers -> at least kernel+bias per layer resolve
+    assert len(resolved) >= 4, (sorted(shared), sorted(vars_))
+
+    prog = tfs.load_saved_model(sm, relax_lead_dim=True)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    m = tf.saved_model.load(sm)
+    want = m.signatures["serving_default"](tf.constant(x))
+    got = prog.fn({prog.inputs[0].name: x})
+    for name, w in want.items():
+        np.testing.assert_allclose(
+            np.asarray(got[name]), w.numpy(), atol=1e-5, err_msg=name
+        )
