@@ -84,8 +84,10 @@ from .graphdef import (  # noqa: E402,F401
     load_saved_model,
     parse_graphdef,
     parse_saved_model,
+    parse_saved_model_meta_graphs,
     program_from_graphdef,
 )
+from .bundle import restore_variables  # noqa: E402,F401
 from .validation import ValidationError  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
     aggregate,

@@ -379,13 +379,15 @@ class TensorFrame:
         over block columns (parameter names select columns), DSL nodes,
         or a Program — producing ONE boolean output of shape ``[rows]``.
         The mask computes on device through ``map_blocks``; rows subset
-        per block (device columns boolean-gather, host columns
-        compress). Lazy like the verbs: the mask computes when the
-        frame is forced. The reference had no filter — Spark's
-        ``where`` ran before tensorframes saw the data; standalone
-        frames need it native. Sharded frames force to a host-backed
-        frame (row-dropping is data-dependent — call ``.to_device()``
-        to re-shard); multi-process frames raise with the
+        per block — device columns gather IN HBM (only the
+        byte-per-row mask crosses to host to fix the data-dependent
+        output size), host columns compress. Lazy like the verbs: the
+        mask computes when the frame is forced. The reference had no
+        filter — Spark's ``where`` ran before tensorframes saw the
+        data; standalone frames need it native. A sharded frame's
+        result columns stay on device but lose their mesh layout
+        (row-dropping is data-dependent — call ``.to_device()`` to
+        re-shard); multi-process frames raise with the
         ``column_values`` guidance.
         """
         from .ops.verbs import map_blocks
@@ -424,11 +426,33 @@ class TensorFrame:
                         f"filter predicate output {mname!r} must be "
                         f"bool[rows]; got {m.dtype} with shape {m.shape}"
                     )
+                rows = _block_num_rows({n_: b[n_] for n_ in names})
+                if m.shape[0] != rows:
+                    # must fail LOUDLY on both paths: jax gather clamps
+                    # out-of-bounds indices, so an oversized mask would
+                    # silently duplicate the last row on device columns
+                    # where numpy's boolean index raises
+                    raise ValueError(
+                        f"filter predicate output {mname!r} has "
+                        f"{m.shape[0]} rows for a block of {rows}"
+                    )
                 nb: Block = {}
+                idx = None
                 for name in names:
                     v = b[name]
                     if isinstance(v, list):
                         nb[name] = [x for x, keep in zip(v, m) if keep]
+                    elif _is_jax_array(v):
+                        # device columns subset ON DEVICE: only the
+                        # 1-byte-per-row mask crosses to host (to fix
+                        # the data-dependent output size); the payload
+                        # gathers in HBM instead of round-tripping
+                        # (r3 noted filter forced device frames host)
+                        if idx is None:
+                            import jax.numpy as jnp
+
+                            idx = jnp.asarray(np.flatnonzero(m))
+                        nb[name] = v[idx]
                     else:
                         nb[name] = np.asarray(v)[m]
                 new_blocks.append(nb)

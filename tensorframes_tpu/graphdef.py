@@ -1894,19 +1894,22 @@ def load_saved_model(
     quantize_weights: bool = False,
     compute_dtype: Optional[str] = "auto",
 ) -> Program:
-    """Import a TF SavedModel signature.
+    """Import a TF SavedModel signature — with NO TensorFlow at all.
 
-    VARIABLE-FREE models (pure ``tf.function`` exports) import with NO
-    TensorFlow at all: the bundled clean-room parser reads
-    ``saved_model.pb`` directly and the PartitionedCall bodies evaluate
-    from the graph's function library. Models with variables fall back
-    to freezing via TensorFlow (required at CONVERSION time only —
-    scoring is always TF-free).
+    The clean-room parser reads ``saved_model.pb`` directly (MetaGraph
+    selection, signature map, function library for PartitionedCall
+    bodies), and VARIABLE-BEARING models restore their weights straight
+    from the checkpoint bundle (``bundle.py`` reads
+    ``variables/variables.index`` + data shards; VarHandleOp binds to
+    the value, ReadVariableOp is an identity). TensorFlow is used only
+    as a FALLBACK for models the clean-room path cannot resolve (legacy
+    ``VariableV2`` graphs, unresolvable handles, or
+    ``quantize_weights=True``, whose weight planner needs an inlined
+    graph) — those freeze via ``convert_variables_to_constants_v2``.
 
     Migration affordance beyond the reference (which took raw GraphDefs
-    only): modern TF users hold SavedModels. Without tensorflow
-    installed, variable-bearing models must be frozen offline
-    (convert_variables_to_constants_v2) and shipped as ``GraphDef``.
+    only): modern TF users hold SavedModels, and they import here with
+    an empty environment — no tensorflow at conversion OR scoring time.
     """
     import os as _os
 

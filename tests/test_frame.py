@@ -576,3 +576,25 @@ def test_sort_values_device_bool_and_int_dtypes():
         {"b": vals, "i": small, "u": u, "tag": np.arange(4)}
     ).sort_values(["b", "i", "u"]).collect()
     assert [int(r["tag"]) for r in got] == [r["tag"] for r in host]
+
+
+def test_filter_device_frame_stays_on_device():
+    """Device-frame filter gathers in HBM: result columns remain jax
+    Arrays (only the mask crosses to host), matching the host path's
+    rows exactly."""
+    import jax
+
+    x = np.arange(32.0)
+    dev = tfs.frame_from_arrays({"x": x, "tag": np.arange(32)}).to_device()
+    flt = dev.filter(lambda x: {"keep": x % 3.0 == 0.0})
+    blks = flt.blocks()
+    assert all(isinstance(b["x"], jax.Array) for b in blks)
+    got = sorted(float(r["x"]) for r in flt.collect())
+    want = sorted(float(v) for v in x[x % 3 == 0])
+    assert got == want
+    # host parity
+    host = tfs.frame_from_arrays({"x": x, "tag": np.arange(32)})
+    hgot = sorted(float(r["x"]) for r in host.filter(
+        lambda x: {"keep": x % 3.0 == 0.0}
+    ).collect())
+    assert hgot == want
