@@ -1,9 +1,35 @@
 #!/bin/bash
-# Wait for the tunnel prober to mark the backend healthy, then capture a
-# full TPU bench run + refresh the TPU regression baseline. Written so a
-# heal window is never missed while the operator is elsewhere.
+# Wait for the tunnel prober to mark the backend healthy, then capture
+# EVERYTHING the round-3 verdict's TPU re-validation item asks for —
+# smoke first, then the full bench (appends a platform=tpu entry to
+# dev/bench_history.jsonl with the device-frame aggregate, native
+# string-hash, bf16 frozen serving, bert_base, gpt_small f32+int8kv
+# decode, batch-swept headline), then refresh the TPU regression
+# baseline so the gate tracks the new configuration set. Written so a
+# heal window is never missed while the operator is elsewhere — and so
+# a FLAPPING tunnel (healthy probe, wedged again by smoke time) re-arms
+# instead of consuming the one-shot watcher on a dead backend.
 cd /root/repo
-while [ ! -f dev/TPU_ALIVE ]; do sleep 60; done
-echo "$(date -u +%H:%M:%S) TPU healed — running bench" >> dev/tpu_probe.log
-python bench.py > dev/bench_tpu_heal.log 2>&1
-echo "$(date -u +%H:%M:%S) bench exit=$? (dev/bench_tpu_heal.log)" >> dev/tpu_probe.log
+while true; do
+  while [ ! -f dev/TPU_ALIVE ]; do sleep 60; done
+  echo "$(date -u +%H:%M:%S) TPU healed — smoke" >> dev/tpu_probe.log
+  timeout 900 python dev/tpu_smoke.py > dev/tpu_smoke_heal.log 2>&1
+  src=$?
+  echo "$(date -u +%H:%M:%S) smoke exit=$src (dev/tpu_smoke_heal.log)" >> dev/tpu_probe.log
+  if [ $src -ne 0 ]; then
+    # transient heal: drop the marker, resume probing, keep waiting
+    rm -f dev/TPU_ALIVE
+    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 &
+    continue
+  fi
+  python bench.py > dev/bench_tpu_heal.log 2>&1
+  rc=$?
+  echo "$(date -u +%H:%M:%S) bench exit=$rc (dev/bench_tpu_heal.log)" >> dev/tpu_probe.log
+  if [ $rc -eq 0 ] && ! grep -q "devices=\[CpuDevice" dev/bench_tpu_heal.log; then
+    # refresh only a REAL-TPU run: bench self-degrades to CPU when the
+    # backend re-wedges mid-run, and that must not rewrite a baseline
+    python dev/bench_check.py dev/bench_tpu_heal.log --refresh \
+      >> dev/tpu_probe.log 2>&1
+  fi
+  break
+done

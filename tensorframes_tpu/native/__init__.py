@@ -160,6 +160,28 @@ def dict_encode(values) -> Optional[tuple]:
     return np.frombuffer(buf, dtype=np.int32), uniques
 
 
+def stack_cells(cells: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """Stack equal-shape contiguous ndarray cells into ``[len(cells),
+    *cell_shape]`` with ONE native memcpy pass — np.stack pays
+    per-element numpy dispatch, which dominates the ragged map_rows
+    host path at thousands of small cells per shape group. Returns
+    None when unavailable or the first cell is not a supported dense
+    array (callers fall back to np.stack); raises ValueError on
+    shape/dtype mismatch among cells like np.stack would."""
+    mod = _load()
+    if mod is None or len(cells) == 0:
+        return None
+    c0 = cells[0]
+    if not isinstance(c0, np.ndarray) or c0.dtype.hasobject:
+        return None
+    if not c0.flags.c_contiguous:
+        return None
+    buf = mod.stack_cells(cells)
+    return np.frombuffer(buf, dtype=c0.dtype).reshape(
+        (len(cells),) + c0.shape
+    )
+
+
 def columns_to_rows(
     names: Sequence[str], arrays: Sequence[np.ndarray]
 ) -> Optional[List[Dict[str, object]]]:

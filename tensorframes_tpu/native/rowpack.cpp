@@ -445,9 +445,84 @@ PyObject* dict_encode(PyObject*, PyObject* args) {
   return result;
 }
 
+// stack_cells(cells) -> bytearray of the cells' bytes concatenated.
+//
+// The ragged map_rows path stacks thousands of small same-shape ndarray
+// cells per shape group (np.stack pays per-element numpy dispatch); one
+// native pass over the buffer protocol memcpys them. Every cell must be
+// C-contiguous with identical itemsize/format/shape — any mismatch
+// raises and the wrapper falls back to np.stack.
+PyObject* stack_cells(PyObject*, PyObject* args) {
+  PyObject* cells;
+  if (!PyArg_ParseTuple(args, "O", &cells)) return nullptr;
+  PyObject* fast = PySequence_Fast(cells, "cells must be a sequence");
+  if (fast == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  if (n == 0) {
+    Py_DECREF(fast);
+    PyErr_SetString(PyExc_ValueError, "stack_cells needs >= 1 cell");
+    return nullptr;
+  }
+  PyObject* out = nullptr;
+  Py_buffer first;
+  first.obj = nullptr;
+  {
+    PyObject* c0 = PySequence_Fast_GET_ITEM(fast, 0);  // borrowed
+    if (PyObject_GetBuffer(c0, &first, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) !=
+        0)
+      goto fail;
+    const Py_ssize_t cell_len = first.len;
+    out = PyByteArray_FromStringAndSize(nullptr, n * cell_len);
+    if (out == nullptr) goto fail;
+    char* buf = PyByteArray_AS_STRING(out);
+    std::memcpy(buf, first.buf, cell_len);
+    for (Py_ssize_t i = 1; i < n; ++i) {
+      PyObject* c = PySequence_Fast_GET_ITEM(fast, i);  // borrowed
+      Py_buffer view;
+      if (PyObject_GetBuffer(c, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) !=
+          0)
+        goto fail;
+      bool ok =
+          view.len == cell_len && view.itemsize == first.itemsize &&
+          view.ndim == first.ndim &&
+          ((view.format == nullptr && first.format == nullptr) ||
+           (view.format != nullptr && first.format != nullptr &&
+            std::strcmp(view.format, first.format) == 0));
+      // same byte length is NOT same shape ([2,6] vs [3,4] f32):
+      // PyBUF_C_CONTIGUOUS implies ND, so shape arrays are present
+      if (ok && view.shape != nullptr && first.shape != nullptr) {
+        for (int d = 0; d < view.ndim; ++d)
+          if (view.shape[d] != first.shape[d]) {
+            ok = false;
+            break;
+          }
+      }
+      if (!ok) {
+        PyBuffer_Release(&view);
+        PyErr_Format(PyExc_ValueError,
+                     "cell %zd does not match cell 0's shape/dtype",
+                     (ssize_t)i);
+        goto fail;
+      }
+      std::memcpy(buf + i * cell_len, view.buf, cell_len);
+      PyBuffer_Release(&view);
+    }
+  }
+  PyBuffer_Release(&first);
+  Py_DECREF(fast);
+  return out;
+fail:
+  if (first.obj != nullptr) PyBuffer_Release(&first);
+  Py_XDECREF(out);
+  Py_DECREF(fast);
+  return nullptr;
+}
+
 PyMethodDef methods[] = {
     {"dict_encode", dict_encode, METH_VARARGS,
      "dict_encode(seq) -> (bytearray int32 codes, uniques list)"},
+    {"stack_cells", stack_cells, METH_VARARGS,
+     "stack_cells(cells) -> bytearray of concatenated equal-shape cells"},
     {"gather_column", gather_column, METH_VARARGS,
      "gather_column(rows, name, dtype_code) -> bytearray of packed cells"},
     {"scatter_rows", scatter_rows, METH_VARARGS,

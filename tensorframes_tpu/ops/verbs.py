@@ -408,6 +408,28 @@ def map_blocks(
 _RAGGED_STAGE_BYTES = 1 << 28  # 256 MB
 
 
+def _stack_group(col, idx) -> np.ndarray:
+    """Stack the cells ``col[i] for i in idx`` (same shape by grouping)
+    into ``[len(idx), *cell]``: one native memcpy pass when available
+    (np.stack pays per-element dispatch — it dominated the ragged host
+    path), np.stack otherwise."""
+    from .. import native
+
+    cells = [col[i] for i in idx]
+    if (
+        isinstance(cells[0], np.ndarray)
+        and not cells[0].dtype.hasobject
+        and cells[0].flags.c_contiguous
+    ):
+        try:
+            stacked = native.stack_cells(cells)
+        except (ValueError, TypeError):
+            stacked = None
+        if stacked is not None:
+            return stacked
+    return np.stack([np.asarray(c) for c in cells])
+
+
 def map_rows(
     fetches: Fetches,
     frame,
@@ -483,7 +505,6 @@ def map_rows(
                         np.shape(b[name][i]) for name in input_names
                     )
                     groups.setdefault(key, []).append(i)
-                per_row: List[Optional[Dict[str, np.ndarray]]] = [None] * n
                 # stage EVERY group's padded feeds, then move them with
                 # ONE device_put call and dispatch every group before
                 # the first result sync: per-group transfer+sync
@@ -496,9 +517,7 @@ def map_rows(
                     g = len(idx)
                     feeds = {}
                     for name in input_names:
-                        stacked = np.stack(
-                            [np.asarray(b[name][i]) for i in idx]
-                        )
+                        stacked = _stack_group(b[name], idx)
                         spec = program.input(name)
                         if (
                             dt.demotion_active()
@@ -554,22 +573,33 @@ def map_rows(
                         )
                         for f in staged
                     ]
-                for idx, outs_g in zip(group_list, outs_list):
-                    outs_g = {
-                        k: np.asarray(v) for k, v in outs_g.items()
-                    }
-                    for j, i in enumerate(idx):
-                        per_row[i] = {
-                            o.name: outs_g[o.name][j]
-                            for o in program.outputs
-                        }
+                # VECTORIZED scatter: a uniform output column writes
+                # whole groups via index assignment — no per-row python
+                # loop, no per-row dict, no final re-stack (the r1-r3
+                # assembly spent most of the ragged path's host time
+                # there). Ragged outputs (cell shapes differ across
+                # groups) keep the per-row list form.
                 outs = {}
                 for o in program.outputs:
-                    cells = [r[o.name] for r in per_row]
-                    shapes = {c.shape for c in cells}
-                    if len(shapes) == 1:
-                        outs[o.name] = np.stack(cells)
+                    cell_shapes = {
+                        outs_g[o.name].shape[1:] for outs_g in outs_list
+                    }
+                    if len(cell_shapes) == 1:
+                        first = outs_list[0][o.name]
+                        dest = np.empty(
+                            (n,) + first.shape[1:], dtype=first.dtype
+                        )
+                        for idx, outs_g in zip(group_list, outs_list):
+                            dest[np.asarray(idx)] = (
+                                np.asarray(outs_g[o.name])[: len(idx)]
+                            )
+                        outs[o.name] = dest
                     else:
+                        cells: List = [None] * n
+                        for idx, outs_g in zip(group_list, outs_list):
+                            og = np.asarray(outs_g[o.name])
+                            for j, i in enumerate(idx):
+                                cells[i] = og[j]
                         outs[o.name] = cells  # ragged output column
             nb: Block = {i.name: outs[i.name] for i in out_infos}
             nb.update(b)

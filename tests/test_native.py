@@ -204,3 +204,33 @@ def test_unique_inverse_fallback_matches_native(monkeypatch, use_native):
     # repr) total order puts int before str, then 'a' < 'b'
     assert list(u3) == [2, "a", "b"]
     np.testing.assert_array_equal(inv3, [2, 0, 1, 0])
+
+
+def test_stack_cells_matches_np_stack():
+    """Native stack_cells: one memcpy pass over equal-shape cells ==
+    np.stack, across dtypes/ranks; mismatched cells raise like np.stack
+    (including the same-bytes-different-shape trap: [2,6] vs [3,4])."""
+    from tensorframes_tpu import native
+
+    if not native.available():
+        pytest.skip("native module unavailable")
+    rng = np.random.default_rng(0)
+    for dtype, shape in [
+        (np.float32, (8,)), (np.float64, (3, 4)), (np.int64, ()),
+        (np.int8, (5, 2, 2)),
+    ]:
+        cells = [
+            np.ascontiguousarray(rng.standard_normal(shape).astype(dtype))
+            for _ in range(7)
+        ]
+        got = native.stack_cells(cells)
+        assert got is not None
+        np.testing.assert_array_equal(got, np.stack(cells))
+    with pytest.raises(ValueError):
+        native.stack_cells(
+            [np.zeros((2, 6), np.float32), np.zeros((3, 4), np.float32)]
+        )
+    with pytest.raises(ValueError):
+        native.stack_cells(
+            [np.zeros(4, np.float32), np.zeros(4, np.int32)]
+        )
