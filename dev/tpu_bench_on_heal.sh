@@ -25,11 +25,16 @@ while true; do
   python bench.py > dev/bench_tpu_heal.log 2>&1
   rc=$?
   echo "$(date -u +%H:%M:%S) bench exit=$rc (dev/bench_tpu_heal.log)" >> dev/tpu_probe.log
-  if [ $rc -eq 0 ] && ! grep -q "devices=\[CpuDevice" dev/bench_tpu_heal.log; then
-    # refresh only a REAL-TPU run: bench self-degrades to CPU when the
-    # backend re-wedges mid-run, and that must not rewrite a baseline
-    python dev/bench_check.py dev/bench_tpu_heal.log --refresh \
-      >> dev/tpu_probe.log 2>&1
+  if [ $rc -ne 0 ] || grep -q "devices=\[CpuDevice" dev/bench_tpu_heal.log; then
+    # bench failed, or self-degraded to CPU because the backend
+    # re-wedged mid-run: that run captured nothing TPU — re-arm and
+    # keep waiting for the next genuine window (same as smoke failure)
+    echo "$(date -u +%H:%M:%S) bench was not a TPU run — re-arming" >> dev/tpu_probe.log
+    rm -f dev/TPU_ALIVE
+    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 &
+    continue
   fi
+  python dev/bench_check.py dev/bench_tpu_heal.log --refresh \
+    >> dev/tpu_probe.log 2>&1
   break
 done

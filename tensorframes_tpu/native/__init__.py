@@ -166,8 +166,10 @@ def stack_cells(cells: Sequence[np.ndarray]) -> Optional[np.ndarray]:
     per-element numpy dispatch, which dominates the ragged map_rows
     host path at thousands of small cells per shape group. Returns
     None when unavailable or the first cell is not a supported dense
-    array (callers fall back to np.stack); raises ValueError on
-    shape/dtype mismatch among cells like np.stack would."""
+    array (callers fall back to np.stack). Mismatched cells raise
+    ValueError — for shape mismatch np.stack does too, but for DTYPE
+    mismatch np.stack would silently promote; a caller wanting
+    promotion must catch and fall back."""
     mod = _load()
     if mod is None or len(cells) == 0:
         return None

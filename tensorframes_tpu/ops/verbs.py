@@ -408,6 +408,34 @@ def map_blocks(
 _RAGGED_STAGE_BYTES = 1 << 28  # 256 MB
 
 
+def _group_rows_by_shape(
+    b: Dict[str, object], input_names: Sequence[str], n: int
+) -> List[np.ndarray]:
+    """Row indices grouped by input cell shape — the ragged dispatch
+    unit. The common case (ONE 1-D ragged column) grouped VECTORIZED:
+    lengths via a single fromiter, then unique/argsort, no 20k-iteration
+    python dict loop; multi-input / higher-rank cells keep the general
+    tuple-key path."""
+    if len(input_names) == 1:
+        col = b[input_names[0]]
+        cells = col if isinstance(col, list) else list(col)
+        if cells and all(
+            isinstance(c, np.ndarray) and c.ndim == 1 for c in cells
+        ):
+            lens = np.fromiter(
+                (c.shape[0] for c in cells), np.int64, count=n
+            )
+            uniq, inv = np.unique(lens, return_inverse=True)
+            order = np.argsort(inv, kind="stable")
+            bounds = np.searchsorted(inv[order], np.arange(1, len(uniq)))
+            return [g for g in np.split(order, bounds)]
+    groups: Dict[tuple, List[int]] = {}
+    for i in range(n):
+        key = tuple(np.shape(b[name][i]) for name in input_names)
+        groups.setdefault(key, []).append(i)
+    return [np.asarray(v) for v in groups.values()]
+
+
 def _stack_group(col, idx) -> np.ndarray:
     """Stack the cells ``col[i] for i in idx`` (same shape by grouping)
     into ``[len(idx), *cell]``: one native memcpy pass when available
@@ -416,17 +444,14 @@ def _stack_group(col, idx) -> np.ndarray:
     from .. import native
 
     cells = [col[i] for i in idx]
-    if (
-        isinstance(cells[0], np.ndarray)
-        and not cells[0].dtype.hasobject
-        and cells[0].flags.c_contiguous
-    ):
-        try:
-            stacked = native.stack_cells(cells)
-        except (ValueError, TypeError):
-            stacked = None
-        if stacked is not None:
-            return stacked
+    try:
+        # native.stack_cells returns None itself for unavailable /
+        # non-ndarray / object-dtype / non-contiguous first cells
+        stacked = native.stack_cells(cells)
+    except (ValueError, TypeError):
+        stacked = None
+    if stacked is not None:
+        return stacked
     return np.stack([np.asarray(c) for c in cells])
 
 
@@ -499,19 +524,14 @@ def map_rows(
                 # shapes, run each group as ONE vmapped dispatch with its
                 # lead dim bucketed — #dispatches = #distinct shapes and
                 # #compiles = #shapes × O(log bucket), not one per row
-                groups: Dict[tuple, List[int]] = {}
-                for i in range(n):
-                    key = tuple(
-                        np.shape(b[name][i]) for name in input_names
-                    )
-                    groups.setdefault(key, []).append(i)
+                group_indices = _group_rows_by_shape(b, input_names, n)
                 # stage EVERY group's padded feeds, then move them with
                 # ONE device_put call and dispatch every group before
                 # the first result sync: per-group transfer+sync
                 # round-trips multiply per-call link latency by the
                 # shape count — the r3 TPU run collapsed 23x on exactly
                 # this (VERDICT r3 #5; ≙ TFDataOps.scala:90-103)
-                group_list = list(groups.values())
+                group_list = group_indices
                 staged = []
                 for idx in group_list:
                     g = len(idx)
