@@ -486,6 +486,19 @@ _BINARY = {
     "LogicalAnd": jnp.logical_and,
     "LogicalOr": jnp.logical_or,
     "Atan2": jnp.arctan2,
+    # the 0-input short-circuits TF defines: Xdivy/Xlogy return 0 where
+    # x==0 (whatever y), DivNoNan returns 0 where y==0
+    "Xdivy": lambda x, y: jnp.where(
+        x == 0, jnp.zeros_like(jnp.divide(x, y)), jnp.divide(x, y)
+    ),
+    "Xlogy": lambda x, y: jnp.where(
+        x == 0,
+        jnp.zeros_like(jnp.multiply(x, jnp.log(y))),
+        jnp.multiply(x, jnp.log(y)),
+    ),
+    "DivNoNan": lambda x, y: jnp.where(
+        y == 0, jnp.zeros_like(jnp.divide(x, y)), jnp.divide(x, y)
+    ),
     # TF's Mod is C-style TRUNCATED modulo (sign of the dividend);
     # jnp.mod is floor-modulo — lax.rem / np.fmod have the right
     # semantics
@@ -499,6 +512,12 @@ _UNARY = {
     # a VarHandleOp resolves to the variable's VALUE at import (clean-room
     # bundle restore, bundle.py), so the read is an identity
     "ReadVariableOp": lambda x: x,
+    # graph-plumbing no-ops under pure inference
+    "Snapshot": lambda x: x,
+    "PreventGradient": lambda x: x,
+    "CheckNumerics": lambda x: x,
+    "LogSoftmax": jax.nn.log_softmax,
+    "L2Loss": lambda x: jnp.sum(jnp.square(x)) / 2,
     "Neg": jnp.negative,
     "Square": jnp.square,
     "Abs": jnp.abs,
@@ -549,6 +568,8 @@ _REDUCERS = {
     "Max": jnp.max,
     "Mean": jnp.mean,
     "Prod": jnp.prod,
+    "All": jnp.all,
+    "Any": jnp.any,
 }
 
 # numpy twins for the shape-arithmetic subgraphs (Shape → Pack → Tile …):
@@ -1134,6 +1155,11 @@ def program_from_graphdef(
         "LeakyRelu",
         "Slice", "ZerosLike", "OnesLike", "BroadcastTo", "OneHot",
         "Cumsum", "Cumprod", "Rank", "Size",
+        # image-serving tier (round 4): the ops frozen detection /
+        # segmentation / preprocessing graphs lean on
+        "AddN", "ReverseV2", "GatherNd", "MirrorPad", "MatrixBandPart",
+        "DepthToSpace", "SpaceToDepth",
+        "ResizeBilinear", "ResizeNearestNeighbor",
         "Split", "SplitV", "Unpack", "TopKV2", "IdentityN",
         # function calls (un-frozen tf.function exports): bodies come
         # from the graph's FunctionDefLibrary and are validated below
@@ -1764,7 +1790,133 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
         if _is_concrete(args[0]):
             red = np.argmin if op == "ArgMin" else np.argmax
         return red(args[0], axis=ax).astype(out_dt.np_dtype)
+    if op == "AddN":
+        total = args[0]
+        for a in args[1:]:
+            total = total + a
+        return total
+    if op == "ReverseV2":
+        axes = _axes(_concrete_operand(n, "axis", args[1]))
+        return jnp.flip(args[0], axis=axes)
+    if op == "GatherNd":
+        x, idx = args
+        # index tuples along the last dim select slices of x; jnp-wrap
+        # the table so a concrete Const indexed by traced indices works
+        return jnp.asarray(x)[tuple(jnp.moveaxis(jnp.asarray(idx), -1, 0))]
+    if op == "MirrorPad":
+        pads = np.asarray(_concrete_operand(n, "paddings", args[1]))
+        mode_a = n.attrs.get("mode")
+        mode = (mode_a.s or b"REFLECT").decode("utf-8") if mode_a else "REFLECT"
+        return jnp.pad(
+            args[0],
+            [tuple(int(p) for p in row) for row in pads],
+            mode="reflect" if mode == "REFLECT" else "symmetric",
+        )
+    if op == "MatrixBandPart":
+        x = args[0]
+        lower = int(_concrete_operand(n, "num_lower", args[1]))
+        upper = int(_concrete_operand(n, "num_upper", args[2]))
+        m, k = x.shape[-2], x.shape[-1]
+        i = jnp.arange(m)[:, None]
+        j = jnp.arange(k)[None, :]
+        keep = jnp.ones((m, k), bool)
+        if lower >= 0:
+            keep = keep & (i - j <= lower)
+        if upper >= 0:
+            keep = keep & (j - i <= upper)
+        return jnp.where(keep, x, jnp.zeros((), x.dtype))
+    if op in ("DepthToSpace", "SpaceToDepth"):
+        bs = int(n.attrs["block_size"].i)
+        fmt_a = n.attrs.get("data_format")
+        if fmt_a and fmt_a.s and fmt_a.s != b"NHWC":
+            raise ValueError(
+                f"{op} node {name!r}: only NHWC is supported "
+                f"(got {fmt_a.s.decode('utf-8')})"
+            )
+        x = args[0]
+        b, h, w, c = x.shape
+        if op == "DepthToSpace":
+            x = x.reshape(b, h, w, bs, bs, c // (bs * bs))
+            x = x.transpose(0, 1, 3, 2, 4, 5)
+            return x.reshape(b, h * bs, w * bs, c // (bs * bs))
+        x = x.reshape(b, h // bs, bs, w // bs, bs, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, h // bs, w // bs, c * bs * bs)
+    if op in ("ResizeBilinear", "ResizeNearestNeighbor"):
+        size = np.asarray(_concrete_operand(n, "size", args[1]))
+        ac_a = n.attrs.get("align_corners")
+        hp_a = n.attrs.get("half_pixel_centers")
+        return _tf_resize(
+            args[0], int(size[0]), int(size[1]),
+            bilinear=(op == "ResizeBilinear"),
+            align=bool(ac_a.b) if ac_a else False,
+            half_pixel=bool(hp_a.b) if hp_a else False,
+        )
     raise ValueError(f"unsupported op {op}")  # pragma: no cover — gated
+
+
+def _tf_resize(x, nh: int, nw: int, bilinear: bool, align: bool,
+               half_pixel: bool):
+    """TF's legacy image resize, exactly (resize_bilinear_op.cc /
+    resize_nearest_neighbor_op.cc semantics for every align_corners /
+    half_pixel_centers combination). NHWC; source coordinates are
+    STATIC numpy (the size operand is trace-time concrete), so only
+    gathers and lerps reach XLA. ResizeBilinear always outputs f32,
+    matching TF's kernel signature."""
+    b, h, w, c = x.shape
+
+    def scale_for(out_n, in_n):
+        if align and out_n > 1:
+            return (in_n - 1) / (out_n - 1)
+        return in_n / out_n
+
+    def src_coords(out_n, in_n):
+        i = np.arange(out_n, dtype=np.float64)
+        sc = scale_for(out_n, in_n)
+        if half_pixel and not align:
+            return (i + 0.5) * sc - 0.5
+        return i * sc
+
+    if bilinear:
+        def interp_axis(out_n, in_n):
+            src = src_coords(out_n, in_n)
+            lower = np.maximum(np.floor(src), 0).astype(np.int32)
+            upper = np.minimum(np.ceil(src), in_n - 1).astype(np.int32)
+            lerp = (src - np.floor(src)).astype(np.float32)
+            return lower, upper, lerp
+
+        ly, uy, ty = interp_axis(nh, h)
+        lx, ux, tx = interp_axis(nw, w)
+        xf = x.astype(jnp.float32)
+        top = jnp.take(xf, ly, axis=1)
+        bot = jnp.take(xf, uy, axis=1)
+
+        def horiz(img):
+            left = jnp.take(img, lx, axis=2)
+            right = jnp.take(img, ux, axis=2)
+            return left + (right - left) * tx[None, None, :, None]
+
+        t = horiz(top)
+        bm = horiz(bot)
+        return t + (bm - t) * ty[None, :, None, None]
+
+    def nn_index(out_n, in_n):
+        i = np.arange(out_n, dtype=np.float64)
+        sc = scale_for(out_n, in_n)
+        if half_pixel and not align:
+            # NN's half-pixel scaler is (i + 0.5) * scale with NO -0.5
+            # (TF's HalfPixelScalerForNN), then floor
+            idx = np.floor((i + 0.5) * sc).astype(np.int64)
+        elif align:
+            # TF rounds half AWAY from zero (roundf), not half-to-even
+            idx = np.floor(i * sc + 0.5).astype(np.int64)
+        else:
+            idx = np.floor(i * sc).astype(np.int64)
+        return np.clip(idx, 0, in_n - 1).astype(np.int32)
+
+    iy = nn_index(nh, h)
+    ix = nn_index(nw, w)
+    return jnp.take(jnp.take(x, iy, axis=1), ix, axis=2)
 
 
 def load_graphdef(

@@ -780,3 +780,86 @@ def test_compute_dtype_auto_resolution(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert gd._resolve_compute_dtype("auto") == "bfloat16"
     assert gd._resolve_compute_dtype(None) is None
+
+
+def test_image_serving_op_tier_matches_tf():
+    """Round-4 importer tier: the ops frozen detection/segmentation/
+    preprocessing graphs lean on — legacy image resizes in every
+    align_corners/half_pixel_centers combination, depth/space shuffles,
+    GatherNd, MirrorPad, AddN, band-part, ReverseV2, LogSoftmax,
+    Xdivy/DivNoNan — all golden-matched against TF running the same
+    frozen bytes."""
+    tf = pytest.importorskip("tensorflow")
+
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((2, 5, 7, 4)).astype(np.float32)
+    m = rng.standard_normal((3, 6, 6)).astype(np.float32)
+    idx = np.asarray([[1, 2], [0, 0], [1, 4]], np.int32)
+
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [2, 5, 7, 4], name="x")
+        mm = tf.compat.v1.placeholder(tf.float32, [3, 6, 6], name="m")
+        ii = tf.compat.v1.placeholder(tf.int32, [3, 2], name="ii")
+        tf.compat.v1.image.resize_bilinear(x, [8, 9], name="rb")
+        tf.compat.v1.image.resize_bilinear(
+            x, [8, 9], align_corners=True, name="rba"
+        )
+        tf.compat.v1.image.resize_bilinear(
+            x, [8, 9], half_pixel_centers=True, name="rbh"
+        )
+        tf.compat.v1.image.resize_nearest_neighbor(x, [3, 4], name="rn")
+        tf.compat.v1.image.resize_nearest_neighbor(
+            x, [3, 4], align_corners=True, name="rna"
+        )
+        # 5->9 rows: align scale (5-1)/(9-1)=0.5 puts source coords at
+        # exact .5 — TF rounds half AWAY from zero, np.rint would not
+        tf.compat.v1.image.resize_nearest_neighbor(
+            x, [9, 7], align_corners=True, name="rnah"
+        )
+        # const table gathered by PLACEHOLDER indices (embedding-lookup
+        # shape): the table is trace-time numpy, the indices traced
+        tf.gather_nd(
+            tf.constant(np.arange(24, dtype=np.float32).reshape(4, 3, 2)),
+            ii % 2, name="gnc",
+        )
+        tf.compat.v1.image.resize_nearest_neighbor(
+            x, [3, 4], half_pixel_centers=True, name="rnh"
+        )
+        tf.nn.space_to_depth(
+            tf.compat.v1.image.resize_bilinear(x, [6, 8]), 2, name="sd"
+        )
+        tf.nn.depth_to_space(x, 2, name="ds")
+        tf.gather_nd(x, ii, name="gn")
+        tf.pad(mm, [[0, 0], [1, 2], [2, 1]], mode="REFLECT", name="mr")
+        tf.pad(mm, [[0, 0], [1, 2], [2, 1]], mode="SYMMETRIC", name="ms")
+        tf.add_n([mm, mm * 2.0, mm - 1.0], name="an")
+        tf.linalg.band_part(mm, 1, 2, name="bp")
+        tf.linalg.band_part(mm, -1, 0, name="bpl")
+        tf.reverse(mm, axis=[1, 2], name="rv")
+        tf.nn.log_softmax(mm, name="ls")
+        tf.identity(
+            tf.math.xdivy(mm, tf.abs(mm) - tf.abs(mm)), name="xd"
+        )  # y==0 path
+        tf.math.divide_no_nan(mm, mm - mm, name="dn")  # y==0 everywhere
+        tf.reduce_all(mm > -10.0, axis=1, name="ra")
+        tf.reduce_any(mm > 0.5, axis=[0, 2], name="ry")
+    data = g.as_graph_def().SerializeToString()
+    fetches = [
+        "rb", "rba", "rbh", "rn", "rna", "rnah", "rnh", "sd", "ds",
+        "gn", "gnc",
+        "mr", "ms", "an", "bp", "bpl", "rv", "ls", "xd", "dn", "ra", "ry",
+    ]
+    prog = program_from_graphdef(
+        parse_graphdef(data), fetches=fetches, compute_dtype=None
+    )
+    got = prog.fn({"x": img, "m": m, "ii": idx})
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run(
+            [f + ":0" for f in fetches], {"x:0": img, "m:0": m, "ii:0": idx}
+        )
+    for name, w in zip(fetches, want):
+        np.testing.assert_allclose(
+            np.asarray(got[name]).astype(np.float64),
+            np.asarray(w).astype(np.float64),
+            atol=1e-5, err_msg=name,
+        )
