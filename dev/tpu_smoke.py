@@ -15,6 +15,10 @@ the pieces that only real-TPU compilation can validate:
 3. the upstream pallas flash-attention kernel under x64-off tracing
 4. a keyed aggregate through the fast path
 5. a small Inception block scoring via map_blocks
+6. int8 KV-cache decode (round 4: the HBM-bound config the cache
+   quantization exists for)
+7. device-resident sort_values + filter (round 4: lax.sort ordering and
+   mask-only-crossing subset, both staying in HBM)
 
 Exit code 0 = all green (prints per-check lines).
 """
@@ -129,6 +133,35 @@ def main() -> int:
     out = tfs.map_blocks(lambda images: inc.scoring_program(cfg, params)(images), df)
     lab = np.asarray(out.column_values("label"))
     print(f"OK inception quarter-width scoring ({lab.shape[0]} rows) in {time.time() - t0:.1f}s")
+
+    # round-4 features on the chip: int8 KV-cache decode (the config the
+    # quantization exists for) and device-resident sort/filter
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+
+    gcfg = gen.gpt_tiny()
+    gp = tr.quantize_params(tr.init_params(gcfg, seed=0))
+    prompts = np.random.default_rng(3).integers(
+        0, gcfg.vocab_size, (2, 4)
+    ).astype(np.int32)
+    t0 = time.time()
+    toks = np.asarray(gen.generate(gcfg, gp, prompts, 6, kv_quant=True))
+    assert toks.shape == (2, 6)
+    print(f"OK int8-KV decode in {time.time() - t0:.1f}s")
+
+    sf = tfs.frame_from_arrays(
+        {"k": rng.standard_normal(4096).astype(np.float32),
+         "t": np.arange(4096)}
+    ).to_device()
+    t0 = time.time()
+    srt = sf.sort_values("k")
+    [sb] = srt.blocks()
+    assert hasattr(sb["k"], "addressable_shards")  # stayed on device
+    kv = np.asarray(sb["k"])
+    assert (np.diff(kv) >= 0).all()
+    flt = sf.filter(lambda k: {"keep": k > 0.0})
+    assert (np.asarray(flt.column_values("k")) > 0).all()
+    print(f"OK device sort+filter in {time.time() - t0:.1f}s")
     print("ALL GREEN")
     return 0
 

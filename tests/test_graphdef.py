@@ -841,13 +841,17 @@ def test_image_serving_op_tier_matches_tf():
             tf.math.xdivy(mm, tf.abs(mm) - tf.abs(mm)), name="xd"
         )  # y==0 path
         tf.math.divide_no_nan(mm, mm - mm, name="dn")  # y==0 everywhere
+        tf.identity(
+            tf.math.xlogy(tf.nn.relu(mm), tf.abs(mm)), name="xl"
+        )  # x==0 path where relu clamps
         tf.reduce_all(mm > -10.0, axis=1, name="ra")
         tf.reduce_any(mm > 0.5, axis=[0, 2], name="ry")
     data = g.as_graph_def().SerializeToString()
     fetches = [
         "rb", "rba", "rbh", "rn", "rna", "rnah", "rnh", "sd", "ds",
         "gn", "gnc",
-        "mr", "ms", "an", "bp", "bpl", "rv", "ls", "xd", "dn", "ra", "ry",
+        "mr", "ms", "an", "bp", "bpl", "rv", "ls", "xd", "xl", "dn",
+        "ra", "ry",
     ]
     prog = program_from_graphdef(
         parse_graphdef(data), fetches=fetches, compute_dtype=None
