@@ -387,8 +387,10 @@ class TensorFrame:
         data; standalone frames need it native. A sharded frame's
         result columns stay on device but lose their mesh layout
         (row-dropping is data-dependent — call ``.to_device()`` to
-        re-shard); multi-process frames raise with the
-        ``column_values`` guidance.
+        re-shard). MULTI-PROCESS frames filter process-locally: every
+        process keeps its own passing rows (no collective involved),
+        yielding a process-local frame like the broadcast join's
+        output.
         """
         from .ops.verbs import map_blocks
 
@@ -411,15 +413,55 @@ class TensorFrame:
             for b in masked.blocks():
                 mv = b[mname]
                 if _non_addressable(mv):
-                    # multi-process: the mask (and the columns) span
-                    # processes — same actionable guidance as
-                    # column_values, not a raw JAX addressability error
-                    raise RuntimeError(
-                        "filter: columns span processes — one process "
-                        "cannot subset the global frame. Filter before "
-                        "frame_from_process_local, or reduce with a verb "
-                        "(verbs run as collectives)."
-                    )
+                    # MULTI-PROCESS: every process keeps ITS OWN rows
+                    # that pass — the mask's local shard selects from
+                    # each column's local shard, purely process-local
+                    # (no collective, so no deadlock shape exists), and
+                    # the result is a process-local host/device frame
+                    # like the broadcast join's output.
+                    from .ops.device_agg import extract_local_rows
+
+                    m_loc = extract_local_rows(mv)
+                    if m_loc is None:
+                        raise RuntimeError(
+                            "filter: no addressable shard of the mask "
+                            "on this process — re-shard so every "
+                            "process holds rows "
+                            "(frame_from_process_local)"
+                        )
+                    m_loc = np.asarray(m_loc)
+                    if m_loc.dtype != np.bool_ or m_loc.ndim != 1:
+                        raise ValueError(
+                            f"filter predicate output {mname!r} must be "
+                            f"bool[rows]; got {m_loc.dtype} with shape "
+                            f"{m_loc.shape}"
+                        )
+                    nb: Block = {}
+                    for name in names:
+                        v_loc = extract_local_rows(b[name])
+                        if v_loc is None:
+                            raise RuntimeError(
+                                f"filter: column {name!r} has no "
+                                "addressable shard on this process"
+                            )
+                        if len(v_loc) != m_loc.shape[0]:
+                            # same fail-LOUDLY contract as the
+                            # single-process row-count guard below
+                            raise ValueError(
+                                f"filter predicate output {mname!r} has "
+                                f"{m_loc.shape[0]} rows for this "
+                                f"process's {len(v_loc)} rows of "
+                                f"{name!r}"
+                            )
+                        if isinstance(b[name], list):
+                            nb[name] = [
+                                x for x, keep in zip(b[name], m_loc)
+                                if keep
+                            ]
+                        else:
+                            nb[name] = np.asarray(v_loc)[m_loc]
+                    new_blocks.append(nb)
+                    continue
                 m = np.asarray(mv)
                 if m.dtype != np.bool_ or m.ndim != 1:
                     raise ValueError(
@@ -471,8 +513,10 @@ class TensorFrame:
         their input order, ascending OR descending; multiple keys sort
         lexicographically, first key primary). Global across blocks —
         the result is one block, like ``repartition(1)``. Another
-        affordance the reference left to Spark (``orderBy``). Lazy;
-        multi-process frames raise the ``column_values`` guidance.
+        affordance the reference left to Spark (``orderBy``). Lazy.
+        MULTI-PROCESS frames allgather their rows in process order (the
+        global row order, so ties stay stable) and every process holds
+        the same replicated sorted frame.
 
         DEVICE frames sort ON DEVICE: when every column is a device
         array and every key is numeric/bool, ordering runs as
@@ -498,17 +542,58 @@ class TensorFrame:
         parent = self
 
         def compute() -> List[Block]:
+            import jax
+
             from .ops.keys import _unique_inverse
 
-            merged = _merged_global_columns(
-                parent, names, "sort_values", keep_device=True
+            merged = None
+            spans = (
+                jax.process_count() > 1 and parent.is_sharded
+            ) or any(
+                _non_addressable(v)
+                for b in parent.blocks()
+                for v in b.values()
             )
+            if spans:
+                # MULTI-PROCESS: a global sort's result is one totally
+                # ordered block — allgather every process's local rows
+                # in process order (the global row order, so ties stay
+                # stable) and sort the union locally; every process
+                # holds the same REPLICATED sorted frame, the
+                # repartition(1) semantics this verb already promises.
+                from .ops.device_agg import (
+                    _allgather_dicts, gather_local_columns, uniform_ok,
+                )
+
+                local = gather_local_columns(parent, names)
+                # vote BEFORE the allgather so an ineligible fleet
+                # raises everywhere instead of deadlocking a collective
+                if not uniform_ok(local is not None):
+                    raise RuntimeError(
+                        "sort_values: some process holds no addressable "
+                        "shard of a column — re-shard so every process "
+                        "holds rows (frame_from_process_local)"
+                    )
+                union, _ = _allgather_dicts([local[n] for n in names])
+                merged = {
+                    name: (
+                        list(v)
+                        if isinstance(v, np.ndarray) and v.dtype == object
+                        else v
+                    )
+                    for name, v in zip(names, union)
+                }
+            if merged is None:
+                merged = _merged_global_columns(
+                    parent, names, "sort_values", keep_device=True
+                )
             # DEVICE path (VERDICT r3 #7): every selected column is a
             # device array and every key is numeric/bool — order and
             # gather entirely on device (jnp.lexsort → lax.sort), so a
             # large device frame never serializes through host memory.
             # Object/string/uint64 keys and host columns take the host
-            # codes path below.
+            # codes path below. (The multi-process union is host numpy,
+            # so it takes the host path.)
             import jax.numpy as jnp
 
             def _dev_key_ok(v):
@@ -882,34 +967,12 @@ class TensorFrame:
                 # (spans is a property of the global frame), so the
                 # allgather collective cannot deadlock.
                 from .ops.device_agg import (
-                    _allgather_dicts, extract_local_rows,
+                    _allgather_dicts, gather_local_columns, uniform_ok,
                 )
 
-                def local_merged(fr):
-                    # returns None (not raise) when a column has no
-                    # addressable shard here: eligibility is VOTED on
-                    # below so every process raises together instead of
-                    # one bailing out while its peers sit in the
-                    # allgather collective
-                    cols: Dict[str, np.ndarray] = {}
-                    for name in fr.schema.names:
-                        parts = []
-                        for b in fr.blocks():
-                            lr = extract_local_rows(b[name])
-                            if lr is None:
-                                return None
-                            parts.append(lr)
-                        cols[name] = (
-                            parts[0] if len(parts) == 1
-                            else np.concatenate(parts)
-                        )
-                    return cols
-
-                from .ops.device_agg import uniform_ok
-
-                lcols = local_merged(left)
+                lcols = gather_local_columns(left, left.schema.names)
                 r_names = list(right.schema.names)
-                r_local = local_merged(right)
+                r_local = gather_local_columns(right, r_names)
                 if not uniform_ok(
                     lcols is not None and r_local is not None
                 ):

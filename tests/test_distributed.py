@@ -134,6 +134,27 @@ jgot = [
     for r in jrows
 ]
 assert jgot == jwant, (jgot, jwant)
+# multi-process FILTER: process-local subset (each process keeps its
+# own passing rows; no collective)
+fgot = [
+    (int(r["k"]), float(r["v"]))
+    for r in kf.filter(lambda v: {{"keep": v > 10.0 * pid + 1.5}}).collect()
+]
+assert fgot == [(pid + 1, 10.0 * pid + 2.0)], fgot
+# multi-process SORT: allgather in process order -> every process holds
+# the SAME replicated globally-sorted frame. EXACT sequence asserted:
+# python's sorted() over the global-row-order list is stable, so equal
+# keys must appear in global row order — tie stability included
+sgot2 = [
+    (int(r["k"]), float(r["v"]))
+    for r in kf.sort_values("k").collect()
+]
+global_rows = []
+for p in range(NPROC):
+    global_rows.append((p, 10.0 * p + 1.0))
+    global_rows.append((p + 1, 10.0 * p + 2.0))
+swant2 = sorted(global_rows, key=lambda t: t[0])
+assert sgot2 == swant2, (sgot2, swant2)
 # sharded persistence: each process writes its part, reloads, and the
 # reassembled global frame reduces to the same total across hosts
 sf_dir = {sf_dir!r}
