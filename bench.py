@@ -1030,8 +1030,11 @@ def main():
             print(f"# {name_}={v_}")
     # per-metric history (VERDICT r2 #5): every run appends one JSON line
     # so cross-round drift (the r01→r02 bert_tiny −26% the gate couldn't
-    # see) is reconstructable from the repo itself
+    # see) is reconstructable from the repo itself. Rehearsal/CI runs set
+    # TFTPU_BENCH_NO_HISTORY=1: a contended dry run is not provenance.
     try:
+        if os.environ.get("TFTPU_BENCH_NO_HISTORY") == "1":
+            raise OSError("history append disabled (TFTPU_BENCH_NO_HISTORY)")
         hist_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
             "dev", "bench_history.jsonl",

@@ -10,7 +10,7 @@ default factor is 2.0 against a same-machine baseline, so an injected
 timing in bench.py holds repeats to ~10%) does not.
 
 Usage: python dev/bench_check.py bench_output.txt [--factor F]
-       [--require-all] [--refresh]
+       [--require-all] [--refresh] [--baseline PATH]
 
 * ``--factor`` widens the allowance for alien runners (CI uses 10).
 * A metric whose bench line reads ``name=ERROR ImportError...`` is
@@ -18,6 +18,9 @@ Usage: python dev/bench_check.py bench_output.txt [--factor F]
   tensorflow, so the frozen-graph fixtures legitimately can't build
   there (ADVICE r2).
 * ``--refresh`` records this run as the baseline for its platform.
+* ``--baseline PATH`` reads/writes an alternate baseline file (the heal
+  rehearsal refreshes into a throwaway copy so a CPU dry run can never
+  clobber the real per-platform baselines).
 * No baseline recorded yet for this platform → pass with a notice (the
   first run on new hardware cannot regress against anything).
 """
@@ -49,19 +52,22 @@ def main(argv) -> int:
     refresh = "--refresh" in argv
     if "--factor" in argv:
         factor = float(argv[argv.index("--factor") + 1])
+    baseline_path = BASELINE_PATH
+    if "--baseline" in argv:
+        baseline_path = argv[argv.index("--baseline") + 1]
     with open(path) as f:
         text = f.read()
     values, errors, platform = parse(text)
 
     try:
-        with open(BASELINE_PATH) as f:
+        with open(baseline_path) as f:
             all_baselines = json.load(f)
     except FileNotFoundError:
         all_baselines = {}
 
     if refresh:
         all_baselines[platform] = values
-        with open(BASELINE_PATH, "w") as f:
+        with open(baseline_path, "w") as f:
             json.dump(all_baselines, f, indent=1, sort_keys=True)
         print(
             f"bench_check: {platform} baseline refreshed with "

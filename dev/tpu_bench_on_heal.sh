@@ -1,40 +1,87 @@
 #!/bin/bash
 # Wait for the tunnel prober to mark the backend healthy, then capture
-# EVERYTHING the round-3 verdict's TPU re-validation item asks for —
+# EVERYTHING the round-3/4 verdicts' TPU re-validation items ask for —
 # smoke first, then the full bench (appends a platform=tpu entry to
 # dev/bench_history.jsonl with the device-frame aggregate, native
 # string-hash, bf16 frozen serving, bert_base, gpt_small f32+int8kv
-# decode, batch-swept headline), then refresh the TPU regression
-# baseline so the gate tracks the new configuration set. Written so a
-# heal window is never missed while the operator is elsewhere — and so
-# a FLAPPING tunnel (healthy probe, wedged again by smoke time) re-arms
-# instead of consuming the one-shot watcher on a dead backend.
+# decode, batch-swept headline, transfer/compute splits), then refresh
+# the TPU regression baseline so the gate tracks the new configuration
+# set. Written so a heal window is never missed while the operator is
+# elsewhere — and so a FLAPPING tunnel (healthy probe, wedged again by
+# smoke time) re-arms instead of consuming the one-shot watcher on a
+# dead backend.
+#
+# SINGLETON: flock guards against two watchers racing (round-4 verdict
+# item 1 — two probe loops were observed racing; the same failure mode
+# applies here).
+#
+# REHEARSAL: TFTPU_HEAL_REHEARSAL=1 runs the entire pipeline once on
+# the CPU backend (simulated heal): it plants its own TPU_ALIVE marker,
+# tells the smoke to accept CPU (pallas interpreted), skips the
+# CpuDevice re-arm check (a rehearsal IS a CPU run), writes all logs
+# with a .rehearsal suffix, refreshes into a throwaway baseline copy,
+# and exits after one pass leaving the real state untouched.
 cd /root/repo
+REH="${TFTPU_HEAL_REHEARSAL:-0}"
+LOCK=dev/.tpu_heal.lock
+[ "$REH" = "1" ] && LOCK=dev/.tpu_heal_rehearsal.lock
+exec 8>"$LOCK"
+flock -n 8 || { echo "tpu_bench_on_heal: another watcher holds the lock" >&2; exit 0; }
+
+if [ "$REH" = "1" ]; then
+  export JAX_PLATFORMS=cpu
+  export TFTPU_SMOKE_ALLOW_CPU=1
+  # the axon sitecustomize dials the TPU relay at EVERY interpreter
+  # start when this is set; against a wedged tunnel that call can hang
+  # 90s+, which timed out the rehearsal's probe subprocesses (observed
+  # round 5). A CPU rehearsal needs no axon backend at all.
+  export PALLAS_AXON_POOL_IPS=
+  # a contended CPU dry run is not provenance — keep it out of
+  # dev/bench_history.jsonl
+  export TFTPU_BENCH_NO_HISTORY=1
+  SUF=".rehearsal"
+  ALIVE=dev/TPU_ALIVE.rehearsal
+  BASELINE_ARGS=(--baseline dev/bench_baseline_rehearsal.json)
+  cp dev/bench_baseline.json dev/bench_baseline_rehearsal.json 2>/dev/null || true
+  touch "$ALIVE"
+else
+  SUF=""
+  ALIVE=dev/TPU_ALIVE
+  BASELINE_ARGS=()
+fi
+
 while true; do
-  while [ ! -f dev/TPU_ALIVE ]; do sleep 60; done
+  while [ ! -f "$ALIVE" ]; do sleep 60; done
   echo "$(date -u +%H:%M:%S) TPU healed — smoke" >> dev/tpu_probe.log
-  timeout 900 python dev/tpu_smoke.py > dev/tpu_smoke_heal.log 2>&1
+  timeout 900 python dev/tpu_smoke.py > "dev/tpu_smoke_heal.log$SUF" 2>&1
   src=$?
-  echo "$(date -u +%H:%M:%S) smoke exit=$src (dev/tpu_smoke_heal.log)" >> dev/tpu_probe.log
+  echo "$(date -u +%H:%M:%S) smoke exit=$src (dev/tpu_smoke_heal.log$SUF)" >> dev/tpu_probe.log
   if [ $src -ne 0 ]; then
     # transient heal: drop the marker, resume probing, keep waiting
-    rm -f dev/TPU_ALIVE
-    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 &
+    rm -f "$ALIVE"
+    [ "$REH" = "1" ] && exit 1
+    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 8>&- &
     continue
   fi
-  python bench.py > dev/bench_tpu_heal.log 2>&1
+  python bench.py > "dev/bench_tpu_heal.log$SUF" 2>&1
   rc=$?
-  echo "$(date -u +%H:%M:%S) bench exit=$rc (dev/bench_tpu_heal.log)" >> dev/tpu_probe.log
-  if [ $rc -ne 0 ] || grep -q "devices=\[CpuDevice" dev/bench_tpu_heal.log; then
+  echo "$(date -u +%H:%M:%S) bench exit=$rc (dev/bench_tpu_heal.log$SUF)" >> dev/tpu_probe.log
+  if [ $rc -ne 0 ] || { [ "$REH" != "1" ] && grep -q "devices=\[CpuDevice" "dev/bench_tpu_heal.log$SUF"; }; then
     # bench failed, or self-degraded to CPU because the backend
     # re-wedged mid-run: that run captured nothing TPU — re-arm and
     # keep waiting for the next genuine window (same as smoke failure)
     echo "$(date -u +%H:%M:%S) bench was not a TPU run — re-arming" >> dev/tpu_probe.log
-    rm -f dev/TPU_ALIVE
-    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 &
+    rm -f "$ALIVE"
+    [ "$REH" = "1" ] && exit 1
+    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 8>&- &
     continue
   fi
-  python dev/bench_check.py dev/bench_tpu_heal.log --refresh \
+  python dev/bench_check.py "dev/bench_tpu_heal.log$SUF" --refresh "${BASELINE_ARGS[@]}" \
     >> dev/tpu_probe.log 2>&1
+  if [ "$REH" = "1" ]; then
+    rm -f "$ALIVE"
+    echo "$(date -u +%H:%M:%S) rehearsal complete (logs: *.rehearsal)" >> dev/tpu_probe.log
+    exit 0
+  fi
   break
 done

@@ -2,6 +2,12 @@
 # Probe the axon TPU backend on a 2-6 min cadence (120s sleep + up to
 # 240s probe timeout when the backend hangs); write status to
 # dev/tpu_probe.log and touch dev/TPU_ALIVE when a probe succeeds.
+#
+# SINGLETON: round 4 ended with two copies of this loop racing (a
+# manual launch plus the heal script's re-arm). The flock below makes
+# any second copy exit immediately, so re-arms can never stack.
+exec 9>/root/repo/dev/.tpu_probe.lock
+flock -n 9 || exit 0
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout 240 python -c "import jax; jax.devices(); print('ok')" >/dev/null 2>&1; then

@@ -65,9 +65,16 @@ def main() -> int:
 
     dev = jax.devices()[0]
     print(f"devices: {jax.devices()}")
+    allow_cpu = os.environ.get("TFTPU_SMOKE_ALLOW_CPU") == "1"
     if dev.platform == "cpu":
-        print("FAIL backend: only CPU visible")
-        return 1
+        if not allow_cpu:
+            print("FAIL backend: only CPU visible")
+            return 1
+        # heal-pipeline rehearsal (dev/tpu_bench_on_heal.sh): run every
+        # check the backend permits so the SHELL wiring is validated
+        # before the one real window; pallas runs interpreted here
+        print("NOTE rehearsal mode: CPU backend accepted, pallas interpreted")
+    interp = dev.platform == "cpu"
 
     t0 = time.time()
     x = jnp.ones((1024, 1024), jnp.bfloat16)
@@ -80,11 +87,12 @@ def main() -> int:
     sids = jnp.asarray(np.random.default_rng(1).integers(0, 16, 512), jnp.int32)
     try:
         t0 = time.time()
-        out = segment.segment_sum_pallas(vals, sids, 16)
+        out = segment.segment_sum_pallas(vals, sids, 16, interpret=interp)
         ref = np.zeros((16, 4), np.float32)
         np.add.at(ref, np.asarray(sids), np.asarray(vals))
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
-        print(f"OK pallas segment-sum (non-interpreted) in {time.time() - t0:.1f}s")
+        mode = "interpreted" if interp else "non-interpreted"
+        print(f"OK pallas segment-sum ({mode}) in {time.time() - t0:.1f}s")
     except Exception as e:
         print(f"FAIL pallas segment-sum: {type(e).__name__}: {str(e)[:200]}")
         return 1

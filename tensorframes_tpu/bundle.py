@@ -82,7 +82,10 @@ def _parse_table_block(data: bytes, off: int, size: int) -> List[Tuple[bytes, by
     """Decode one SSTable block (prefix-compressed entries + restart
     array). The byte at ``data[off+size]`` is the compression tag —
     bundle index blocks are written raw (type 0)."""
-    if off + size > len(data):
+    # ``>=``: the compression-tag byte at data[off+size] must itself be
+    # in range, else a truncated index crashes with IndexError instead
+    # of the BundleError the fallback contract documents (ADVICE r4)
+    if off + size >= len(data):
         raise BundleError("block handle past end of file")
     if size < 4:
         raise BundleError("block too small for a restart array")

@@ -45,6 +45,17 @@ from . import dtypes as dt
 from .ops.windows import same_pool_counts
 from .program import Program, TensorSpec, analyze_program
 from .shape import Shape, Unknown
+from .utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class UnresolvedVariableError(ValueError):
+    """A reachable VarHandleOp has no bound value (the checkpoint bundle
+    restored fine but the graph references a variable absent from it).
+    ``load_saved_model`` falls back to TensorFlow freezing on exactly
+    this failure; other lowering ``ValueError``s are genuine import
+    errors and stay chained into any final failure (ADVICE r4)."""
 
 # ---------------------------------------------------------------------------
 # protobuf wire-format primitives (clean-room; spec: protobuf.dev/encoding)
@@ -959,7 +970,25 @@ def _resolve_compute_dtype(compute_dtype):
         return compute_dtype
     import jax
 
-    return "bfloat16" if jax.default_backend() != "cpu" else None
+    resolved = "bfloat16" if jax.default_backend() != "cpu" else None
+    if resolved == "bfloat16":
+        # precision drift must be traceable: "auto" silently changing
+        # imported-graph numerics vs TF is worth one log line per
+        # process (ADVICE r4)
+        global _auto_bf16_logged
+        if not _auto_bf16_logged:
+            _auto_bf16_logged = True
+            logger.info(
+                "compute_dtype='auto' resolved to bfloat16 on the %s "
+                "backend: imported MatMul/Conv ops serve in bf16 with "
+                "f32 accumulation and will not bit-match TF; pass "
+                "compute_dtype=None for f32-faithful serving",
+                jax.default_backend(),
+            )
+    return resolved
+
+
+_auto_bf16_logged = False
 
 
 def program_from_graphdef(
@@ -1127,7 +1156,7 @@ def program_from_graphdef(
             elif variables is not None and n.name in variables:
                 consts[n.name] = np.asarray(variables[n.name])
             else:
-                raise ValueError(
+                raise UnresolvedVariableError(
                     f"graph contains variable {key!r} (VarHandleOp node "
                     f"{n.name!r}) with no bound value; pass "
                     "variables={name: array} — load_saved_model restores "
@@ -2066,6 +2095,7 @@ def load_saved_model(
     import os as _os
 
     pb = _os.path.join(path, "saved_model.pb")
+    tf_free_error = None
     if _os.path.exists(pb):
         with open(pb, "rb") as fh:
             metas = _parse_meta_graphs_raw(fh.read())
@@ -2175,16 +2205,27 @@ def load_saved_model(
                 return _tf_free_import()
             try:
                 return _tf_free_import()
-            except ValueError as e:
+            except UnresolvedVariableError as e:
                 # a resolvable BUNDLE does not guarantee a
-                # resolvable GRAPH: legacy VariableV2 nodes, or a
-                # reachable VarHandleOp whose shared_name is absent
-                # from the restored map, surface as lowering
-                # ValueErrors — those models keep the old
-                # TF-freezing behavior below
+                # resolvable GRAPH: a reachable VarHandleOp whose
+                # shared_name is absent from the restored map keeps
+                # the old TF-freezing behavior below
+                tf_free_error = e
                 logger.warning(
                     "TF-free variable import failed (%s); falling "
                     "back to TensorFlow freezing", e,
+                )
+            except ValueError as e:
+                # a GENUINE lowering failure (e.g. unsupported op —
+                # legacy VariableV2 lands here). TF re-tracing during
+                # freezing can still produce a lowerable graph, so
+                # fall back — but keep the root cause chained so a
+                # missing-tensorflow environment surfaces it instead
+                # of only the generic 'tensorflow required' (ADVICE r4)
+                tf_free_error = e
+                logger.warning(
+                    "TF-free import hit a lowering error (%s); "
+                    "retrying via TensorFlow freezing", e,
                 )
     try:
         import tensorflow as tf
@@ -2192,12 +2233,21 @@ def load_saved_model(
             convert_variables_to_constants_v2,
         )
     except ImportError as e:
-        raise ImportError(
+        msg = (
             "this SavedModel holds variables, and freezing them needs "
             "tensorflow; freeze offline (convert_variables_to_constants_v2) "
             "and use load_graphdef on the result instead (variable-FREE "
             "SavedModels load without tensorflow)"
-        ) from e
+        )
+        if tf_free_error is not None:
+            msg += (
+                f"; note the TF-free import path failed first with: "
+                f"{tf_free_error}"
+            )
+        # chain `e`, not tf_free_error: a BROKEN tensorflow install
+        # (numpy ABI mismatch etc.) must stay visible — tf_free_error
+        # is already embedded in the message above
+        raise ImportError(msg) from e
     m = tf.saved_model.load(path)
     if signature not in m.signatures:
         raise KeyError(

@@ -446,9 +446,12 @@ def _stack_group(col, idx) -> np.ndarray:
     cells = [col[i] for i in idx]
     try:
         # native.stack_cells returns None itself for unavailable /
-        # non-ndarray / object-dtype / non-contiguous first cells
+        # non-ndarray / object-dtype / non-contiguous first cells;
+        # BufferError covers a non-contiguous LATER cell (a sliced-view
+        # ndarray) whose PyObject_GetBuffer fails inside rowpack.cpp —
+        # np.stack handles such views fine (ADVICE r4)
         stacked = native.stack_cells(cells)
-    except (ValueError, TypeError):
+    except (ValueError, TypeError, BufferError):
         stacked = None
     if stacked is not None:
         return stacked

@@ -867,3 +867,47 @@ def test_image_serving_op_tier_matches_tf():
             np.asarray(w).astype(np.float64),
             atol=1e-5, err_msg=name,
         )
+
+
+def test_compute_dtype_auto_logs_bf16_once(monkeypatch, caplog):
+    """ADVICE r4: "auto" silently flipping imports to bf16 must be
+    traceable — one INFO line per process the first time auto resolves
+    to bfloat16, none on later resolutions."""
+    import jax
+    import logging
+
+    from tensorframes_tpu import graphdef as gd
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(gd, "_auto_bf16_logged", False)
+    with caplog.at_level(logging.INFO, logger="tensorframes_tpu.graphdef"):
+        assert gd._resolve_compute_dtype("auto") == "bfloat16"
+        assert gd._resolve_compute_dtype("auto") == "bfloat16"
+    hits = [r for r in caplog.records if "bfloat16" in r.message]
+    assert len(hits) == 1 and "compute_dtype=None" in hits[0].getMessage()
+
+
+def test_unresolved_variable_error_type():
+    """ADVICE r4: an unbound VarHandleOp raises the DEDICATED subclass
+    (still a ValueError for old callers) so load_saved_model's
+    TF-freezing fallback can tell it from genuine lowering errors."""
+    from tensorframes_tpu.graphdef import GraphNode, UnresolvedVariableError
+
+    node = GraphNode(name="w", op="VarHandleOp", inputs=[], attrs={})
+    with pytest.raises(UnresolvedVariableError) as ei:
+        program_from_graphdef([node], fetches=["w"])
+    assert isinstance(ei.value, ValueError)
+    assert "no bound value" in str(ei.value)
+
+
+def test_bundle_truncated_index_raises_bundle_error():
+    """ADVICE r4: a block handle whose tag byte would sit exactly at
+    EOF must surface as BundleError (the documented fallback contract),
+    not IndexError."""
+    from tensorframes_tpu.bundle import BundleError, _parse_table_block
+
+    data = bytes(16)
+    with pytest.raises(BundleError):
+        _parse_table_block(data, 8, 8)  # off+size == len(data)
+    with pytest.raises(BundleError):
+        _parse_table_block(data, 8, 12)  # past EOF

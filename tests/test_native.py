@@ -234,3 +234,21 @@ def test_stack_cells_matches_np_stack():
         native.stack_cells(
             [np.zeros(4, np.float32), np.zeros(4, np.int32)]
         )
+
+
+def test_stack_group_falls_back_on_noncontiguous_later_cell():
+    """ADVICE r4: a sliced-view (non-C-contiguous) cell AFTER cell 0
+    passes the wrapper's cell-0 pre-check but makes PyObject_GetBuffer
+    raise BufferError inside rowpack.cpp — _stack_group must fall back
+    to np.stack (which handles views fine), not abort the ragged map."""
+    from tensorframes_tpu.ops.verbs import _stack_group
+
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    cells = [
+        np.ascontiguousarray(base[0, ::2]),  # contiguous cell 0
+        base[1, ::2],                        # strided view: not contiguous
+        np.ascontiguousarray(base[2, ::2]),
+    ]
+    assert not cells[1].flags.c_contiguous
+    got = _stack_group(cells, [0, 1, 2])
+    np.testing.assert_array_equal(got, np.stack(cells))
