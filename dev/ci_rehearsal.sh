@@ -9,6 +9,12 @@
 #
 #   * matrix python-version: only the image's python (3.12) is
 #     installed; the 3.11 leg cannot run here.
+#   * the bench-smoke step usually runs CONTENDED (rehearsals share the
+#     machine with a build session); its absolute numbers can print
+#     10x+ slower than dedicated runs and must never be read as
+#     regressions — the factor-10 gate exists exactly for that, and
+#     rehearsal benches do not enter dev/bench_history.jsonl
+#     (TFTPU_BENCH_NO_HISTORY).
 #   * `pip install -U pip` + `pip install -e ".[test]"`: the image has
 #     no package index (zero egress) and the interpreter is itself a
 #     venv (a nested venv would lose its site-packages), so the project
@@ -33,6 +39,7 @@ SITE="$WORK/site"
 export PALLAS_AXON_POOL_IPS=  # CPU CI: never touch the TPU relay
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS=--xla_force_host_platform_device_count=8
+export TFTPU_BENCH_NO_HISTORY=1  # a contended smoke is not provenance
 
 run_step() {
   local name="$1"; shift
@@ -58,8 +65,11 @@ run_step() {
 run_step "checkout (clean clone of HEAD)" \
   git clone --quiet --no-hardlinks "$REPO" "$CLONE"
 
-run_step "setup-python (image interpreter; 3.11 leg unavailable here)" \
+run_step "setup-python (image interpreter; full 3.11 leg unavailable here)" \
   python -c "import sys; assert sys.version_info >= (3, 11); print(sys.version)"
+
+run_step "py311 static gate (the 3.11-leg stand-in that CAN run here)" \
+  bash "$REPO/dev/py311_check.sh"
 
 cd "$CLONE"
 

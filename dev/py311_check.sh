@@ -1,0 +1,33 @@
+#!/bin/bash
+# Python-3.11 compatibility gate (VERDICT r4 #9): the CI matrix's 3.11
+# leg has never executed because no jax-equipped 3.11 interpreter can be
+# provisioned offline (zero egress, no pip). This is the static stand-in
+# that CAN run anywhere a bare python3.11 exists:
+#
+#   1. py_compile every source file under 3.11 — rejects 3.12-only
+#      SYNTAX (PEP 695 type parameters, f-string grammar extensions).
+#   2. grep for 3.12-only stdlib API usage the syntax pass can't see.
+#
+# What it cannot prove: RUNTIME behavior differences (none known — the
+# package uses no itertools.batched, no os.path.isjunction, no
+# tomllib-3.12-only features; typing usage is 3.9-era). The real 3.11
+# leg runs the moment CI reaches a real runner (ci.yml matrix).
+set -e
+cd "$(dirname "$0")/.."
+PY311="${PY311:-python3.11}"
+if ! command -v "$PY311" >/dev/null; then
+  echo "py311_check: no python3.11 on PATH — skipping (documented risk)"
+  exit 0
+fi
+# the axon sitecustomize needs jax; a bare 3.11 has none — silence it
+export PALLAS_AXON_POOL_IPS=
+FILES=$(find tensorframes_tpu tests examples dev -name "*.py"; echo bench.py __graft_entry__.py)
+"$PY311" -m py_compile $FILES
+# 3.12-only stdlib surface a syntax compile can't catch — same scope as
+# the py_compile pass above (tests/dev scripts run on the 3.11 leg too)
+if grep -rnE "itertools\.batched|os\.path\.isjunction|calendar\.(Month|Day)\b|\bsys\.monitoring" \
+    tensorframes_tpu tests examples dev bench.py __graft_entry__.py --include="*.py"; then
+  echo "py311_check: 3.12-only stdlib API found (lines above)"
+  exit 1
+fi
+echo "py311_check: OK ($(echo "$FILES" | wc -w) files compile under $("$PY311" --version 2>&1); no 3.12-only stdlib use)"

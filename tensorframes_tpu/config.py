@@ -79,6 +79,19 @@ class Config:
     # saving) and bloating every per-shape compile with literal copies
     # of the weights.
     hoist_constants: bool = _env_bool("TFTPU_HOIST_CONSTS", True)
+    # Multi-process relational verbs (sort_values / join): frames whose
+    # replicated side would exceed this byte budget PER PROCESS switch
+    # from the replicating plan (allgather sort / broadcast join) to the
+    # hash/range-partitioned exchange (ops/exchange.py), which holds
+    # only O(global/P) rows per process (VERDICT r4 #2/#7; ≙ Catalyst's
+    # hash-partitioned exchange, DebugRowOps.scala:583).
+    relational_broadcast_bytes: int = _env_int(
+        "TFTPU_RELATIONAL_BROADCAST_MB", 64
+    ) * (1 << 20)
+    # Kill-switch for the exchange path (debugging): with it off, an
+    # over-budget replicated plan raises an actionable error instead of
+    # silently OOMing every process at once.
+    relational_exchange: bool = _env_bool("TFTPU_RELATIONAL_EXCHANGE", True)
     # Demote f64/i64 device columns to f32/i32 at the device boundary:
     # False = never (reference-parity precision, f64 emulated on TPU),
     # True = on TPU backends only, "always" = every backend (testing /

@@ -514,9 +514,17 @@ class TensorFrame:
         lexicographically, first key primary). Global across blocks —
         the result is one block, like ``repartition(1)``. Another
         affordance the reference left to Spark (``orderBy``). Lazy.
-        MULTI-PROCESS frames allgather their rows in process order (the
-        global row order, so ties stay stable) and every process holds
-        the same replicated sorted frame.
+
+        MULTI-PROCESS frames under ``config.relational_broadcast_bytes``
+        allgather their rows in process order (the global row order, so
+        ties stay stable) and every process holds the same replicated
+        sorted frame. LARGER frames take the range-partitioned exchange
+        (``ops/exchange.py`` ≙ Spark's rangepartitioning exchange for
+        orderBy): process p receives and sorts the p-th key range, so
+        each process holds O(global/P) rows and concatenating the
+        per-process results in process order is the global sort order —
+        tie stability included (the exchange preserves (process, local
+        row) order and the local sort is stable).
 
         DEVICE frames sort ON DEVICE: when every column is a device
         array and every key is numeric/bool, ordering runs as
@@ -555,12 +563,14 @@ class TensorFrame:
                 for v in b.values()
             )
             if spans:
-                # MULTI-PROCESS: a global sort's result is one totally
-                # ordered block — allgather every process's local rows
-                # in process order (the global row order, so ties stay
-                # stable) and sort the union locally; every process
-                # holds the same REPLICATED sorted frame, the
-                # repartition(1) semantics this verb already promises.
+                # MULTI-PROCESS: small frames allgather and sort the
+                # replicated union (repartition(1) semantics, every
+                # process holds the same block); frames over the
+                # broadcast budget take the RANGE EXCHANGE — process p
+                # receives only the p-th key range (O(global/P) memory)
+                # and sorts it locally (VERDICT r4 #2).
+                from .config import get_config
+                from .ops import exchange as xch
                 from .ops.device_agg import (
                     _allgather_dicts, gather_local_columns, uniform_ok,
                 )
@@ -574,15 +584,44 @@ class TensorFrame:
                         "shard of a column — re-shard so every process "
                         "holds rows (frame_from_process_local)"
                     )
-                union, _ = _allgather_dicts([local[n] for n in names])
-                merged = {
-                    name: (
-                        list(v)
-                        if isinstance(v, np.ndarray) and v.dtype == object
-                        else v
+                cfg = get_config()
+                # global-bytes estimate is an allgather itself, so every
+                # process computes the same number and takes the same
+                # branch — no collective divergence
+                gbytes = xch.global_frame_bytes(local)
+                if gbytes > cfg.relational_broadcast_bytes:
+                    if not cfg.relational_exchange:
+                        raise RuntimeError(
+                            f"sort_values: replicating {gbytes:,} bytes "
+                            "on every process exceeds "
+                            "config.relational_broadcast_bytes "
+                            f"({cfg.relational_broadcast_bytes:,}) and "
+                            "the exchange path is disabled "
+                            "(config.relational_exchange=False / "
+                            "TFTPU_RELATIONAL_EXCHANGE=0) — raise the "
+                            "budget, re-enable the exchange, or sort a "
+                            "projected/filtered frame"
+                        )
+                    part = xch.partition_by_range(
+                        [local[k] for k in keys],
+                        jax.process_count(),
+                        asc,
                     )
-                    for name, v in zip(names, union)
-                }
+                    recv = xch.exchange_rows(local, part)
+                    merged = recv  # this process's key range only
+                else:
+                    union, _ = _allgather_dicts(
+                        [local[n] for n in names]
+                    )
+                    merged = {
+                        name: (
+                            list(v)
+                            if isinstance(v, np.ndarray)
+                            and v.dtype == object
+                            else v
+                        )
+                        for name, v in zip(names, union)
+                    }
             if merged is None:
                 merged = _merged_global_columns(
                     parent, names, "sort_values", keep_device=True
@@ -750,29 +789,63 @@ class TensorFrame:
         ``how="left"`` keeps unmatched left rows; their right-side
         columns take ``fill_value`` (a scalar, or a dict keyed by the
         right column's ORIGINAL name) — explicit fills instead of NaN,
-        because NaN would silently retype integer columns. Lazy;
-        returns one block.
+        because NaN would silently retype integer columns.
+        ``how="right"`` mirrors it (unmatched RIGHT rows kept, LEFT
+        columns filled, pandas-like right-row ordering).
+        ``how="outer"`` keeps both: matched + unmatched-left rows in
+        left order first, then unmatched right rows in right order
+        (pandas sort=False convention); ``fill_value`` must cover the
+        non-key columns of BOTH sides. Lazy; returns one block.
 
         MULTI-PROCESS frames join via a broadcast hash join (VERDICT
-        r3 #7): every process allgathers the full RIGHT side (put the
-        smaller frame on the right) and joins its own process-local
-        left rows, so no process ever materializes the global left.
-        The result is a process-local host frame — each process holds
-        the join of its left rows, like a Spark partition's share of a
-        broadcast join. Exercised at 2 and 4 real OS processes in
+        r3 #7) when the right side fits
+        ``config.relational_broadcast_bytes``: every process allgathers
+        the full RIGHT side (put the smaller frame on the right) and
+        joins its own process-local left rows, so no process ever
+        materializes the global left. A LARGER right side switches to
+        the hash-partitioned exchange (``ops/exchange.py`` ≙ Catalyst's
+        shuffle exchange, DebugRowOps.scala:583): both sides
+        hash-partition on the key columns over the process axis and
+        each process joins one partition — O(global/P) memory, no
+        replication. Either way the result is a process-local host
+        frame — each process holds its share of the join, like a Spark
+        partition's share. Exercised at 2 and 4 real OS processes in
         ``tests/test_distributed.py``.
         """
-        if how not in ("inner", "left"):
-            raise NotImplementedError(
-                f"join supports how='inner'/'left' (got {how!r}); outer "
-                "joins need per-dtype null semantics the schema doesn't "
-                "define"
-            )
-        if how == "left" and fill_value is None:
+        if how not in ("inner", "left", "right", "outer"):
             raise ValueError(
-                "how='left' needs fill_value (scalar or {column: value}) "
-                "for unmatched rows' right-side columns — explicit fills "
-                "instead of NaN, which would retype integer columns"
+                f"join supports how='inner'/'left'/'right'/'outer' "
+                f"(got {how!r})"
+            )
+        if how == "right":
+            # mirror of the left join with the sides (and suffix roles)
+            # swapped; select() restores the canonical keys + left +
+            # right column order. Unmatched-right rows keep pandas'
+            # right-row ordering because they ARE the swapped call's
+            # left rows.
+            swapped = other.join(
+                self,
+                on=on,
+                how="left",
+                suffixes=(suffixes[1], suffixes[0]),
+                fill_value=fill_value,
+            )
+            ks = [on] if isinstance(on, str) else list(on)
+            l_only = [c for c in self.schema.names if c not in ks]
+            r_only = [c for c in other.schema.names if c not in ks]
+            clash = set(l_only) & set(r_only)
+            ordered = (
+                ks
+                + [c + suffixes[0] if c in clash else c for c in l_only]
+                + [c + suffixes[1] if c in clash else c for c in r_only]
+            )
+            return swapped.select(ordered)
+        if how in ("left", "outer") and fill_value is None:
+            raise ValueError(
+                f"how={how!r} needs fill_value (scalar or "
+                "{column: value}) for unmatched rows' columns — "
+                "explicit fills instead of NaN, which would retype "
+                "integer columns"
             )
 
         def fill_for(col_name):
@@ -824,11 +897,14 @@ class TensorFrame:
         rname = {
             c: (c + suffixes[1] if c in clashes else c) for c in right_only
         }
-        if how == "left" and isinstance(fill_value, dict):
-            missing_fills = [c for c in right_only if c not in fill_value]
+        if how in ("left", "outer") and isinstance(fill_value, dict):
+            need = list(right_only)
+            if how == "outer":  # unmatched RIGHT rows fill left columns
+                need += left_only
+            missing_fills = [c for c in need if c not in fill_value]
             if missing_fills:
                 raise ValueError(
-                    f"how='left': fill_value has no entry for right "
+                    f"how={how!r}: fill_value has no entry for "
                     f"column(s) {missing_fills}"
                 )
         cols = (
@@ -844,9 +920,12 @@ class TensorFrame:
 
             nl = _block_num_rows(lcols)
             nr = _block_num_rows(rcols)
-            if nl == 0 or (nr == 0 and how == "inner"):
+            if (nl == 0 and how != "outer") or (
+                nr == 0 and how == "inner"
+            ) or (nl == 0 and nr == 0):
                 # group_ids cannot encode zero rows; an empty side means
-                # an empty inner join (a left join keeps left rows)
+                # an empty inner join (left/outer joins keep the
+                # populated side's rows via the branches below)
                 out0: Block = {}
                 for k in keys:
                     v = lcols[k]
@@ -857,6 +936,23 @@ class TensorFrame:
                 for c in right_only:
                     v = rcols[c]
                     out0[rname[c]] = [] if isinstance(v, list) else v[:0]
+                return out0
+            if nl == 0:  # outer join, only right rows: left cols filled
+                out0 = {}
+                for k in keys:
+                    out0[k] = rcols[k]
+                for c in left_only:
+                    v = lcols[c]
+                    if isinstance(v, list):
+                        out0[lname[c]] = [fill_for(c)] * nr
+                    else:
+                        out0[lname[c]] = np.full(
+                            (nr,) + v.shape[1:],
+                            checked_fill(c, v.dtype),
+                            v.dtype,
+                        )
+                for c in right_only:
+                    out0[rname[c]] = rcols[c]
                 return out0
             if nr == 0:
                 # left join against an empty right side: all left rows,
@@ -893,7 +989,7 @@ class TensorFrame:
             counts = np.bincount(r_codes, minlength=num_codes)
             starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
             cnt_l = counts[l_codes]
-            if how == "left":
+            if how in ("left", "outer"):
                 # unmatched left rows still emit ONE output row, marked
                 # ri = -1 so right columns take the fill
                 cnt_eff = np.maximum(cnt_l, 1)
@@ -905,7 +1001,7 @@ class TensorFrame:
                 np.cumsum(cnt_eff) - cnt_eff, cnt_eff
             )
             base = np.repeat(starts[l_codes], cnt_eff) + offs
-            if how == "left":
+            if how in ("left", "outer"):
                 matched = np.repeat(cnt_l > 0, cnt_eff)
                 safe = np.where(
                     matched, np.clip(base, 0, max(nr - 1, 0)), 0
@@ -920,7 +1016,7 @@ class TensorFrame:
                 return col[idx]
 
             def gather_right(col, col_name):
-                if how != "left":
+                if how not in ("left", "outer"):
                     return gather(col, ri)
                 fv = fill_for(col_name)
                 if isinstance(col, list):
@@ -940,6 +1036,37 @@ class TensorFrame:
                 out[lname[c]] = gather(lcols[c], li)
             for c in right_only:
                 out[rname[c]] = gather_right(rcols[c], c)
+            if how == "outer":
+                # append the right rows NO left row matched (pandas
+                # sort=False outer: they follow the left-ordered part,
+                # in right order), left columns filled
+                matched_r = np.zeros(nr, bool)
+                matched_r[ri[ri >= 0]] = True
+                extra = np.flatnonzero(~matched_r)
+                if len(extra):
+                    def cat(a, b):
+                        if isinstance(a, list) or isinstance(b, list):
+                            return list(a) + list(b)
+                        return np.concatenate([a, b])
+
+                    for k in keys:
+                        out[k] = cat(out[k], gather(rcols[k], extra))
+                    ne = len(extra)
+                    for c in left_only:
+                        v = lcols[c]
+                        if isinstance(v, list):
+                            fills = [fill_for(c)] * ne
+                        else:
+                            fills = np.full(
+                                (ne,) + v.shape[1:],
+                                checked_fill(c, v.dtype),
+                                v.dtype,
+                            )
+                        out[lname[c]] = cat(out[lname[c]], fills)
+                    for c in right_only:
+                        out[rname[c]] = cat(
+                            out[rname[c]], gather(rcols[c], extra)
+                        )
             return out
 
         def compute() -> List[Block]:
@@ -970,6 +1097,9 @@ class TensorFrame:
                     _allgather_dicts, gather_local_columns, uniform_ok,
                 )
 
+                from .config import get_config
+                from .ops import exchange as xch
+
                 lcols = gather_local_columns(left, left.schema.names)
                 r_names = list(right.schema.names)
                 r_local = gather_local_columns(right, r_names)
@@ -981,9 +1111,56 @@ class TensorFrame:
                         "of a column — re-shard so every process holds "
                         "rows of both sides (frame_from_process_local)"
                     )
-                union, _ = _allgather_dicts([r_local[n] for n in r_names])
-                rcols = dict(zip(r_names, union))
-                out = join_cols(lcols, rcols)
+                cfg = get_config()
+                # allgathered estimate: identical on every process, so
+                # the broadcast-vs-exchange branch is uniform. OUTER
+                # joins always exchange: under a broadcast plan every
+                # process would re-emit right rows its local left
+                # happens not to match, duplicating them fleet-wide.
+                r_bytes = xch.global_frame_bytes(r_local)
+                if (
+                    r_bytes > cfg.relational_broadcast_bytes
+                    or how == "outer"
+                ):
+                    if not cfg.relational_exchange:
+                        raise RuntimeError(
+                            f"join: broadcasting the {r_bytes:,}-byte "
+                            "right side to every process "
+                            + (
+                                "cannot implement an outer join "
+                                "(unmatched right rows would duplicate "
+                                "per process)"
+                                if how == "outer"
+                                else "exceeds config."
+                                "relational_broadcast_bytes "
+                                f"({cfg.relational_broadcast_bytes:,})"
+                            )
+                            + " and the exchange path is disabled "
+                            "(config.relational_exchange=False / "
+                            "TFTPU_RELATIONAL_EXCHANGE=0) — raise the "
+                            "budget, re-enable the exchange, or put "
+                            "the smaller frame on the right"
+                        )
+                    # SHUFFLE JOIN: both sides hash-partition on the
+                    # key columns (content hashes — identical on every
+                    # process for identical values) and each process
+                    # joins one partition
+                    procs = jax.process_count()
+                    lpart = xch.partition_by_hash(
+                        [lcols[k] for k in keys], procs
+                    )
+                    rpart = xch.partition_by_hash(
+                        [r_local[k] for k in keys], procs
+                    )
+                    lrecv = xch.exchange_rows(lcols, lpart)
+                    rrecv = xch.exchange_rows(r_local, rpart)
+                    out = join_cols(lrecv, rrecv)
+                else:
+                    union, _ = _allgather_dicts(
+                        [r_local[n] for n in r_names]
+                    )
+                    rcols = dict(zip(r_names, union))
+                    out = join_cols(lcols, rcols)
                 for name in list(out):
                     v = out[name]
                     if isinstance(v, np.ndarray) and v.dtype == object:

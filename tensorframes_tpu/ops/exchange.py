@@ -1,0 +1,367 @@
+"""Hash/range-partitioned exchange between processes — the TPU-native
+equivalent of Catalyst's shuffle exchange (``DebugRowOps.scala:583``:
+Spark hash-partitions both sides of a join/sort across executors so no
+executor holds the global frame).
+
+Round-4 verdict item #2: the multi-process relational verbs replicated
+their inputs (allgather sort, broadcast join) — correct, but O(global)
+memory per process. This module gives them a real shuffle:
+
+* :func:`partition_by_hash` — content-stable row hashes (identical on
+  every process for the same values, unlike ``ops.keys.group_ids``
+  codes, which depend on local data order) → ``hash % P``.
+* :func:`partition_by_range` — sampled splitters (identical on every
+  process: the sample is allgathered, tiny) → partition p holds the
+  p-th key range, so concatenating per-process results in process
+  order IS the global sort order.
+* :func:`exchange_rows` — the data plane: per-destination pickled
+  payloads ride ONE ``lax.all_to_all`` over a one-device-per-process
+  mesh axis (XLA collectives over ICI/DCN — Gloo on the multi-process
+  CPU backend), so each process receives only its partition.
+
+Memory per process: O(global/P) for balanced keys (max payload over
+(src, dst) pairs × P), vs O(global) for the replicating plans. The
+replicating plans remain the small-frame fast path behind
+``config.relational_broadcast_bytes``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# splitmix64 constants — a well-mixed 64-bit finalizer (public domain
+# constant set; avalanches every input bit across the output)
+_SM_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SM_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM_M2 = np.uint64(0x94D049BB133111EB)
+
+# observability for tests and debugging: per-call accounting of the
+# last exchange on THIS process
+# ({"sent": [P], "received": [P], "rounds": n, "chunk": bytes})
+last_exchange_stats: Optional[Dict[str, object]] = None
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over a uint64 array (vectorized)."""
+    x = x + _SM_GAMMA
+    x = (x ^ (x >> np.uint64(30))) * _SM_M1
+    x = (x ^ (x >> np.uint64(27))) * _SM_M2
+    return x ^ (x >> np.uint64(31))
+
+
+def _cell_bytes(v) -> bytes:
+    if isinstance(v, str):
+        return v.encode("utf-8", "surrogatepass")
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, np.ndarray):
+        return v.tobytes()
+    # None and anything else with a stable repr; repr is deterministic
+    # across processes for the primitive cell types host columns hold
+    # (PYTHONHASHSEED salts hash(), so hash() is NOT usable)
+    return repr(v).encode("utf-8")
+
+
+def _f64_bits(f: np.ndarray) -> np.ndarray:
+    """Canonical float64 bit patterns: one NaN, -0.0 == +0.0."""
+    f = f.astype(np.float64, copy=True)
+    f[np.isnan(f)] = np.nan
+    f[f == 0.0] = 0.0
+    return f.view(np.uint64)
+
+
+_NUMERIC_CELL = (bool, int, float, np.integer, np.floating, np.bool_)
+
+
+def content_hash64(arrs: Sequence) -> np.ndarray:
+    """Per-row uint64 hashes that are IDENTICAL on every process for
+    identical key values — the property partition assignment needs and
+    dictionary codes don't have.
+
+    EVERY numeric value (bool/int/uint/float, array or object cell)
+    hashes through its canonical float64 bit pattern: the join's
+    broadcast path compares key unions after numpy promotion
+    (``np.concatenate([int_col, float_col])`` → f64), so 5 must hash
+    like 5.0 or a size-triggered switch to the hash exchange would
+    silently drop cross-dtype matches. Distinct huge ints that collide
+    in f64 merely COLOCATE (a harmless partition collision — they
+    compare equal in the promoted join too). String/bytes/other cells
+    hash their bytes (crc32 + length, mixed to 64 bits)."""
+    np_err = np.seterr(over="ignore")  # uint64 mixing wraps by design
+    try:
+        combined = None
+        for a in arrs:
+            if isinstance(a, list):
+                a = np.asarray(a, dtype=object)
+            a = np.asarray(a)
+            if a.dtype == object or a.dtype.kind in ("U", "S"):
+                cells = a.tolist()
+                h = np.empty(len(cells), np.uint64)
+                for i, v in enumerate(cells):
+                    if isinstance(v, _NUMERIC_CELL):
+                        h[i] = _f64_bits(np.asarray([v]))[0]
+                    else:
+                        b = _cell_bytes(v)
+                        h[i] = np.uint64(
+                            zlib.crc32(b) ^ (len(b) << 32)
+                        )
+            else:  # every numeric family → canonical f64 bits
+                h = _f64_bits(a)
+            h = _mix64(h)
+            combined = h if combined is None else _mix64(combined ^ h)
+        return combined
+    finally:
+        np.seterr(**np_err)
+
+
+def partition_by_hash(key_cols: Sequence, num_parts: int) -> np.ndarray:
+    """Destination partition per local row: ``content_hash64 % P``."""
+    return (content_hash64(key_cols) % np.uint64(num_parts)).astype(np.int64)
+
+
+def _lex_geq(row_cols, split_tuple, asc) -> np.ndarray:
+    """Vectorized ``row >= splitter`` under lexicographic multi-key
+    order with per-key ascending flags. ``row_cols`` holds per-key
+    int64 code arrays, ``split_tuple`` the splitter's codes. Rows fully
+    equal to the splitter compare >= (ties land in the higher
+    partition, matching the splitter-count assignment)."""
+    n = len(row_cols[0])
+    geq = np.ones(n, bool)  # fully-equal default
+    decided = np.zeros(n, bool)
+    for col, sv, a in zip(row_cols, split_tuple, asc):
+        gt = (col > sv) if a else (col < sv)
+        lt = (col < sv) if a else (col > sv)
+        geq = np.where(~decided & gt, True, geq)
+        geq = np.where(~decided & lt, False, geq)
+        decided = decided | gt | lt
+    return geq
+
+
+def partition_by_range(
+    key_cols: Sequence,
+    num_parts: int,
+    ascending: Sequence[bool],
+    sample_per_process: int = 2048,
+) -> np.ndarray:
+    """Range partitioning for the distributed sort: every process
+    allgathers a small deterministic SAMPLE of its key rows, computes
+    identical splitters from the union, and assigns each local row to
+    ``#{splitters lexicographically <= row}``. Concatenating partitions
+    0..P-1 in order then yields the global sort order (each partition is
+    sorted locally afterwards). The sample is the only replicated data —
+    O(P * sample) rows, independent of frame size."""
+    from .device_agg import _allgather_dicts
+    from .keys import _unique_inverse
+
+    local = [
+        np.asarray(a, dtype=object) if isinstance(a, list) else np.asarray(a)
+        for a in key_cols
+    ]
+    n = len(local[0])
+    # deterministic evenly-spaced sample (no RNG: every process must be
+    # reproducible, and order bias is broken by the global union)
+    take = min(n, sample_per_process)
+    idx = (
+        np.linspace(0, n - 1, take).astype(np.int64)
+        if take
+        else np.zeros(0, np.int64)
+    )
+    sample = [a[idx] for a in local]
+    union, _ = _allgather_dicts(sample)
+
+    # codes must be computed over sample∪local TOGETHER: _unique_inverse
+    # codes are only comparable within one encode pass. The comparison
+    # RESULTS are value-determined, hence identical across processes
+    # even though the codes differ.
+    m = len(union[0])
+    codes = []
+    for u_col, l_col in zip(union, local):
+        if u_col.dtype == object or l_col.dtype == object:
+            both = np.empty(m + n, dtype=object)
+            both[:m] = list(u_col)
+            both[m:] = list(l_col)
+        else:
+            both = np.concatenate([u_col, l_col])
+        codes.append(_unique_inverse(both)[1].astype(np.int64))
+    samp_codes = [c[:m] for c in codes]
+    row_codes = [c[m:] for c in codes]
+
+    # identical splitters everywhere: lexsort the union sample (which is
+    # identical on every process) and read P-1 quantile rows
+    order = np.lexsort(
+        [
+            c if a else -c
+            for c, a in zip(reversed(samp_codes), reversed(ascending))
+        ]
+    )
+    if m == 0 or num_parts == 1:
+        return np.zeros(n, np.int64)
+    q = [
+        order[min(m - 1, (m * (i + 1)) // num_parts)]
+        for i in range(num_parts - 1)
+    ]
+    part = np.zeros(n, np.int64)
+    for s_idx in q:
+        split = tuple(c[s_idx] for c in samp_codes)
+        part += _lex_geq(row_codes, split, ascending).astype(np.int64)
+    return part
+
+
+def _one_device_per_process():
+    import jax
+
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, d)
+    return [by_proc[p] for p in sorted(by_proc)]
+
+
+# per-round budget for the padded all_to_all buffers (send and receive
+# shards are each [P, round_width] — bounded by this regardless of skew)
+_EXCHANGE_ROUND_BYTES = 64 << 20
+
+
+def _exchange_bytes(parts: List[bytes]) -> List[bytes]:
+    """All-to-all of arbitrary byte payloads between processes: entry
+    ``parts[dst]`` is sent from this process to ``dst``; returns
+    ``recv[src]`` = the payload ``src`` addressed to this process.
+
+    One size allgather (tiny) + CHUNKED padded uint8 ``lax.all_to_all``
+    rounds: padding every slot to the global max payload would cost
+    P × max bytes per process — O(global) again under a hot-key skew,
+    the exact blow-up the exchange exists to avoid. Chunking bounds the
+    in-flight buffers to ``_EXCHANGE_ROUND_BYTES`` per direction per
+    round; only the hot partition's OWNER accumulates its (genuinely
+    large) partition, which no partitioning scheme can avoid."""
+    global last_exchange_stats
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import multihost_utils as mh
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    procs = jax.process_count()
+    me = jax.process_index()
+    assert len(parts) == procs
+    sizes = np.asarray([len(b) for b in parts], np.int64)
+    all_sizes = np.asarray(mh.process_allgather(sizes)).reshape(procs, procs)
+    max_size = int(all_sizes.max())
+    chunk = max(1 << 16, _EXCHANGE_ROUND_BYTES // max(procs, 1))
+    # identical on every process (derived from the allgathered sizes),
+    # so the round count cannot diverge across the fleet
+    rounds = max(1, -(-max_size // chunk))
+
+    mesh = Mesh(np.asarray(_one_device_per_process()), ("px",))
+    from ..parallel._shard_map import shard_map
+
+    swap = jax.jit(
+        shard_map(
+            lambda s: lax.all_to_all(
+                s, "px", split_axis=1, concat_axis=0, tiled=True
+            ),
+            mesh=mesh,
+            in_specs=P("px", None, None),
+            out_specs=P(None, "px", None),
+        )
+    )
+    recv = [bytearray() for _ in range(procs)]
+    for r in range(rounds):
+        lo = r * chunk
+        local = np.zeros((1, procs, chunk), np.uint8)
+        for dst, b in enumerate(parts):
+            seg = b[lo: lo + chunk]
+            if seg:
+                local[0, dst, : len(seg)] = np.frombuffer(seg, np.uint8)
+        arr = jax.make_array_from_callback(
+            (procs, procs, chunk),
+            NamedSharding(mesh, P("px")),
+            lambda _idx: jnp.asarray(local),
+        )
+        out = swap(arr)
+        [shard] = [s for s in out.addressable_shards]
+        got = np.asarray(shard.data)[:, 0, :]  # [P(src), chunk]
+        for src in range(procs):
+            take = min(chunk, int(all_sizes[src, me]) - lo)
+            if take > 0:
+                recv[src] += got[src, :take].tobytes()
+    last_exchange_stats = {
+        "sent": [int(s) for s in sizes],
+        "received": [int(all_sizes[src, me]) for src in range(procs)],
+        "rounds": rounds,
+        "chunk": chunk,
+    }
+    return [bytes(b) for b in recv]
+
+
+def exchange_rows(
+    cols: Dict[str, object], part: np.ndarray
+) -> Dict[str, object]:
+    """Shuffle this process's rows to their partition owners and return
+    the rows every process sent HERE (source-process order, then local
+    row order — deterministic). ``cols`` maps names to process-local
+    numpy arrays or cell lists; ``part`` holds each row's destination
+    process. Everything serializes through pickle so string/object and
+    multi-dim columns exchange the same way."""
+    import jax
+
+    procs = jax.process_count()
+    names = list(cols)
+    as_arr = {
+        n: (
+            np.asarray(v, dtype=object)
+            if isinstance(v, list)
+            else np.asarray(v)
+        )
+        for n, v in cols.items()
+    }
+    payloads = []
+    for dst in range(procs):
+        sel = np.flatnonzero(part == dst)
+        sub = [as_arr[n][sel] for n in names]
+        payloads.append(
+            pickle.dumps(sub, protocol=pickle.HIGHEST_PROTOCOL)
+        )
+    received = _exchange_bytes(payloads)
+    chunks = [pickle.loads(b) for b in received]
+    out: Dict[str, object] = {}
+    for i, n in enumerate(names):
+        pieces = [c[i] for c in chunks]
+        if as_arr[n].dtype == object:
+            merged: List[object] = []
+            for p in pieces:
+                merged.extend(list(p))
+            out[n] = merged
+        else:
+            out[n] = np.concatenate(pieces) if pieces else as_arr[n][:0]
+    return out
+
+
+def global_frame_bytes(local_cols: Dict[str, object]) -> int:
+    """Total bytes of the GLOBAL frame (sum over processes of this
+    process-local estimate) — the quantity the broadcast-vs-exchange
+    budget gates on. One tiny allgather."""
+    import jax
+    from jax.experimental import multihost_utils as mh
+
+    local = 0
+    for v in local_cols.values():
+        if isinstance(v, np.ndarray) and v.dtype != object:
+            local += int(v.nbytes)
+        else:
+            cells = v if isinstance(v, list) else list(v)
+            for c in cells:
+                local += (
+                    int(np.asarray(c).nbytes)
+                    if isinstance(c, np.ndarray)
+                    else len(_cell_bytes(c))
+                )
+    if jax.process_count() == 1:
+        return local
+    totals = np.asarray(
+        mh.process_allgather(np.asarray([local], np.int64))
+    )
+    return int(totals.sum())

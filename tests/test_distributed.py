@@ -155,6 +155,90 @@ for p in range(NPROC):
     global_rows.append((p + 1, 10.0 * p + 2.0))
 swant2 = sorted(global_rows, key=lambda t: t[0])
 assert sgot2 == swant2, (sgot2, swant2)
+# EXCHANGE paths (VERDICT r4 #2): force the broadcast budget tiny so
+# sort_values takes the RANGE exchange and join the HASH exchange —
+# no process may hold the global frame. Asserted: correctness (global
+# order / join values), the O(global/P) memory bound (per-process row
+# share), and the disabled-exchange guard.
+from jax.experimental import multihost_utils as mhx
+from tensorframes_tpu.config import configure
+from tensorframes_tpu.ops import exchange as xch
+
+configure(relational_broadcast_bytes=64)
+NLOC = 400
+rngx = np.random.default_rng(1000 + pid)
+xk = rngx.integers(0, 1000, NLOC).astype(np.int64)
+xv = (xk * 2).astype(np.float64)
+xf = frame_from_process_local({{"k": xk, "v": xv}}, mesh=mesh, axis="dp")
+part_rows = xf.sort_values("k").collect()  # this process's key RANGE
+pk = np.asarray([r["k"] for r in part_rows], np.int64)
+pv = np.asarray([r["v"] for r in part_rows])
+assert (np.diff(pk) >= 0).all()  # locally sorted
+np.testing.assert_array_equal(pv, pk * 2.0)  # rows kept intact
+lens = np.asarray(
+    mhx.process_allgather(np.asarray([len(pk)], np.int64))
+).reshape(-1)
+assert int(lens.sum()) == NPROC * NLOC  # nothing lost or duplicated
+# memory bound: no process holds the global frame (a replicating plan
+# would put all NPROC*NLOC rows here); 2x over the balanced share is
+# the skew allowance for random keys
+assert int(lens.max()) <= max(2 * NLOC, 64), lens
+# partitions form disjoint ordered ranges: concatenating processes in
+# order IS the global sort (pad-allgather the variable-length parts)
+W = int(lens.max())
+buf = np.full(W, -1, np.int64)
+buf[: len(pk)] = pk
+allb = np.asarray(mhx.process_allgather(buf)).reshape(NPROC, W)
+cat = np.concatenate(
+    [allb[p, : int(lens[p])] for p in range(NPROC)]
+)
+gk = np.asarray(mhx.process_allgather(xk)).reshape(-1)
+np.testing.assert_array_equal(cat, np.sort(gk, kind="stable"))
+# SHUFFLE JOIN: right side over budget → hash-partition both sides
+rk = np.arange(pid, 1000, NPROC).astype(np.int64)
+rframe = frame_from_process_local(
+    {{"k": rk, "w": (rk * 10).astype(np.float64)}}, mesh=mesh, axis="dp"
+)
+jrows = xf.join(rframe, on="k").collect()
+for r in jrows:
+    assert float(r["w"]) == int(r["k"]) * 10.0
+    assert float(r["v"]) == int(r["k"]) * 2.0
+jlen = np.asarray(
+    mhx.process_allgather(np.asarray([len(jrows)], np.int64))
+).reshape(-1)
+# right side covers every key 0..999 exactly once → one output row per
+# left row, spread across processes by key hash
+assert int(jlen.sum()) == NPROC * NLOC, jlen
+assert int(jlen.max()) <= max(2 * NLOC, 64), jlen
+# OUTER join across processes rides the exchange (broadcast would
+# duplicate unmatched right rows on every process): global row count =
+# matched left rows + each unmatched right key exactly ONCE
+orows = xf.join(
+    rframe, on="k", how="outer",
+    fill_value={{"v": -1.0, "w": -1.0}},
+).collect()
+olen = np.asarray(
+    mhx.process_allgather(np.asarray([len(orows)], np.int64))
+).reshape(-1)
+n_distinct = len(np.unique(gk))
+assert int(olen.sum()) == NPROC * NLOC + (1000 - n_distinct), (
+    int(olen.sum()), NPROC * NLOC, n_distinct
+)
+for r in orows:  # every left row matches, so only v carries fills
+    assert float(r["w"]) == int(r["k"]) * 10.0
+# guard: with the exchange disabled, over-budget plans raise the
+# actionable error on EVERY process instead of replicating
+configure(relational_exchange=False)
+for plan in (
+    lambda: xf.sort_values("k").collect(),
+    lambda: xf.join(rframe, on="k").collect(),
+):
+    try:
+        plan()
+        raise SystemExit("exchange guard did not fire")
+    except RuntimeError as e:
+        assert "relational_broadcast_bytes" in str(e), e
+configure(relational_exchange=True, relational_broadcast_bytes=64 << 20)
 # sharded persistence: each process writes its part, reloads, and the
 # reassembled global frame reduces to the same total across hosts
 sf_dir = {sf_dir!r}

@@ -466,10 +466,12 @@ def test_join_int_keys_and_empty_result():
     # zero-row sides must give an empty join, not a group_ids crash
     empty = lf.filter(lambda id: {"keep": id > 99})
     assert lf.join(empty.select(["id"]), on="id").collect() == []
-    with pytest.raises(NotImplementedError, match="outer"):
-        lf.join(rf, on="id", how="outer")
+    with pytest.raises(ValueError, match="fill_value"):
+        lf.join(rf, on="id", how="outer")  # outer requires explicit fills
     with pytest.raises(ValueError, match="fill_value"):
         lf.join(rf, on="id", how="left")  # left requires explicit fills
+    with pytest.raises(ValueError, match="cross"):
+        lf.join(rf, on="id", how="cross")
 
 
 def test_join_left_with_fill_matches_pandas():
@@ -523,6 +525,104 @@ def test_join_left_with_fill_matches_pandas():
     assert np.asarray(je[0]["e"]).shape == (2,)
     got_rows = {r["k"]: np.asarray(r["e"]).tolist() for r in je}
     assert got_rows[1] == [1.0, 2.0] and got_rows[0] == [0.0, 0.0]
+
+
+def test_join_outer_matches_pandas():
+    """VERDICT r4 #8: outer join golden-matched against pandas.merge
+    (sort=False ordering: left-ordered part first, unmatched right rows
+    after, in right order), with explicit per-side fills."""
+    import pandas as pd
+
+    left_rows = [
+        {"k": "a", "v": 1.0, "tag": "l0"},
+        {"k": "b", "v": 2.0, "tag": "l1"},
+        {"k": "a", "v": 3.0, "tag": "l2"},
+        {"k": "x", "v": 4.0, "tag": "l3"},
+    ]
+    right_rows = [
+        {"k": "a", "w": 10.0, "tag": "r0"},
+        {"k": "d", "w": 40.0, "tag": "r1"},
+        {"k": "a", "w": 20.0, "tag": "r2"},
+        {"k": "e", "w": 50.0, "tag": "r3"},
+    ]
+    lf = tfs.frame_from_rows(left_rows, num_blocks=2)
+    rf = tfs.frame_from_rows(right_rows, num_blocks=2)
+    fills = {"v": -1.0, "w": -2.0, "tag": "<none>"}
+    got = lf.join(rf, on="k", how="outer", fill_value=fills).collect()
+
+    want = pd.merge(
+        pd.DataFrame(left_rows), pd.DataFrame(right_rows),
+        on="k", how="outer", sort=False,
+    )
+    want["v"] = want["v"].fillna(-1.0)
+    want["w"] = want["w"].fillna(-2.0)
+    want[["tag_x", "tag_y"]] = want[["tag_x", "tag_y"]].fillna("<none>")
+    assert len(got) == len(want) == 8
+    # pandas' outer row order is version-dependent (3.x key-sorts even
+    # under sort=False) — golden-match the CONTENT as a multiset, then
+    # pin OUR documented order below
+    def as_set(rows):
+        return sorted(
+            (r["k"], r["v"], r["w"], r["tag_x"], r["tag_y"]) for r in rows
+        )
+
+    assert as_set(got) == as_set(want.to_dict("records"))
+    # our order: left-ordered matched/unmatched-left part first, then
+    # unmatched right rows in right order
+    assert [r["k"] for r in got] == [
+        "a", "a", "b", "a", "a", "x", "d", "e"
+    ]
+    # fill dict must cover BOTH sides for outer
+    with pytest.raises(ValueError, match="no entry"):
+        lf.join(rf, on="k", how="outer", fill_value={"w": 0.0, "tag": ""})
+    # empty left side: outer keeps every right row, left columns filled
+    empty_l = lf.filter(lambda v: {"keep": v > 99.0})
+    eo = empty_l.join(rf, on="k", how="outer", fill_value=fills).collect()
+    assert [r["k"] for r in eo] == ["a", "d", "a", "e"]
+    assert all(r["v"] == -1.0 and r["tag_x"] == "<none>" for r in eo)
+
+
+def test_join_right_matches_pandas():
+    """VERDICT r4 #8: right join = mirrored left join, canonical column
+    order restored, pandas-like right-row ordering."""
+    import pandas as pd
+
+    left_rows = [
+        {"k": 1, "v": 1.0, "tag": "l0"},
+        {"k": 2, "v": 2.0, "tag": "l1"},
+        {"k": 1, "v": 3.0, "tag": "l2"},
+    ]
+    right_rows = [
+        {"k": 2, "w": 20.0, "tag": "r0"},
+        {"k": 9, "w": 90.0, "tag": "r1"},
+        {"k": 1, "w": 10.0, "tag": "r2"},
+    ]
+    lf = tfs.frame_from_rows(left_rows)
+    rf = tfs.frame_from_rows(right_rows)
+    got = lf.join(
+        rf, on="k", how="right",
+        fill_value={"v": -1.0, "tag": "<none>"},
+    ).collect()
+    want = pd.merge(
+        pd.DataFrame(left_rows), pd.DataFrame(right_rows),
+        on="k", how="right", sort=False,
+    )
+    want["v"] = want["v"].fillna(-1.0)
+    want["tag_x"] = want["tag_x"].fillna("<none>")
+    assert len(got) == len(want) == 4
+    for g, (_, w) in zip(got, want.iterrows()):
+        assert (
+            g["k"] == w["k"]
+            and g["v"] == w["v"]
+            and g["w"] == w["w"]
+            and g["tag_x"] == w["tag_x"]
+            and g["tag_y"] == w["tag_y"]
+        ), (g, dict(w))
+    # column order is canonical: keys, left columns, right columns
+    assert list(got[0].keys()) == ["k", "v", "tag_x", "w", "tag_y"]
+    # right join requires fills for the LEFT columns
+    with pytest.raises(ValueError, match="fill_value"):
+        lf.join(rf, on="k", how="right")
 
 
 def test_sort_values_device_path_matches_host_and_stays_on_device():
