@@ -68,10 +68,12 @@ run_step "checkout (clean clone of HEAD)" \
 run_step "setup-python (image interpreter; full 3.11 leg unavailable here)" \
   python -c "import sys; assert sys.version_info >= (3, 11); print(sys.version)"
 
-run_step "py311 static gate (the 3.11-leg stand-in that CAN run here)" \
-  bash "$REPO/dev/py311_check.sh"
-
 cd "$CLONE"
+
+# the CLONE's copy, like every other step: real CI checks out the
+# commit, so an uncommitted working-tree file must not affect the gate
+run_step "py311 static gate (the 3.11-leg stand-in that CAN run here)" \
+  bash "$CLONE/dev/py311_check.sh"
 
 run_step "Install (clean-clone package, --no-deps: zero-egress image carries deps)" \
   python -m pip install . --no-deps --no-build-isolation --quiet --target "$SITE"

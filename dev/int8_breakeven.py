@@ -18,6 +18,15 @@ variants and reads XLA's cost model (`compiled.cost_analysis()`s
 the ones that matter and get appended to the pre-registered table in
 BASELINE.md when a healthy window runs this.
 
+CAVEAT on the cost-model column: XLA charges every
+dynamic_update_slice as a full-array write at cost-analysis time —
+in-place aliasing happens later, at buffer assignment — so the cache
+updates over-count by roughly (num_layers × cache bytes) per step.
+The ANALYTIC ratio is the defensible HBM-roofline bound; the
+cost-model ratio brackets it from above. (Round-5 change: collapsing
+the per-layer slice-out/.at[li].set chains to single 5-D DUS ops cut
+the charged int8 bytes 7.0 GB → 2.7 GB for gpt_small.)
+
 Usage: [JAX_PLATFORMS=cpu] python dev/int8_breakeven.py
 """
 

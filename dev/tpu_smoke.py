@@ -185,10 +185,16 @@ def main() -> int:
     fprog = tfs.compile_program(lambda v: {"s": v.sum()}, ff2, block=False)
 
     def timed(fn):
-        fn()  # warm: compiles cached out of the measurement
-        t1 = time.time()
+        """Median of 3 (after a compile-absorbing warm call): a single
+        sample would let one scheduler/relay latency spike flip the
+        smoke's exit code on a healthy chip."""
         fn()
-        return time.time() - t1
+        samples = []
+        for _ in range(3):
+            t1 = time.time()
+            fn()
+            samples.append(time.time() - t1)
+        return sorted(samples)[1]
 
     rt = timed(lambda: np.asarray(tfs.map_rows(rprog, rf2).column_values("s")))
     ft = timed(lambda: np.asarray(tfs.map_rows(fprog, ff2).column_values("s")))

@@ -852,7 +852,7 @@ class TensorFrame:
             if isinstance(fill_value, dict):
                 if col_name not in fill_value:
                     raise ValueError(
-                        f"how='left': fill_value has no entry for right "
+                        f"how={how!r}: fill_value has no entry for "
                         f"column {col_name!r}"
                     )
                 return fill_value[col_name]
@@ -870,7 +870,7 @@ class TensorFrame:
                 # 'cannot convert float NaN to integer' before the
                 # representability check below can phrase it usefully
                 raise ValueError(
-                    f"how='left': fill_value {fv!r} is not exactly "
+                    f"how={how!r}: fill_value {fv!r} is not exactly "
                     f"representable in column {col_name!r}'s dtype "
                     f"{np_dtype}"
                 ) from None
@@ -879,7 +879,7 @@ class TensorFrame:
             ) or cast == np.asarray(fv)
             if not bool(same):
                 raise ValueError(
-                    f"how='left': fill_value {fv!r} is not exactly "
+                    f"how={how!r}: fill_value {fv!r} is not exactly "
                     f"representable in column {col_name!r}'s dtype "
                     f"{np_dtype}"
                 )

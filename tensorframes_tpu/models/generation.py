@@ -148,18 +148,24 @@ def _forward_cached(
             k, k_s = _quantize_slots(k)
             v, v_s = _quantize_slots(v)
             for key, chunk in (("k_scale", k_s), ("v_scale", v_s)):
-                cs = lax.dynamic_update_slice(
-                    new_cache[key][li], chunk, (0, 0, offset, 0)
+                new_cache[key] = lax.dynamic_update_slice(
+                    new_cache[key], chunk[None], (li, 0, 0, offset, 0)
                 )
-                new_cache[key] = new_cache[key].at[li].set(cs)
-        ck = lax.dynamic_update_slice(
-            new_cache["k"][li], k, (0, 0, offset, 0)
+        # ONE 5-D dynamic_update_slice per tensor (li is a static index
+        # here, the loop is python): the previous slice-out → update →
+        # .at[li].set chain rematerialized the whole [L,b,nh,S,hd]
+        # cache per layer when XLA failed to prove in-place — the CPU
+        # cost model charged ~24x the analytic step traffic for
+        # gpt_small (dev/int8_breakeven.py); a single DUS on the full
+        # array aliases reliably
+        new_cache["k"] = lax.dynamic_update_slice(
+            new_cache["k"], k[None], (li, 0, 0, offset, 0)
         )
-        cv = lax.dynamic_update_slice(
-            new_cache["v"][li], v, (0, 0, offset, 0)
+        new_cache["v"] = lax.dynamic_update_slice(
+            new_cache["v"], v[None], (li, 0, 0, offset, 0)
         )
-        new_cache["k"] = new_cache["k"].at[li].set(ck)
-        new_cache["v"] = new_cache["v"].at[li].set(cv)
+        ck = new_cache["k"][li]  # li is static: a plain slice, no scatter
+        cv = new_cache["v"][li]
         if quant:
             # int8 k/v stream from HBM and convert on-chip; each scale
             # is per cache SLOT (constant along the contracted head_dim

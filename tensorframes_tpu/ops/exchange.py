@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import pickle
 import zlib
+from functools import lru_cache
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -220,6 +221,33 @@ def _one_device_per_process():
     return [by_proc[p] for p in sorted(by_proc)]
 
 
+@lru_cache(maxsize=4)
+def _swap_fn(procs: int):
+    """The exchange's (mesh, jitted all_to_all) pair, built once per
+    process count: rebuilding the jit wrapper per call would miss jax's
+    jit cache and recompile the collective on every exchange (a single
+    over-budget join exchanges twice). Shapes vary per call (chunk), so
+    the jit still specializes per chunk width under ONE stable wrapper."""
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..parallel._shard_map import shard_map
+
+    mesh = Mesh(np.asarray(_one_device_per_process()), ("px",))
+    swap = jax.jit(
+        shard_map(
+            lambda s: lax.all_to_all(
+                s, "px", split_axis=1, concat_axis=0, tiled=True
+            ),
+            mesh=mesh,
+            in_specs=P("px", None, None),
+            out_specs=P(None, "px", None),
+        )
+    )
+    return mesh, swap
+
+
 # per-round budget for the padded all_to_all buffers (send and receive
 # shards are each [P, round_width] — bounded by this regardless of skew)
 _EXCHANGE_ROUND_BYTES = 64 << 20
@@ -250,24 +278,15 @@ def _exchange_bytes(parts: List[bytes]) -> List[bytes]:
     sizes = np.asarray([len(b) for b in parts], np.int64)
     all_sizes = np.asarray(mh.process_allgather(sizes)).reshape(procs, procs)
     max_size = int(all_sizes.max())
+    # cap at the actual max payload: a small exchange must not pad every
+    # slot to the full round budget (P x budget of wire traffic for KB
+    # of data); identical on every process (allgathered sizes), so the
+    # chunk and round count cannot diverge across the fleet
     chunk = max(1 << 16, _EXCHANGE_ROUND_BYTES // max(procs, 1))
-    # identical on every process (derived from the allgathered sizes),
-    # so the round count cannot diverge across the fleet
+    chunk = min(chunk, max(1, max_size))
     rounds = max(1, -(-max_size // chunk))
 
-    mesh = Mesh(np.asarray(_one_device_per_process()), ("px",))
-    from ..parallel._shard_map import shard_map
-
-    swap = jax.jit(
-        shard_map(
-            lambda s: lax.all_to_all(
-                s, "px", split_axis=1, concat_axis=0, tiled=True
-            ),
-            mesh=mesh,
-            in_specs=P("px", None, None),
-            out_specs=P(None, "px", None),
-        )
-    )
+    mesh, swap = _swap_fn(procs)
     recv = [bytearray() for _ in range(procs)]
     for r in range(rounds):
         lo = r * chunk

@@ -235,3 +235,39 @@ def test_int8_full_span_keys_no_wrap():
     key_cols, out_cols = got
     assert list(key_cols["k"]) == [-128, 127]
     assert list(out_cols["v"]) == [800.0, 800.0]
+
+
+def test_repeated_aggregates_hit_memos_and_stay_correct():
+    """Round 5: repeated aggregates over the same immutable device
+    columns memoize the dense plan's span probe and the dictionary
+    plan's encode+staged ids (each a relay round trip per call on
+    tunnel-attached chips). Results must be IDENTICAL across calls and
+    the memos must actually populate."""
+    rng = np.random.default_rng(11)
+    # dense plan (int keys): minmax memo
+    di = tfs.frame_from_arrays(
+        {"k": rng.integers(0, 32, 4096),
+         "v": rng.standard_normal(4096).astype(np.float32)}
+    ).to_device()
+    first = {r["k"]: r["v"] for r in _dsl_agg(di, "v", tfs.reduce_sum).collect()}
+    assert any(id(b["k"]) in device_agg._minmax_memo for b in di.blocks())
+    for _ in range(3):
+        again = {
+            r["k"]: r["v"] for r in _dsl_agg(di, "v", tfs.reduce_sum).collect()
+        }
+        assert again == first
+    # dictionary plan (huge-span keys): encode memo
+    dk = tfs.frame_from_arrays(
+        {"k": rng.integers(0, 2**40, 4096),
+         "v": rng.standard_normal(4096).astype(np.float32)}
+    ).to_device()
+    want = {r["k"]: r["v"] for r in _dsl_agg(dk, "v", tfs.reduce_sum).collect()}
+    assert any(
+        id(b["k"]) in {i for key in device_agg._dict_encode_memo for i in key}
+        for b in dk.blocks()
+    )
+    for _ in range(3):
+        got = {
+            r["k"]: r["v"] for r in _dsl_agg(dk, "v", tfs.reduce_sum).collect()
+        }
+        assert got == want
