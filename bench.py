@@ -1073,6 +1073,16 @@ def main():
         ),
     )
 
+    if gen_tps and gen_tps_q:
+        # the pre-registered int8 adjudication (BASELINE.md r5): >1x on
+        # an HBM-bound device backend or the default flips back to f32
+        print(
+            f"# int8 | decode gpt_{'small' if on_tpu else 'tiny'} "
+            f"f32={gen_tps:.0f} int8kv={gen_tps_q:.0f} tok/s "
+            f"ratio={gen_tps_q / gen_tps:.2f}x "
+            "(pre-registered: 1.5-2.1x HBM-bound device; <1x on CPU by design)"
+        )
+
     from tensorframes_tpu import native
 
     convert_s, convertback_s = _try(

@@ -1180,6 +1180,74 @@ class TensorFrame:
             ),
         )
 
+    def repartition_by_key(self, on) -> "TensorFrame":
+        """Hash-partition rows by key across the process fleet (≙ Spark's
+        ``repartition(col)`` exchange): afterwards every row whose key
+        hashes to process p lives ON process p, as a process-local host
+        frame. Frames repartitioned on the same key are CO-PARTITIONED —
+        joining or aggregating them afterwards runs process-locally (the
+        join's ``spans`` test sees plain local frames), with no further
+        collectives: pay the shuffle once, reuse it across a pipeline.
+        The partitioner is ``ops.exchange.partition_by_hash`` — the same
+        content-stable hash the over-budget shuffle join uses, so a
+        repartitioned frame joins consistently with exchange-planned
+        ones. EAGER (the exchange runs now, not at force time);
+        single-process frames return themselves unchanged. The result's
+        ``num_rows`` is the LOCAL partition's row count, like every
+        process-local frame.
+
+        The global frame is taken to be the UNION of the processes'
+        local rows (the contract of every process-local frame). Do NOT
+        call this on a REPLICATED frame — e.g. an under-budget
+        multi-process ``sort_values`` result, where every process
+        holds the full global frame — or each row arrives P times; a
+        warning fires when the local rows look identical fleet-wide."""
+        keys = [on] if isinstance(on, str) else list(on)
+        for k in keys:
+            self.schema[k]
+        import jax
+
+        if jax.process_count() == 1:
+            return self
+        from .ops import exchange as xch
+        from .ops.device_agg import gather_local_columns, uniform_ok
+
+        names = list(self.schema.names)
+        local = gather_local_columns(self, names)
+        if not uniform_ok(local is not None):
+            raise RuntimeError(
+                "repartition_by_key: some process holds no addressable "
+                "shard of a column — re-shard so every process holds "
+                "rows (frame_from_process_local)"
+            )
+        # replication tripwire: checksum a bounded key sample and
+        # compare fleet-wide. Identical partitions CAN be legitimate
+        # (then P-fold multiplicity is the correct union semantics), so
+        # this warns rather than raises.
+        import zlib
+
+        from jax.experimental import multihost_utils as _mh
+
+        probe = xch.content_hash64([local[k] for k in keys])[:1024]
+        crc = zlib.crc32(probe.tobytes()) if len(probe) else 0
+        crcs = np.asarray(
+            _mh.process_allgather(np.asarray([crc], np.int64))
+        ).reshape(-1)
+        if len(probe) and len(set(crcs.tolist())) == 1:
+            logger.warning(
+                "repartition_by_key: every process holds identical-"
+                "looking local rows — if this frame is REPLICATED "
+                "(e.g. an under-budget multi-process sort_values "
+                "result), the exchange will duplicate each row "
+                "process_count times; repartition the original "
+                "sharded frame instead"
+            )
+        part = xch.partition_by_hash(
+            [local[k] for k in keys], jax.process_count()
+        )
+        recv = xch.exchange_rows(local, part)
+        return TensorFrame([{n: recv[n] for n in names}], self.schema)
+
     def with_column_renamed(self, old: str, new: str) -> "TensorFrame":
         schema = Schema(
             [c.with_name(new) if c.name == old else c for c in self.schema]

@@ -1479,7 +1479,14 @@ def _eval_node(n: GraphNode, args: List, compute_dtype: Optional[str] = None):
 
     def mxu(x):
         """Serving-precision cast for MXU operands: f32 → compute_dtype
-        (accumulation stays f32 via preferred_element_type below)."""
+        (accumulation stays f32 via preferred_element_type below).
+
+        For CONCRETE operands (weight Consts — numpy at trace time)
+        this astype is EAGER, so the jaxpr embeds a bf16 constant and
+        constant hoisting passes bf16 weights as runtime arguments —
+        half the per-call weight HBM traffic of hoisted-f32-plus-
+        convert. Pinned by test_bf16_serving_halves_hoisted_weight_
+        bytes; tracers (activations) convert inside the program."""
         if compute_dtype is not None and getattr(x, "dtype", None) == jnp.float32:
             return x.astype(compute_dtype)
         return x

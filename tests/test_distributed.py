@@ -226,6 +226,22 @@ assert int(olen.sum()) == NPROC * NLOC + (1000 - n_distinct), (
 )
 for r in orows:  # every left row matches, so only v carries fills
     assert float(r["w"]) == int(r["k"]) * 10.0
+# CO-PARTITIONING (repartition_by_key): pay the shuffle once, then
+# joins run process-locally (spans=False on the local host frames) and
+# the union of local joins equals the global join
+lp = xf.repartition_by_key("k")
+rp = rframe.repartition_by_key("k")
+from tensorframes_tpu.ops.exchange import partition_by_hash
+lk = np.asarray(lp.column_values("k"), np.int64)
+assert (partition_by_hash([lk], NPROC) == pid).all()  # keys colocated
+cj = lp.join(rp, on="k").collect()
+cjlen = np.asarray(
+    mhx.process_allgather(np.asarray([len(cj)], np.int64))
+).reshape(-1)
+assert int(cjlen.sum()) == NPROC * NLOC, cjlen
+for r in cj:
+    assert float(r["w"]) == int(r["k"]) * 10.0
+    assert float(r["v"]) == int(r["k"]) * 2.0
 # guard: with the exchange disabled, over-budget plans raise the
 # actionable error on EVERY process instead of replicating
 configure(relational_exchange=False)

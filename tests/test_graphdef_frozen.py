@@ -742,3 +742,49 @@ def test_saved_model_keras_variables_object_path_keys(tmp_path):
         np.testing.assert_allclose(
             np.asarray(got[name]), w.numpy(), atol=1e-5, err_msg=name
         )
+
+
+def test_bf16_serving_halves_hoisted_weight_bytes():
+    """Round 5: under compute_dtype="bfloat16", the HOISTED constants
+    (the per-call HBM weight traffic under hoist_constants) must be
+    bf16 — i.e. the importer's serving cast applies to the weight
+    Consts THEMSELVES (numpy astype is eager), not as a per-call
+    convert on hoisted f32 arrays. Biases and other non-MXU constants
+    stay f32 ("all other ops stay exact")."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    import jax
+
+    from tensorframes_tpu.program import HoistedProgram
+
+    tf.keras.utils.set_random_seed(9)
+    inp = tf.keras.Input((32,), dtype="float32")
+    h = tf.keras.layers.Dense(64, activation="relu")(inp)
+    outp = tf.keras.layers.Dense(10)(h)
+    model = tf.keras.Model(inp, outp)
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(tf.TensorSpec([None, 32], tf.float32))
+    data = convert_variables_to_constants_v2(cf).graph.as_graph_def(
+    ).SerializeToString()
+
+    sizes = {}
+    outs = {}
+    x = np.random.default_rng(0).standard_normal((4, 32)).astype(np.float32)
+    for label, cd in (("f32", None), ("bf16", "bfloat16")):
+        prog = program_from_graphdef(
+            parse_graphdef(data), relax_lead_dim=True, compute_dtype=cd
+        )
+        abstract = {
+            prog.inputs[0].name: jax.ShapeDtypeStruct((4, 32), np.float32)
+        }
+        sizes[label] = HoistedProgram(prog.fn, abstract).const_bytes()
+        outs[label] = np.asarray(
+            prog.fn({prog.inputs[0].name: x})[prog.fetch_order[0]],
+            np.float32,
+        )
+    # weight matrices halve; f32 biases keep the ratio above exactly 0.5
+    assert sizes["bf16"] < 0.6 * sizes["f32"], sizes
+    # and the eager cast is numerically identical to serving rounding
+    np.testing.assert_allclose(outs["f32"], outs["bf16"], atol=0.05)

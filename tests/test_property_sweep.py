@@ -117,3 +117,52 @@ def test_bf16_map_and_reduce():
     assert float(np.asarray(tot).astype(np.float32)) == float(
         x.astype(np.float32).sum()
     )
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+@pytest.mark.parametrize("keydtype", [np.int32, np.int64, "str"])
+def test_join_sweep_vs_pandas(how, keydtype):
+    """Round 5: every join kind against pandas.merge over random keyed
+    frames (multi-match expansion, unmatched rows on both sides,
+    string and int keys). Row order is compared as a sorted multiset —
+    pandas' outer ordering is version-dependent."""
+    pd = pytest.importorskip("pandas")
+    import zlib
+
+    # crc, not hash(): python string hashing is salted per interpreter
+    # run, and an unreproducible seed makes any failure unbisectable
+    rng = np.random.default_rng(
+        zlib.crc32(f"{how}-{keydtype}".encode())
+    )
+
+    def keys(n):
+        raw = rng.integers(0, 8, n)
+        if keydtype == "str":
+            return [f"k{v}" for v in raw]
+        return raw.astype(keydtype)
+
+    nl, nr = 23, 17
+    left = {"k": keys(nl), "v": rng.standard_normal(nl)}
+    right = {"k": keys(nr), "w": rng.standard_normal(nr)}
+    lf = tfs.frame_from_arrays(dict(left), num_blocks=2)
+    rf = tfs.frame_from_arrays(dict(right), num_blocks=3)
+    kwargs = {}
+    if how != "inner":
+        kwargs["fill_value"] = {"v": -9.0, "w": -7.0}
+    got = lf.join(rf, on="k", how=how, **kwargs).collect()
+
+    want = pd.merge(
+        pd.DataFrame(left), pd.DataFrame(right), on="k", how=how,
+    )
+    if "v" in want:
+        want["v"] = want["v"].fillna(-9.0)
+    want["w"] = want["w"].fillna(-7.0)
+
+    def norm(rows):
+        return sorted(
+            (str(r["k"]), round(float(r["v"]), 9), round(float(r["w"]), 9))
+            for r in rows
+        )
+
+    assert len(got) == len(want)
+    assert norm(got) == norm(want.to_dict("records"))
