@@ -92,6 +92,12 @@ class Config:
     # over-budget replicated plan raises an actionable error instead of
     # silently OOMing every process at once.
     relational_exchange: bool = _env_bool("TFTPU_RELATIONAL_EXCHANGE", True)
+    # Route quantized 2-D matmuls through the pallas int8 kernel
+    # (in-kernel dequant: weights stream HBM→VMEM as int8
+    # unconditionally, ops/quantize.matmul_pallas_int8). OFF until a
+    # real-TPU window shows it beating the XLA structural fusion —
+    # dev/tpu_smoke.py prints the adjudicating comparison.
+    pallas_int8_matmul: bool = _env_bool("TFTPU_PALLAS_INT8_MM", False)
     # Demote f64/i64 device columns to f32/i32 at the device boundary:
     # False = never (reference-parity precision, f64 emulated on TPU),
     # True = on TPU backends only, "always" = every backend (testing /

@@ -602,12 +602,20 @@ class TensorFrame:
                             "budget, re-enable the exchange, or sort a "
                             "projected/filtered frame"
                         )
+                    t_x = time.perf_counter()
                     part = xch.partition_by_range(
                         [local[k] for k in keys],
                         jax.process_count(),
                         asc,
                     )
                     recv = xch.exchange_rows(local, part)
+                    # plan visibility in report(): rows RECEIVED here
+                    # (the replicated plan records no such span)
+                    profiling.record(
+                        "sort_values.exchange",
+                        time.perf_counter() - t_x,
+                        _block_num_rows(recv),
+                    )
                     merged = recv  # this process's key range only
                 else:
                     union, _ = _allgather_dicts(
@@ -1146,6 +1154,7 @@ class TensorFrame:
                     # process for identical values) and each process
                     # joins one partition
                     procs = jax.process_count()
+                    t_x = time.perf_counter()
                     lpart = xch.partition_by_hash(
                         [lcols[k] for k in keys], procs
                     )
@@ -1154,6 +1163,11 @@ class TensorFrame:
                     )
                     lrecv = xch.exchange_rows(lcols, lpart)
                     rrecv = xch.exchange_rows(r_local, rpart)
+                    profiling.record(
+                        "join.exchange",
+                        time.perf_counter() - t_x,
+                        _block_num_rows(lrecv) + _block_num_rows(rrecv),
+                    )
                     out = join_cols(lrecv, rrecv)
                 else:
                     union, _ = _allgather_dicts(
@@ -1242,10 +1256,15 @@ class TensorFrame:
                 "process_count times; repartition the original "
                 "sharded frame instead"
             )
+        t_x = time.perf_counter()
         part = xch.partition_by_hash(
             [local[k] for k in keys], jax.process_count()
         )
         recv = xch.exchange_rows(local, part)
+        profiling.record(
+            "repartition_by_key", time.perf_counter() - t_x,
+            _block_num_rows(recv),
+        )
         return TensorFrame([{n: recv[n] for n in names}], self.schema)
 
     def with_column_renamed(self, old: str, new: str) -> "TensorFrame":

@@ -112,9 +112,160 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     # singleton dims
     if w.q.ndim != 2 or w.scale.shape[:-1] != (1,) * (w.q.ndim - 1):
         return x @ w.dequantize(x.dtype)
+    if _pallas_int8_eligible(x, w):
+        # the probe in _pallas_int8_eligible already validated the
+        # kernel family eagerly — no try/except here, because under an
+        # outer jax.jit (how models call this) tracing cannot catch a
+        # downstream Mosaic failure anyway
+        return matmul_pallas_int8(x, w)
     out = x @ w.q.astype(x.dtype)
     scale = w.scale.reshape(-1)
     return (out.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def matmul_pallas_int8(
+    x: jnp.ndarray,
+    w: QuantizedTensor,
+    tile_n: int = 256,
+    tile_k: int = 256,
+    tile_m: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``x @ w`` with the int8 weight dequantized INSIDE a pallas
+    kernel: each weight tile streams HBM→VMEM as int8 (the whole point
+    — 4× less weight traffic than f32, 2× less than bf16) and converts
+    on-chip right before the MXU dot; the per-output-channel scale
+    multiplies the accumulator on the last k step.
+
+    Exists because :func:`matmul`'s structural fusion still leaves the
+    convert placement to XLA, and the r3 chip run measured int8 ≈ f32
+    there — consistent with a materialized wide copy. This kernel makes
+    the int8 byte saving unconditional. Fully tiled over (m, n, k) with
+    k innermost (sequential accumulation into the output block), so
+    VMEM holds only one tile per operand regardless of activation size.
+    Gated behind ``config.pallas_int8_matmul`` (off by default until a
+    real-TPU window adjudicates it — ``dev/tpu_smoke.py`` prints the
+    comparison); shapes: x [*, k], w.q [k, n], per-output-channel
+    scales. Same index-map x64 discipline as ops/segment.py (``i - i``
+    is an i32 zero under jax x64)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    assert w.q.ndim == 2 and w.scale.shape[:-1] == (1,)
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    n = w.q.shape[1]
+    m = int(np.prod(lead)) if lead else 1
+    x2 = x.reshape(m, k)
+
+    t_m = tile_m if m > tile_m else _round_up(max(m, 1), 8)
+    m_pad = _round_up(max(m, 1), t_m)
+    k_pad = _round_up(k, tile_k)
+    n_pad = _round_up(n, tile_n)
+    xp = jnp.zeros((m_pad, k_pad), x.dtype).at[:m, :k].set(x2)
+    qp = jnp.zeros((k_pad, n_pad), jnp.int8).at[:k, :n].set(w.q)
+    sp = (
+        jnp.ones((8, n_pad), jnp.float32)
+        .at[:, :n]
+        .set(jnp.broadcast_to(w.scale.reshape(1, n), (8, n)))
+    )
+    k_steps = k_pad // tile_k
+
+    def kernel(x_ref, q_ref, s_ref, o_ref):
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        q_wide = q_ref[:].astype(x_ref.dtype)  # int8→wide IN VMEM
+        o_ref[:] += jnp.dot(
+            x_ref[:], q_wide, preferred_element_type=jnp.float32
+        )
+
+        @pl.when(ki == k_steps - 1)
+        def _scale():
+            o_ref[:] = o_ref[:] * s_ref[0, :][None, :]
+
+    grid = (m_pad // t_m, n_pad // tile_n, k_steps)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (t_m, tile_k), lambda i, j, kk: (i, kk),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (tile_k, tile_n), lambda i, j, kk: (kk, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (8, tile_n), lambda i, j, kk: (i - i, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (t_m, tile_n), lambda i, j, kk: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n_pad), jnp.float32),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:m, :n].reshape(*lead, n).astype(x.dtype)
+
+
+# Probe-once gate: under jax.jit (how models call matmul) a Mosaic
+# compile failure surfaces at the OUTER jit's compile, where matmul's
+# try/except can no longer catch it. So eligibility runs a tiny
+# CONCRETE kernel once per process; if the kernel family doesn't
+# compile on this toolchain, the flag disables before any traced use.
+# The (m,n,k) tiling bounds every block to tile-sized VMEM, so probe
+# success is shape-representative. Resettable via reset_pallas_int8().
+_pallas_int8_state = {"probed": False, "ok": False}
+
+
+def reset_pallas_int8() -> None:
+    """Forget the probe result (e.g. after switching backends)."""
+    _pallas_int8_state["probed"] = False
+    _pallas_int8_state["ok"] = False
+
+
+def _pallas_int8_probe_ok() -> bool:
+    if not _pallas_int8_state["probed"]:
+        _pallas_int8_state["probed"] = True
+        try:
+            xs = jnp.ones((8, 128), jnp.bfloat16)
+            ws = quantize(jnp.ones((128, 128), jnp.float32))
+            jax.block_until_ready(matmul_pallas_int8(xs, ws))
+            _pallas_int8_state["ok"] = True
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas int8 matmul probe failed — using the XLA "
+                "structural fusion: %s", e,
+            )
+            _pallas_int8_state["ok"] = False
+    return _pallas_int8_state["ok"]
+
+
+def _pallas_int8_eligible(x, w) -> bool:
+    from ..config import get_config
+
+    return (
+        get_config().pallas_int8_matmul
+        and isinstance(w, QuantizedTensor)
+        and w.q.ndim == 2
+        and w.scale.shape[:-1] == (1,)
+        and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        and jax.default_backend() == "tpu"
+        and _pallas_int8_probe_ok()
+    )
 
 
 def quantize_tree(

@@ -242,6 +242,11 @@ assert int(cjlen.sum()) == NPROC * NLOC, cjlen
 for r in cj:
     assert float(r["w"]) == int(r["k"]) * 10.0
     assert float(r["v"]) == int(r["k"]) * 2.0
+# exchange observability: the shuffle plans record their own spans
+from tensorframes_tpu.utils import profiling as _prof
+_rep = _prof.report()
+for spanname in ("sort_values.exchange", "join.exchange", "repartition_by_key"):
+    assert spanname in _rep, (spanname, _rep[-2000:])
 # guard: with the exchange disabled, over-budget plans raise the
 # actionable error on EVERY process instead of replicating
 configure(relational_exchange=False)

@@ -242,3 +242,47 @@ def test_fused_dequant_matmul_matches_dequantize():
     np.testing.assert_allclose(
         np.asarray(got_row), np.asarray(want_row), rtol=2e-5, atol=2e-5
     )
+
+
+def test_pallas_int8_matmul_matches_structural_fusion():
+    """Round 5 (VERDICT r4 #3 'consider'): the pallas in-kernel-dequant
+    matmul must agree with quantize.matmul's structural fusion across
+    shapes (incl. non-tile-multiple dims and 3-D activations), run in
+    interpret mode on CPU. The real-TPU speed adjudication lives in
+    dev/tpu_smoke.py."""
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops import quantize as qz
+
+    rng = np.random.default_rng(0)
+    for (m_shape, k, n) in [((4,), 96, 160), ((2, 3), 128, 256),
+                            ((5,), 70, 100)]:
+        x = jnp.asarray(
+            rng.standard_normal((*m_shape, k)), jnp.float32
+        )
+        w = qz.quantize(
+            jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+        )
+        want = qz.matmul(x, w)
+        got = qz.matmul_pallas_int8(x, w, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_pallas_int8_matmul_gate_defaults_off():
+    """The kernel is opt-in until hardware adjudicates it: with the
+    config flag off (default), quantize.matmul must not attempt pallas
+    on any backend."""
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.config import get_config
+    from tensorframes_tpu.ops import quantize as qz
+
+    assert get_config().pallas_int8_matmul is False
+    x = jnp.ones((2, 32), jnp.float32)
+    w = qz.quantize(jnp.ones((32, 64), jnp.float32))
+    assert not qz._pallas_int8_eligible(x, w)
+    # and the default path still answers
+    out = qz.matmul(x, w)
+    assert out.shape == (2, 64)
