@@ -356,11 +356,15 @@ def _bench_attention(batch: int = 4, heads: int = 8, seq: int = 4096,
 
 def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
                     iters: int = 3, full_scale: bool = True,
-                    int8: bool = False):
+                    int8: bool = False, sweep: Sequence[int] = ()):
     """Causal-LM decode throughput (generated tokens/sec): KV-cache
     lax.scan decode as ONE jitted XLA program (models/generation.py).
     ``int8=True`` measures the weight-only quantized tree (decode is
-    weight-HBM-bound, so this is where int8 pays)."""
+    weight-HBM-bound, so this is where int8 pays). ``sweep`` (TPU)
+    times alternate batch sizes at 1 iter each — per-step weight
+    traffic amortizes across the batch, so tok/s should scale well
+    past batch 8 until the cache term dominates; the headline batch
+    stays fixed for cross-round comparability."""
     import jax
 
     from tensorframes_tpu.models import generation as gen
@@ -387,6 +391,17 @@ def _bench_generate(batch: int = 8, prompt: int = 32, new: int = 64,
     def run_once():
         _sync(fn(d_params, prompts))
 
+    for b2 in sweep:
+        if b2 == batch:
+            continue
+        p2 = rng.integers(0, cfg.vocab_size, (b2, prompt)).astype(np.int32)
+        tps2 = _time_rows_per_sec(
+            lambda: _sync(fn(d_params, p2)), b2 * new, 1
+        )
+        print(
+            f"# sweep | decode{'_int8kv' if int8 else ''} batch={b2} "
+            f"tokens_per_sec={tps2:.0f}"
+        )
     return _time_rows_per_sec(run_once, batch * new, iters)
 
 
@@ -1053,6 +1068,7 @@ def main():
             new=64 if on_tpu else 8,
             iters=3 if on_tpu else 1,
             full_scale=on_tpu,
+            sweep=(16, 32) if on_tpu else (),
         ),
         0.0,
         metric_keys=(
@@ -1066,6 +1082,7 @@ def main():
             iters=3 if on_tpu else 1,
             full_scale=on_tpu,
             int8=True,
+            sweep=(16, 32) if on_tpu else (),
         ),
         0.0,
         metric_keys=(
