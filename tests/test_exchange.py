@@ -182,3 +182,98 @@ def test_exchange_chunked_rounds_reassemble(monkeypatch):
     assert out["s"] == cols["s"]
     stats = xch.last_exchange_stats
     assert stats["rounds"] > 1, stats
+
+
+def test_simulated_shuffle_join_equals_global():
+    """The shuffle-join invariant, simulated in-process: hash-partition
+    BOTH sides into P buckets, join each bucket pair locally, and the
+    union must equal the global join — over the key types the real
+    fleet test can't sweep (strings, NaN floats, ±0.0, and int-vs-float
+    sides whose equality only appears after numpy promotion)."""
+    import pandas as pd
+
+    P = 4
+    rng = np.random.default_rng(5)
+
+    def global_join(l, r):
+        return pd.merge(
+            pd.DataFrame(l), pd.DataFrame(r), on="k", how="inner"
+        )
+
+    def check(left, right):
+        want = global_join(left, right)
+        lpart = xch.partition_by_hash([left["k"]], P)
+        rpart = xch.partition_by_hash([right["k"]], P)
+        pieces = []
+        for p in range(P):
+            lsub = {n: np.asarray(v, dtype=object)[lpart == p].tolist()
+                    if isinstance(v, list) else v[lpart == p]
+                    for n, v in left.items()}
+            rsub = {n: np.asarray(v, dtype=object)[rpart == p].tolist()
+                    if isinstance(v, list) else v[rpart == p]
+                    for n, v in right.items()}
+            if len(lsub["k"]) and len(rsub["k"]):
+                pieces.append(global_join(lsub, rsub))
+        got = pd.concat(pieces) if pieces else want.iloc[:0]
+        key = lambda df: sorted(
+            map(repr, df[["k", "v", "w"]].to_numpy().tolist())
+        )
+        assert key(got) == key(want)
+        assert len(want) > 0  # the sweep actually joined something
+
+    # INT64 left vs FLOAT64 right: equality appears only after numpy
+    # promotion; the canonical-f64 hash must colocate 5 with 5.0
+    lk_i = rng.integers(0, 12, 60)  # stays int64
+    assert lk_i.dtype == np.int64
+    rk_f = rng.integers(0, 12, 40).astype(np.float64)
+    rk_f[0] = -0.0  # ±0.0 must meet (+0.0 keys exist on the left)
+    check(
+        {"k": lk_i, "v": np.arange(60, dtype=np.float64)},
+        {"k": rk_f, "w": np.arange(40, dtype=np.float64)},
+    )
+    # STRING keys as host lists (the per-cell crc path)
+    check(
+        {"k": [f"s{v}" for v in rng.integers(0, 9, 50)],
+         "v": np.arange(50, dtype=np.float64)},
+        {"k": [f"s{v}" for v in rng.integers(0, 9, 30)],
+         "w": np.arange(30, dtype=np.float64)},
+    )
+    # NaN float keys: pandas merge matches NaN to NaN (hash must
+    # colocate every NaN in one partition for that to survive)
+    lk_n = rng.integers(0, 6, 40).astype(np.float64)
+    rk_n = rng.integers(0, 6, 25).astype(np.float64)
+    lk_n[[1, 7]] = np.nan
+    rk_n[[2]] = np.nan
+    check(
+        {"k": lk_n, "v": np.arange(40, dtype=np.float64)},
+        {"k": rk_n, "w": np.arange(25, dtype=np.float64)},
+    )
+
+
+def test_simulated_range_sort_equals_global():
+    """The range-sort invariant, simulated in-process: partition by
+    sampled splitters, sort each partition, concatenate in partition
+    order — must equal the global stable sort, including NaN keys
+    (numpy convention: NaN last ascending) and multi-key descending."""
+    rng = np.random.default_rng(6)
+    P = 4
+    k1 = rng.standard_normal(500)
+    k1[[7, 123, 400]] = np.nan
+    k2 = rng.integers(0, 5, 500).astype(np.int64)
+    tag = np.arange(500)
+
+    for asc in ([True, True], [True, False]):
+        part = xch.partition_by_range([k1, k2], P, asc)
+        from tensorframes_tpu.ops.keys import _unique_inverse
+
+        def order_of(idx):
+            c1 = _unique_inverse(k1[idx])[1]
+            c2 = _unique_inverse(k2[idx])[1]
+            ks = [c2 if asc[1] else -c2, c1 if asc[0] else -c1]
+            return idx[np.lexsort(ks)]
+
+        got = np.concatenate(
+            [order_of(np.flatnonzero(part == p)) for p in range(P)]
+        )
+        want = order_of(np.arange(500))
+        np.testing.assert_array_equal(tag[got], tag[want])
