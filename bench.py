@@ -1123,7 +1123,10 @@ def main():
         "aggregate_device_1M_512groups_wall_s": round(aggregate_dev_s, 6),
         "aggregate_strings_1M_512groups_wall_s": round(aggregate_str_s, 6),
         "map_rows_ragged_rows_per_sec": round(ragged_rps),
+        "map_rows_ragged_device_rows_per_sec": round(ragged_dev_rps),
         "map_rows_fixed_rows_per_sec": round(fixed_rps),
+        "pair_native_inception_rows_per_sec": round(pair_native, 1),
+        "pair_frozen_inception_rows_per_sec": round(pair_frozen, 1),
         "logreg_map_blocks_rows_per_sec": round(logreg_rps),
         "inception_v3_map_blocks_rows_per_sec": round(inception_rps),
         "inception_v3_int8_map_blocks_rows_per_sec": round(inception_rps_q),

@@ -78,6 +78,14 @@ while true; do
   fi
   python dev/bench_check.py "dev/bench_tpu_heal.log$SUF" --refresh "${BASELINE_ARGS[@]}" \
     >> dev/tpu_probe.log 2>&1
+  # bonus capture while the window is open: the TPU cost-model int8
+  # break-even (the CPU cost model over-counts DUS; BASELINE.md r5) —
+  # best-effort, the window may close mid-run. Runs in rehearsal too
+  # (CPU backend) so script bugs here surface in dry runs, not in the
+  # one real window.
+  timeout 900 python dev/int8_breakeven.py > "dev/int8_breakeven_tpu.log$SUF" 2>&1 \
+    && echo "$(date -u +%H:%M:%S) int8_breakeven captured (dev/int8_breakeven_tpu.log$SUF)" >> dev/tpu_probe.log \
+    || echo "$(date -u +%H:%M:%S) int8_breakeven did not finish" >> dev/tpu_probe.log
   if [ "$REH" = "1" ]; then
     rm -f "$ALIVE"
     echo "$(date -u +%H:%M:%S) rehearsal complete (logs: *.rehearsal)" >> dev/tpu_probe.log
