@@ -53,6 +53,27 @@ def worker_main(coordinator: str, num_processes: int, process_id: int) -> None:
     )
     print(f"[proc {pid}] global rows={frame.num_rows} total(w)={float(total)}")
 
+    # the relational layer across the fleet: attach a per-key attribute
+    # (broadcast hash join — the right side is tiny), then CO-PARTITION
+    # both sides once and join process-locally (no further collectives)
+    keys = np.arange(pid * 4, pid * 4 + 4)  # spread across the hash space
+    kf = parallel.frame_from_process_local(
+        {"k": keys, "v": local_rows}, mesh=mesh, axis="dp",
+    )
+    dims = parallel.frame_from_process_local(
+        {"k": keys[::-1].copy(), "weight": keys[::-1] * 0.5},
+        mesh=mesh, axis="dp",
+    )
+    joined = kf.join(dims, on="k")  # process-local share of the join
+    co_l = kf.repartition_by_key("k")    # each key's rows now colocate…
+    co_r = dims.repartition_by_key("k")  # …on the SAME process
+    local_join = co_l.join(co_r, on="k")  # plain local frames: no collective
+    print(
+        f"[proc {pid}] join rows={len(joined.collect())} "
+        f"co-partitioned local rows={co_l.num_rows} "
+        f"local-join rows={len(local_join.collect())}"
+    )
+
 
 def main() -> None:
     with socket.socket() as s:
