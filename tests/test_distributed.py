@@ -260,6 +260,20 @@ for plan in (
     except RuntimeError as e:
         assert "relational_broadcast_bytes" in str(e), e
 configure(relational_exchange=True, relational_broadcast_bytes=64 << 20)
+# replication tripwire: repartitioning a REPLICATED frame (the
+# under-budget sort result — every process holds the same rows) must
+# warn about P-fold duplication
+import logging as _lg
+_msgs = []
+class _CapH(_lg.Handler):
+    def emit(self, r):
+        _msgs.append(r.getMessage())
+_h = _CapH()
+_lg.getLogger("tensorframes_tpu.frame").addHandler(_h)
+replicated = kf.sort_values("k")  # small -> replicated plan
+_ = replicated.repartition_by_key("k")
+_lg.getLogger("tensorframes_tpu.frame").removeHandler(_h)
+assert any("identical" in m for m in _msgs), _msgs
 # sharded persistence: each process writes its part, reloads, and the
 # reassembled global frame reduces to the same total across hosts
 sf_dir = {sf_dir!r}
