@@ -291,12 +291,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(tmp_path, nproc: int, timeout: float, attempts: int = 2):
-    """Launch the worker fleet; one retry with a FRESH coordinator port.
+def _run_workers(tmp_path, nproc: int, timeout: float, attempts: int = 3):
+    """Launch the worker fleet; retries with a FRESH coordinator port.
     The rendezvous is exposed to two load-dependent transients a retry
     cures: the _free_port bind/close/reuse race, and slow worker
     interpreter startup under a loaded machine blowing the distributed
-    init window (observed as rare full-suite-only failures)."""
+    init window (observed as rare full-suite-only failures; round 5
+    reproduced one by running a SECOND fleet concurrently — hence the
+    third attempt)."""
     last = None
     for attempt in range(attempts):
         try:
