@@ -242,6 +242,39 @@ assert int(cjlen.sum()) == NPROC * NLOC, cjlen
 for r in cj:
     assert float(r["w"]) == int(r["k"]) * 10.0
     assert float(r["v"]) == int(r["k"]) * 2.0
+# distributed drop_duplicates: duplicates COLOCATE under the hash
+# exchange, so each process's local dedup is the global dedup; survivors
+# carry the GLOBAL-first-occurrence row (v encodes (proc, row))
+dupf = frame_from_process_local(
+    {{"k": np.asarray([0, 10 + pid, 0, 10 + pid], np.int64),
+      "v": np.asarray([100.0 * pid + i for i in range(4)])}},
+    mesh=mesh, axis="dp",
+)
+surv = dupf.drop_duplicates(subset="k").collect()
+for r in surv:
+    kk, vv = int(r["k"]), float(r["v"])
+    if kk == 0:
+        assert vv == 0.0, r  # global first occurrence: proc 0, row 0
+    else:
+        p_src = kk - 10
+        assert vv == 100.0 * p_src + 1.0, r  # proc p_src, row 1
+slen = np.asarray(
+    mhx.process_allgather(np.asarray([len(surv)], np.int64))
+).reshape(-1)
+assert int(slen.sum()) == 1 + NPROC, slen  # key 0 plus one 10+p per proc
+# the round-5 review's blind spot: dedup of a process-LOCAL frame on a
+# key OTHER than its partition key must still be global (the exchange
+# runs for every layout) — column b duplicates span every process
+pl2 = frame_from_process_local(
+    {{"a": np.asarray([pid, pid], np.int64),
+      "b": np.asarray([7, 7], np.int64)}},
+    mesh=mesh, axis="dp",
+).repartition_by_key("a")
+sb = pl2.drop_duplicates(subset="b").collect()
+sblen = np.asarray(
+    mhx.process_allgather(np.asarray([len(sb)], np.int64))
+).reshape(-1)
+assert int(sblen.sum()) == 1, sblen  # one global survivor, not one/proc
 # exchange observability: the shuffle plans record their own spans
 from tensorframes_tpu.utils import profiling as _prof
 _rep = _prof.report()

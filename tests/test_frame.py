@@ -698,3 +698,50 @@ def test_filter_device_frame_stays_on_device():
         lambda x: {"keep": x % 3.0 == 0.0}
     ).collect())
     assert hgot == want
+
+
+def test_drop_duplicates_matches_pandas():
+    """Round 5: drop_duplicates/distinct — keep-first in global row
+    order, every key type the aggregate encoder handles, NaN keys
+    collapse (the grouping convention, same as pandas)."""
+    import pandas as pd
+
+    rows = [
+        {"k": "a", "g": 1, "v": 0.0},
+        {"k": "b", "g": 1, "v": 1.0},
+        {"k": "a", "g": 1, "v": 2.0},   # dup of (a,1) on subset
+        {"k": "a", "g": 2, "v": 3.0},
+        {"k": "b", "g": 1, "v": 4.0},   # dup of (b,1)
+    ]
+    fr = tfs.frame_from_rows(rows, num_blocks=2)
+    got = fr.drop_duplicates(subset=["k", "g"]).collect()
+    want = pd.DataFrame(rows).drop_duplicates(
+        subset=["k", "g"], keep="first"
+    )
+    assert [(r["k"], r["g"], r["v"]) for r in got] == [
+        tuple(t) for t in want.to_numpy()
+    ]
+
+    # full-row distinct; NaN keys collapse to one row like pandas
+    nan_rows = [
+        {"x": float("nan"), "y": 1.0},
+        {"x": 2.0, "y": 1.0},
+        {"x": float("nan"), "y": 1.0},
+        {"x": 2.0, "y": 1.0},
+    ]
+    nf = tfs.frame_from_rows(nan_rows)
+    dv = nf.distinct().collect()
+    wv = pd.DataFrame(nan_rows).drop_duplicates()
+    assert len(dv) == len(wv) == 2
+    # single-column subset keeps the other columns from the FIRST row
+    s = fr.drop_duplicates(subset="k").collect()
+    assert [(r["k"], r["v"]) for r in s] == [("a", 0.0), ("b", 1.0)]
+    # non-scalar key cells raise with guidance
+    ef = tfs.frame_from_arrays({"e": np.zeros((4, 3), np.float32)})
+    with pytest.raises(ValueError, match="non-scalar"):
+        ef.drop_duplicates().collect()
+    # device frames dedup too (through the host merge)
+    dd = tfs.frame_from_arrays(
+        {"k": np.asarray([3, 1, 3, 1, 2])}
+    ).to_device()
+    assert [r["k"] for r in dd.drop_duplicates().collect()] == [3, 1, 2]
