@@ -60,7 +60,7 @@ while true; do
     # transient heal: drop the marker, resume probing, keep waiting
     rm -f "$ALIVE"
     [ "$REH" = "1" ] && exit 1
-    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 8>&- &
+    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 8>&- 9>&- &
     continue
   fi
   python bench.py > "dev/bench_tpu_heal.log$SUF" 2>&1
@@ -73,7 +73,7 @@ while true; do
     echo "$(date -u +%H:%M:%S) bench was not a TPU run — re-arming" >> dev/tpu_probe.log
     rm -f "$ALIVE"
     [ "$REH" = "1" ] && exit 1
-    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 8>&- &
+    nohup bash dev/tpu_probe_loop.sh >/dev/null 2>&1 8>&- 9>&- &
     continue
   fi
   python dev/bench_check.py "dev/bench_tpu_heal.log$SUF" --refresh "${BASELINE_ARGS[@]}" \
