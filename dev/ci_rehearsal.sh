@@ -84,6 +84,9 @@ run_step "Install check (package metadata + import from install target)" \
 run_step "Test (8-device virtual CPU mesh)" \
   python -m pytest tests/ -x -q
 
+run_step "Resilience drill (kill–resume, corrupted restore, fault injection)" \
+  bash "$CLONE/dev/resilience_drill.sh"
+
 run_step "Bench smoke (CPU fallback)" bash -c \
   "set -o pipefail; python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()\" | tee bench_out.txt"
 

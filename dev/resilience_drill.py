@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Robustness regression drill — one command, nonzero exit on any
+unrecovered failure (wired for CI next to bench_check.py).
+
+Exercises the acceptance surface of the resilience subsystem end-to-end:
+
+1. **kill–resume**: a real training subprocess is SIGKILLed mid-loop
+   after its first checkpoint, relaunched, and must reach a final state
+   bit-identical to an uninterrupted run.
+2. **corrupted-checkpoint restore**: the newest step's payload is
+   truncated; ``restore`` must reject it (integrity failure) and fall
+   back to the previous intact step, and ``verify()`` must flag it.
+3. **transient-IO fault absorption**: ``checkpoint.save`` +
+   ``io.prefetch.device_put`` faults injected every 2nd attempt must be
+   fully absorbed by the retry policies (zero surviving failures).
+
+Run: ``python dev/resilience_drill.py`` (or ``dev/resilience_drill.sh``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import traceback
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def drill_kill_resume(root: str) -> str:
+    """Delegate to tests/test_crash_resume.py — the single source of the
+    SIGKILL/relaunch/compare logic (both the fast single-kill and the
+    slow triple-kill variants), so the drill and the test suite can
+    never drift apart."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_crash_resume.py",
+         "-q", "-p", "no:cacheprovider"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"crash/resume tests failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+    return ("kill -9 mid-loop (single + repeated), resumed runs match "
+            "uninterrupted bit-for-bit")
+
+
+def drill_corrupted_restore(root: str) -> str:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorframes_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(os.path.join(root, "corrupt"), backend="npz")
+    for s in (2, 4, 6):
+        ck.save(s, {"w": jnp.full((4,), float(s), jnp.float32)})
+    payload = os.path.join(ck.root, "step_6", "arrays.npz")
+    data = open(payload, "rb").read()
+    with open(payload, "wb") as f:
+        f.write(data[: len(data) // 2])
+    if ck.verify(6)[6]["ok"] is not False:
+        raise AssertionError("verify() did not flag the truncated step")
+    got = ck.restore(like={"w": jnp.zeros(4, jnp.float32)})
+    if float(np.asarray(got["w"])[0]) != 4.0:
+        raise AssertionError(f"restore did not fall back to step 4: {got}")
+    return "truncated newest step rejected; restore fell back to previous intact step"
+
+
+def drill_transient_faults(root: str) -> str:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import io as tfio
+    from tensorframes_tpu.checkpoint import Checkpointer
+    from tensorframes_tpu.resilience import RetryPolicy, inject
+    from tensorframes_tpu.training import run_resumable
+
+    policy = RetryPolicy(max_attempts=3, backoff=0.005)
+
+    def step(state, batch):
+        new = {"w": state["w"] + batch}
+        return new, {"loss": new["w"].sum()}
+
+    ck = Checkpointer(os.path.join(root, "flaky"), backend="npz", retry=policy)
+    with inject("checkpoint.save", OSError, every_n=2) as save_inj:
+        state, ran = run_resumable(
+            step, {"w": jnp.zeros(2)}, ck,
+            [jnp.full((2,), float(i)) for i in range(8)],
+            num_steps=8, save_every=2,
+        )
+    if ran != 8 or save_inj.fired < 1:
+        raise AssertionError(f"save drill: ran={ran}, fired={save_inj.fired}")
+
+    frame = tfs.frame_from_arrays({"x": np.arange(16.0)})
+    with inject("io.prefetch.device_put", OSError, every_n=2) as put_inj:
+        batches = list(tfio.prefetch_to_device(
+            tfio.iterate_batches(frame, batch_size=4), size=2, retry=policy,
+        ))
+    if len(batches) != 4 or put_inj.fired < 1:
+        raise AssertionError(f"prefetch drill: n={len(batches)}, fired={put_inj.fired}")
+    return (f"injected faults absorbed (save: {save_inj.fired} fired, "
+            f"device_put: {put_inj.fired} fired), zero surviving failures")
+
+
+def main() -> int:
+    drills = [
+        ("kill-resume", drill_kill_resume),
+        ("corrupted-restore", drill_corrupted_restore),
+        ("transient-faults", drill_transient_faults),
+    ]
+    failures = 0
+    with tempfile.TemporaryDirectory() as root:
+        for name, fn in drills:
+            t0 = time.time()
+            try:
+                msg = fn(root)
+                print(f"PASS {name} ({time.time() - t0:.1f}s): {msg}")
+            except Exception:
+                failures += 1
+                print(f"FAIL {name} ({time.time() - t0:.1f}s):")
+                traceback.print_exc()
+    if failures:
+        print(f"resilience_drill: {failures}/{len(drills)} drills FAILED")
+        return 1
+    print(f"resilience_drill: all {len(drills)} drills recovered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

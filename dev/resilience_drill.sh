@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Robustness regression drill (CI entry point): kill–resume exercise,
+# corrupted-checkpoint restore, and injected transient-IO faults under
+# retry. Exits nonzero on any unrecovered failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python dev/resilience_drill.py "$@"

@@ -1,0 +1,98 @@
+"""Fault-injection walkthrough: break the trainer on purpose, watch the
+resilience subsystem absorb it.
+
+Three drills, all deterministic (seeded/counted injections, so a failure
+replays exactly):
+
+1. **Transient IO faults** — every 2nd checkpoint write raises OSError;
+   a retrying ``Checkpointer`` absorbs all of them and the run finishes
+   with the same loss trajectory as a fault-free run.
+2. **Poisoned batch** — one minibatch of NaNs mid-stream; the
+   ``guard="skip"`` policy discards that single update instead of letting
+   NaN propagate into every parameter.
+3. **Corrupted checkpoint** — the newest step's payload is truncated on
+   disk; ``restore`` logs the integrity failure and falls back to the
+   previous intact step.
+
+Run: ``python -m examples.fault_injection``
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.resilience import RetryPolicy, StepGuard, inject
+from tensorframes_tpu.training import run_resumable
+
+
+@jax.jit
+def _step(state, batch):
+    new = {"w": state["w"] * 0.99 + batch}
+    return new, {"loss": jnp.abs(new["w"]).sum()}
+
+
+def _batches(n, poison_at=None):
+    out = [jnp.full((4,), float(i % 5), jnp.float32) for i in range(n)]
+    if poison_at is not None:
+        out[poison_at] = jnp.full((4,), np.nan, jnp.float32)
+    return out
+
+
+def drill_transient_io(root: str) -> None:
+    ck = tfs.Checkpointer(
+        os.path.join(root, "io"), backend="npz",
+        retry=RetryPolicy(max_attempts=3, backoff=0.01),
+    )
+    with inject("checkpoint.save", OSError("simulated disk wobble"),
+                every_n=2) as inj:
+        _, ran = run_resumable(
+            _step, {"w": jnp.zeros(4, jnp.float32)}, ck,
+            _batches(10), num_steps=10, save_every=2,
+        )
+    print(f"[io] {ran} steps, {inj.fired} injected save faults, "
+          f"all absorbed; latest checkpoint = step {ck.latest_step()}")
+
+
+def drill_poison_batch(root: str) -> None:
+    guard = StepGuard(policy="skip", max_consecutive=3)
+    ck = tfs.Checkpointer(os.path.join(root, "nan"), backend="npz")
+    state, ran = run_resumable(
+        _step, {"w": jnp.zeros(4, jnp.float32)}, ck,
+        _batches(10, poison_at=5), num_steps=10, save_every=0, guard=guard,
+    )
+    finite = bool(np.isfinite(np.asarray(state["w"])).all())
+    print(f"[nan] {ran} steps, {guard.skipped} skipped, "
+          f"final state finite = {finite}")
+
+
+def drill_corrupted_checkpoint(root: str) -> None:
+    ck = tfs.Checkpointer(os.path.join(root, "corrupt"), backend="npz")
+    for s in (2, 4, 6):
+        ck.save(s, {"w": jnp.full((4,), float(s), jnp.float32)})
+    payload = os.path.join(ck.root, "step_6", "arrays.npz")
+    data = open(payload, "rb").read()
+    with open(payload, "wb") as f:
+        f.write(data[: len(data) // 2])  # simulate a torn write
+    print(f"[corrupt] audit: "
+          f"{ {s: r['ok'] for s, r in ck.verify().items()} }")
+    got = ck.restore(like={"w": jnp.zeros(4, jnp.float32)})
+    print(f"[corrupt] restore fell back to w={float(np.asarray(got['w'])[0])} "
+          f"(step 4's value) — the torn step 6 was rejected")
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as root:
+        drill_transient_io(root)
+        drill_poison_batch(root)
+        drill_corrupted_checkpoint(root)
+    print("fault_injection: all drills recovered")
+
+
+if __name__ == "__main__":
+    main()

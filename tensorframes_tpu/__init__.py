@@ -97,8 +97,10 @@ from .ops.verbs import (  # noqa: E402,F401
     reduce_blocks,
     reduce_rows,
 )
-from .checkpoint import Checkpointer  # noqa: E402,F401
+from .checkpoint import Checkpointer, CheckpointCorruptionError  # noqa: E402,F401
 from .training import run_resumable  # noqa: E402,F401
+from . import resilience  # noqa: E402,F401
+from .resilience import RetryPolicy, StepGuard  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from .io import (  # noqa: E402,F401
     frame_from_arrow,
@@ -139,6 +141,10 @@ __all__ = [
     "describe",
     # aux subsystems
     "Checkpointer",
+    "CheckpointCorruptionError",
+    "resilience",
+    "RetryPolicy",
+    "StepGuard",
     "run_resumable",
     "profiling",
     "io",

@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume with verified integrity.
 
 The reference has none (SURVEY.md §5: "Checkpoint / resume: none" — it is
 stateless by construction, freezing variables to constants client-side,
@@ -16,6 +16,24 @@ params), so checkpointing becomes first-class, the TPU-native way:
 
 Both sit behind one ``Checkpointer`` API: numbered steps under a root
 directory, ``latest_step``, ``save``, ``restore(like=...)``.
+
+Durability & integrity (the resilience subsystem's checkpoint leg):
+
+* ``save`` fsyncs every payload file and the temp directory **before**
+  the atomic ``os.replace``, then fsyncs the root — power loss can
+  publish the old step or the new step, never a torn one.
+* The npz manifest records a CRC32 + byte size **per array**; ``restore``
+  verifies them and, when the newest step is truncated or corrupted,
+  logs the integrity failure and falls back to the previous intact step
+  automatically (explicit ``step=`` requests fail loudly instead).
+* ``verify()`` is the audit mode: integrity-check any/all steps without
+  materializing state.
+* Orphaned ``step_*.tmp*`` directories left by a crashed save are
+  garbage-collected on the next ``Checkpointer`` init.
+* An optional :class:`~tensorframes_tpu.resilience.RetryPolicy` absorbs
+  transient IO faults around save/restore; the ``checkpoint.save`` /
+  ``checkpoint.restore`` fault-injection sites live inside the retry
+  scope so drills exercise the real path.
 """
 
 from __future__ import annotations
@@ -24,21 +42,62 @@ import json
 import os
 import re
 import shutil
-from typing import Any, List, Optional
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from .resilience.faults import fault_point
+from .resilience.retry import RetryError, RetryPolicy, retry_call
 from .utils import get_logger
 from .utils.npz import decode_array, encode_array
 
 logger = get_logger(__name__)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_RE = re.compile(r"^step_\d+\.tmp(\d+)")
+
+# temp dirs with a save currently in flight IN THIS PROCESS — lets the
+# init-time GC distinguish "our live save on another thread" from "a
+# corpse left by a previous same-pid incarnation" (pid 1 in a restarted
+# container is the same pid every time)
+_live_tmps: set = set()
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint step failed integrity verification (truncated payload,
+    CRC mismatch, unreadable manifest, …)."""
 
 
 def _step_dir(root: str, step: int) -> str:
     return os.path.join(root, f"step_{step}")
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory, best-effort (directories are not
+    fsync-able on every platform/filesystem; durability degrades to the
+    OS default there rather than failing the save)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - platform-dependent
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(path: str) -> None:
+    """fsync every file under ``path``, then the directories bottom-up,
+    so the subsequent ``os.replace`` publishes fully-durable contents."""
+    for dirpath, _dirnames, filenames in os.walk(path, topdown=False):
+        for name in filenames:
+            _fsync_path(os.path.join(dirpath, name))
+        _fsync_path(dirpath)
 
 
 class Checkpointer:
@@ -49,12 +108,21 @@ class Checkpointer:
     >>> state = ckpt.restore(like={"params": params0, "opt": opt0})
     """
 
-    def __init__(self, root: str, backend: Optional[str] = None, keep: int = 0):
+    def __init__(
+        self,
+        root: str,
+        backend: Optional[str] = None,
+        keep: int = 0,
+        retry: Optional[RetryPolicy] = None,
+    ):
         """``backend``: 'orbax' | 'npz' | None (auto: orbax if importable).
-        ``keep``: retain only the newest N step dirs (0 = keep all)."""
+        ``keep``: retain only the newest N step dirs (0 = keep all).
+        ``retry``: optional RetryPolicy absorbing transient IO faults
+        around save/restore (non-retryable errors propagate untouched)."""
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.keep = keep
+        self.retry = retry
         if backend is None:
             try:
                 import orbax.checkpoint  # noqa: F401
@@ -65,6 +133,8 @@ class Checkpointer:
         if backend not in ("orbax", "npz"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.backend = backend
+        self._heal_crashed_swaps()
+        self._gc_orphaned_tmps()
 
     # -- step bookkeeping ---------------------------------------------------
 
@@ -80,6 +150,20 @@ class Checkpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def latest_intact_step(self) -> Optional[int]:
+        """Newest step whose integrity audit does not FAIL (unverifiable
+        orbax/legacy steps count as intact, ``ok=None``) — an audit-side
+        prediction of where ``restore_latest`` will land, without
+        materializing state. NOTE: callers that need the landed step to
+        stay consistent with the restored state should use
+        ``restore_latest`` itself (one read, no prediction gap) — that is
+        what ``run_resumable``/``train_on_frame`` do; this helper is for
+        monitoring/drills."""
+        for s in reversed(self.all_steps()):
+            if self.verify(s)[s]["ok"] is not False:
+                return s
+        return None
+
     def _gc(self) -> None:
         if self.keep <= 0:
             return
@@ -87,45 +171,249 @@ class Checkpointer:
         for s in steps[: -self.keep]:
             shutil.rmtree(_step_dir(self.root, s), ignore_errors=True)
 
+    def _heal_crashed_swaps(self) -> None:
+        """Recover ``step_N.old`` aside-dirs left by a save killed inside
+        its publish window: if ``step_N`` never appeared, the aside copy
+        IS the step — rename it back; otherwise it is superseded refuse."""
+        for name in os.listdir(self.root):
+            if not name.endswith(".old"):
+                continue
+            base = name[: -len(".old")]
+            if not _STEP_RE.match(base):
+                continue
+            old = os.path.join(self.root, name)
+            final = os.path.join(self.root, base)
+            if os.path.isdir(final):
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                try:
+                    os.rename(old, final)
+                except OSError:
+                    # a sibling process relaunching on the same shared
+                    # root healed (or re-saved) first; losing that race
+                    # must not kill our init
+                    if not os.path.isdir(final):
+                        raise
+                    shutil.rmtree(old, ignore_errors=True)
+                    continue
+                logger.warning(
+                    "Checkpointer: healed %s from a crashed publish", base
+                )
+
+    def _gc_orphaned_tmps(self) -> None:
+        """Remove ``step_*.tmp<pid>_*`` directories left behind by a save
+        that crashed before its atomic rename. Temp names embed the
+        writer's pid, and only corpses whose writer is **dead** are
+        collected — a replacement process restarting on a shared root
+        must not delete the old process's still-in-flight emergency save
+        (pid reuse makes this best-effort, which only delays GC)."""
+        for name in os.listdir(self.root):
+            m = _TMP_RE.match(name)
+            if not m:
+                continue
+            full = os.path.join(self.root, name)
+            pid = int(m.group(1))
+            if full in _live_tmps:
+                continue  # this process's save, in flight on another thread
+            if pid != os.getpid():
+                # another process's temp: a corpse only if the writer died
+                # (a pid-1 container restart reuses the pid, which is why
+                # same-pid temps are judged by the _live_tmps registry
+                # above, not by liveness)
+                try:
+                    os.kill(pid, 0)
+                    continue  # writer still alive: not a corpse
+                except ProcessLookupError:
+                    pass
+                except OSError:  # pragma: no cover - EPERM: can't tell
+                    continue
+            shutil.rmtree(full, ignore_errors=True)
+            logger.warning(
+                "Checkpointer: removed orphaned temp %s (crashed save)",
+                name,
+            )
+
+    def _io(self, fn, describe: str):
+        """Run a save/restore closure under the configured retry policy
+        (retry=None → retry_call degrades to a plain call)."""
+        return retry_call(fn, policy=self.retry, describe=describe)
+
     # -- save / restore -----------------------------------------------------
 
     def save(self, step: int, state: Any) -> str:
-        """Write ``state`` (a pytree of arrays) as step ``step``. Atomic:
-        the step dir only appears once fully written."""
+        """Write ``state`` (a pytree of arrays) as step ``step``. Atomic
+        AND durable: payloads are fsynced before the rename publishes the
+        step dir, so a crash at any instant leaves either the previous
+        intact step or the new one — never a torn directory."""
         final = _step_dir(self.root, step)
-        tmp = final + f".tmp{os.getpid()}"
-        shutil.rmtree(tmp, ignore_errors=True)
-        try:
-            if self.backend == "orbax":
-                self._save_orbax(tmp, state)
-            else:
-                self._save_npz(tmp, state)
-            # the previous step dir is removed only after the new one is
-            # fully written, keeping the crash window to the rename itself
-            if os.path.exists(final):
-                shutil.rmtree(final)
-            os.replace(tmp, final)
-        finally:
+
+        def write() -> None:
+            fault_point("checkpoint.save")
+            # attempt-unique temp name: a watchdog-abandoned attempt may
+            # still be writing its tree when the retry starts — sharing
+            # one name would let the two attempts rmtree each other
+            tmp = final + f".tmp{os.getpid()}_{uuid.uuid4().hex[:8]}"
             shutil.rmtree(tmp, ignore_errors=True)
+            _live_tmps.add(tmp)
+            try:
+                if self.backend == "orbax":
+                    self._save_orbax(tmp, state)
+                else:
+                    self._save_npz(tmp, state)
+                _fsync_tree(tmp)
+                # publish via rename-aside (same pattern as io.save_frame):
+                # an existing same-step dir moves ASIDE, the new one swaps
+                # in, only then is the old deleted — rmtree-then-rename
+                # would leave NO published step if a SIGKILL landed between
+                # the two calls (exactly the emergency-save-then-grace-kill
+                # shape). A crash inside the window leaves the aside copy,
+                # healed by the next Checkpointer init or same-step save.
+                old = final + ".old"
+                if os.path.isdir(old) and not os.path.isdir(final):
+                    os.rename(old, final)  # heal a previous crashed swap
+                shutil.rmtree(old, ignore_errors=True)
+                if os.path.isdir(final):
+                    os.rename(final, old)
+                os.replace(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+                _fsync_path(self.root)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+                _live_tmps.discard(tmp)
+
+        self._io(write, f"checkpoint.save(step={step})")
         self._gc()
         return final
 
-    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
-        """Read step ``step`` (default: latest). ``like`` is a template
-        pytree (same treedef; array leaves) — required for npz round-trips
-        of non-dict pytrees and for orbax sharding restoration."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints under {self.root}")
+    def restore(
+        self,
+        step: Optional[int] = None,
+        like: Any = None,
+        verify: bool = True,
+    ) -> Any:
+        """Read step ``step`` (default: latest **intact**). ``like`` is a
+        template pytree (same treedef; array leaves) — required for npz
+        round-trips of non-dict pytrees and for orbax sharding restoration.
+
+        With ``step=None`` a corrupted/truncated newest step is logged
+        and skipped, falling back to the previous step that verifies —
+        the recovery contract a preempted trainer relies on. An explicit
+        ``step=`` raises :class:`CheckpointCorruptionError` instead (the
+        caller asked for that exact state). ``verify=False`` skips CRC
+        verification (trusted-fast path; structural errors still raise).
+        """
+        if step is not None:
+            return self._restore_step(step, like, verify)
+        return self.restore_latest(like=like, verify=verify)[1]
+
+    def restore_latest(
+        self, like: Any = None, verify: bool = True
+    ) -> tuple:
+        """Restore the newest **intact** step, falling back past
+        corrupted ones. Returns ``(step, state)`` — callers that replay
+        data deterministically (``run_resumable``) need to know which
+        step actually came back, which ``latest_step()`` cannot promise
+        once corruption enters the picture."""
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        last_err: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                return s, self._restore_step(s, like, verify)
+            except CheckpointCorruptionError as e:
+                logger.error(
+                    "checkpoint step %d failed integrity verification (%s); "
+                    "falling back to the previous step", s, e,
+                )
+                last_err = e
+        raise CheckpointCorruptionError(
+            f"no intact checkpoint under {self.root} "
+            f"({len(steps)} step(s) all failed verification)"
+        ) from last_err
+
+    def _restore_step(self, step: int, like: Any, verify: bool) -> Any:
         path = _step_dir(self.root, step)
         if not os.path.isdir(path):
             raise FileNotFoundError(f"no checkpoint at {path}")
-        # dispatch on the on-disk format, not the configured backend, so a
-        # checkpoint written where orbax was (un)available restores anywhere
-        if os.path.exists(os.path.join(path, "manifest.json")):
-            return self._restore_npz(path, like)
-        return self._restore_orbax(path, like)
+
+        def read() -> Any:
+            fault_point("checkpoint.restore")
+            # dispatch on the on-disk format, not the configured backend,
+            # so a checkpoint written where orbax was (un)available
+            # restores anywhere
+            if os.path.exists(os.path.join(path, "manifest.json")):
+                return self._restore_npz(path, like, verify)
+            try:
+                return self._restore_orbax(path, like)
+            except FileNotFoundError as e:
+                # missing orbax files count as corruption so the
+                # step=None fallback can engage. ValueError/KeyError stay
+                # caller errors (a mismatched `like` template raises them
+                # for EVERY step — sweeping past N intact checkpoints and
+                # reporting 'no intact checkpoint' would send the
+                # operator hunting disk corruption that isn't there);
+                # other OSErrors stay transient/retryable
+                raise CheckpointCorruptionError(
+                    f"orbax restore of {path} failed: {e}"
+                ) from e
+
+        return self._io(read, f"checkpoint.restore(step={step})")
+
+    # -- integrity audit ----------------------------------------------------
+
+    def verify(self, step: Optional[int] = None) -> Dict[int, Dict[str, Any]]:
+        """Audit checkpoint integrity without materializing state.
+
+        Returns ``{step: {"format", "ok", "errors"}}`` for the given step
+        (or every step). ``ok`` is True/False for npz steps; ``None`` for
+        orbax steps (no per-array manifest to check — only structural
+        presence is asserted) and legacy npz steps predating the CRC
+        manifest.
+        """
+        steps = [step] if step is not None else self.all_steps()
+        report: Dict[int, Dict[str, Any]] = {}
+        for s in steps:
+            path = _step_dir(self.root, s)
+            entry: Dict[str, Any] = {"format": None, "ok": None, "errors": []}
+            if not os.path.isdir(path):
+                entry["ok"] = False
+                entry["errors"].append(f"missing step directory {path}")
+            elif os.path.exists(os.path.join(path, "manifest.json")):
+                entry["format"] = "npz"
+                try:
+                    manifest, raws = self._io(
+                        lambda p=path: self._read_npz_payload(p),
+                        f"checkpoint.verify(step={s})",
+                    )
+                    legacy = bool(manifest) and isinstance(manifest[0], str)
+                    if legacy:
+                        entry["errors"].append(
+                            "legacy manifest (no CRC records)"
+                        )
+                    else:
+                        errs = self._crc_errors(manifest, raws)
+                        entry["errors"].extend(errs)
+                        entry["ok"] = not errs
+                except CheckpointCorruptionError as e:
+                    entry["ok"] = False
+                    entry["errors"].append(str(e))
+                except (OSError, RetryError) as e:
+                    # transient read failure (possibly after retry
+                    # exhaustion): unknown, not corrupt — the audit must
+                    # return its report, never raise
+                    entry["errors"].append(f"transient read error: {e}")
+            else:
+                entry["format"] = "orbax"
+                if not os.path.exists(os.path.join(path, "state")):
+                    entry["ok"] = False
+                    entry["errors"].append("missing orbax state directory")
+                else:
+                    entry["errors"].append(
+                        "orbax step: no per-array CRC manifest to verify"
+                    )
+            report[s] = entry
+        return report
 
     # -- orbax backend ------------------------------------------------------
 
@@ -149,6 +437,9 @@ class Checkpointer:
     def _save_npz(self, path: str, state: Any) -> None:
         # leaves are stored as raw bytes + (dtype, shape) in the manifest
         # (utils/npz.py): numpy's npz loader cannot reconstruct ml_dtypes.
+        # each entry additionally records the byte size and CRC32 of the
+        # raw payload so restore can prove the arrays it read are the
+        # arrays that were written.
         os.makedirs(path, exist_ok=True)
         flat, _ = jax.tree_util.tree_flatten_with_path(state)
         arrays = {}
@@ -156,25 +447,97 @@ class Checkpointer:
         for i, (keypath, leaf) in enumerate(flat):
             arrays[f"a{i}"], entry = encode_array(leaf)
             entry["key"] = jax.tree_util.keystr(keypath)
+            entry["nbytes"] = int(arrays[f"a{i}"].nbytes)
+            # the encoded view is contiguous uint8: crc straight off the
+            # buffer, no tobytes() copy of a possibly-multi-GB leaf
+            entry["crc32"] = zlib.crc32(arrays[f"a{i}"])
             manifest.append(entry)
         np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
 
-    def _restore_npz(self, path: str, like: Any) -> Any:
-        with open(os.path.join(path, "manifest.json")) as f:
-            manifest = json.load(f)
+    def _read_npz_payload(self, path: str):
+        """Read (manifest, {name: raw array}). Structural failures
+        (missing file, unparseable json/zip) become
+        :class:`CheckpointCorruptionError`; transient OSErrors (EIO, NFS
+        blips) propagate untouched so a configured retry policy can
+        classify and retry them instead of silently falling back to an
+        older step."""
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptionError(
+                f"unreadable manifest.json in {path}: {e}"
+            ) from e
+        try:
+            # materialize all arrays inside the context: a truncated zip
+            # member surfaces here, not lazily after we returned
+            with np.load(os.path.join(path, "arrays.npz")) as data:
+                raws = {k: data[k] for k in data.files}
+        except FileNotFoundError as e:
+            raise CheckpointCorruptionError(
+                f"missing arrays.npz in {path}: {e}"
+            ) from e
+        except OSError:
+            raise  # transient IO: retryable, not corruption
+        except Exception as e:
+            raise CheckpointCorruptionError(
+                f"unreadable arrays.npz in {path}: {e}"
+            ) from e
+        return manifest, raws
+
+    @staticmethod
+    def _crc_errors(manifest, raws) -> List[str]:
+        """Per-array integrity errors for a modern (dict-entry) manifest.
+        Entries written before the CRC format (no 'crc32' key) are
+        skipped — old checkpoints stay restorable, just unverified."""
+        errors = []
+        for i, entry in enumerate(manifest):
+            name = f"a{i}"
+            if name not in raws:
+                errors.append(f"array {name} ({entry.get('key')}) missing")
+                continue
+            raw = raws[name]
+            if "nbytes" in entry and int(raw.nbytes) != int(entry["nbytes"]):
+                errors.append(
+                    f"array {name} ({entry.get('key')}): size "
+                    f"{raw.nbytes} != manifest {entry['nbytes']} (truncated?)"
+                )
+                continue
+            if "crc32" in entry and zlib.crc32(
+                np.ascontiguousarray(raw)
+            ) != entry["crc32"]:
+                errors.append(
+                    f"array {name} ({entry.get('key')}): CRC32 mismatch"
+                )
+        return errors
+
+    def _restore_npz(self, path: str, like: Any, verify: bool = True) -> Any:
+        manifest, raws = self._read_npz_payload(path)
         legacy = bool(manifest) and isinstance(manifest[0], str)
-        with np.load(os.path.join(path, "arrays.npz")) as data:
-            leaves = []
-            for i, entry in enumerate(manifest):
-                raw = data[f"a{i}"]
-                if legacy:
-                    # pre-byte-format checkpoints stored arrays directly
-                    # (native dtypes only); keep them restorable
-                    leaves.append(raw)
-                else:
-                    leaves.append(decode_array(raw, entry))
+        if not legacy and verify:
+            errors = self._crc_errors(manifest, raws)
+            if errors:
+                raise CheckpointCorruptionError(
+                    f"{path}: " + "; ".join(errors)
+                )
+        leaves = []
+        for i, entry in enumerate(manifest):
+            try:
+                raw = raws[f"a{i}"]
+            except KeyError:
+                raise CheckpointCorruptionError(
+                    f"{path}: array a{i} missing from arrays.npz"
+                ) from None
+            if legacy:
+                # pre-byte-format checkpoints stored arrays directly
+                # (native dtypes only); keep them restorable
+                leaves.append(raw)
+            else:
+                leaves.append(decode_array(raw, entry))
         keys = manifest if legacy else [e["key"] for e in manifest]
         if like is None:
             # reconstruct as a flat {keystr: array} dict

@@ -18,6 +18,8 @@ from typing import Dict, Iterator, Iterable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from .resilience.faults import fault_point
+from .resilience.retry import RetryPolicy, retry_call
 from .utils import get_logger
 from .utils.npz import decode_array, encode_array
 
@@ -61,21 +63,42 @@ def prefetch_to_device(
     batches: Iterable,
     size: int = 2,
     sharding=None,
+    retry: Optional[RetryPolicy] = None,
+    join_timeout: float = 5.0,
 ) -> Iterator:
     """Wrap a batch iterator with background ``jax.device_put``.
 
     A worker thread stages up to ``size`` batches in HBM ahead of the
     consumer (``sharding`` optionally places them on a mesh), so transfer
-    overlaps compute. Exceptions from the source iterator propagate to the
-    consumer at the point of ``next()``.
+    overlaps compute.
+
+    Failure semantics (the input-pipeline leg of the resilience
+    subsystem): a worker exception is parked in a side slot — never
+    inside the data queue where a full buffer or a consumer drain could
+    delay or drop it — and re-raised by the consumer's very next
+    ``__next__`` once the already-staged good batches are exhausted. The
+    consumer never blocks indefinitely: it polls worker liveness, so
+    even a worker killed by a non-``Exception`` (``KeyboardInterrupt``,
+    interpreter teardown) surfaces instead of hanging the training loop.
+    Shutdown joins the worker with ``join_timeout`` and logs if it is
+    still wedged (e.g. a stuck transfer) rather than blocking teardown
+    forever. ``retry`` applies a
+    :class:`~tensorframes_tpu.resilience.RetryPolicy` to each
+    host→device transfer, absorbing transient device-put faults.
     """
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = threading.Event()
+    done = threading.Event()
+    err: List[Optional[BaseException]] = [None]
 
     def put(batch):
-        if sharding is not None:
-            return jax.device_put(batch, sharding)
-        return jax.device_put(batch)
+        def xfer():
+            fault_point("io.prefetch.device_put")
+            if sharding is not None:
+                return jax.device_put(batch, sharding)
+            return jax.device_put(batch)
+
+        return retry_call(xfer, policy=retry, describe="prefetch.device_put")
 
     def enqueue(item) -> bool:
         # bounded put that aborts when the consumer is gone, so an
@@ -94,31 +117,56 @@ def prefetch_to_device(
             for batch in batches:
                 if stop.is_set() or not enqueue(put(batch)):
                     return
-        except Exception as e:  # propagate into the consumer thread
-            enqueue(e)
-            return
-        enqueue(_SENTINEL)
+        except BaseException as e:  # parked for the consumer thread —
+            # BaseException too: a KeyboardInterrupt/SystemExit dying in
+            # the worker must surface as an error, not truncate the
+            # stream into a clean-looking end-of-data
+            err[0] = e
+        finally:
+            done.set()
+            enqueue(_SENTINEL)
 
     t = threading.Thread(target=worker, daemon=True, name="tfs-prefetch")
     t.start()
 
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=0.2)
+            except queue.Empty:
+                # nothing staged: if the worker is gone the stream is
+                # over (error or not) — without this check a worker that
+                # died before enqueueing its sentinel would hang us
+                if done.is_set() or not t.is_alive():
+                    try:
+                        item = q.get_nowait()  # racing final enqueue
+                    except queue.Empty:
+                        if err[0] is not None:
+                            raise err[0]
+                        return
+                else:
+                    continue
             if item is _SENTINEL:
+                if err[0] is not None:
+                    raise err[0]
                 return
-            if isinstance(item, Exception):
-                raise item
             yield item
     finally:
-        # consumer finished or bailed early: release the worker and drop
-        # any staged batches
+        # consumer finished or bailed early: release the worker, drop
+        # any staged batches, and bound the shutdown wait
         stop.set()
         try:
             while True:
                 q.get_nowait()
         except queue.Empty:
             pass
+        t.join(timeout=join_timeout)
+        if t.is_alive():  # pragma: no cover - requires a wedged transfer
+            logger.warning(
+                "prefetch_to_device: worker still running %.1fs after "
+                "shutdown (stuck transfer?); leaving daemon thread behind",
+                join_timeout,
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +200,7 @@ def save_frame(frame, path: str) -> None:
     import pickle
     import shutil
 
+    fault_point("io.save_frame")
     # fail BEFORE touching the filesystem: a multi-host global array
     # cannot be materialized by one process (and a partial directory
     # would be worse than an error)
@@ -241,6 +290,7 @@ def load_frame(path: str, num_blocks: Optional[int] = None):
     from .schema import ColumnInfo, Schema
     from .shape import Shape
 
+    fault_point("io.load_frame")
     path = os.path.normpath(path)
     if not os.path.isdir(path) and os.path.isdir(f"{path}.old"):
         # a save crashed inside its two-rename swap window; the previous

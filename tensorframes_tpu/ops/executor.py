@@ -29,6 +29,7 @@ import numpy as np
 from .. import dtypes as dt
 from ..config import get_config
 from ..program import Program
+from ..resilience.faults import fault_point
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -136,6 +137,7 @@ class CompiledProgram:
         to_numpy: bool = True,
         donate: bool = False,
     ) -> Dict[str, np.ndarray]:
+        fault_point("executor.run_block")
         donate = donate and donation_supported()
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
         entry = self._entry("block", self.program.fn, feeds) if self.hoist else None
@@ -159,6 +161,7 @@ class CompiledProgram:
         to_numpy: bool = True,
         donate: bool = False,
     ) -> Dict[str, np.ndarray]:
+        fault_point("executor.run_rows")
         donate = donate and donation_supported()
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
         entry = (

@@ -18,6 +18,8 @@ from typing import Optional
 
 import jax
 
+from ..resilience.faults import fault_point
+from ..resilience.retry import RetryPolicy, retry_call
 from ..utils import get_logger
 
 logger = get_logger(__name__)
@@ -30,12 +32,18 @@ def init_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[list] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> None:
     """Initialize multi-process JAX if configured (env vars or args).
 
     Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
     ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``); if neither args nor env are
     present this is a single-process no-op.
+
+    ``retry`` (a :class:`~tensorframes_tpu.resilience.RetryPolicy`)
+    re-attempts the coordinator handshake: in a preemption-restart fleet
+    the workers race the coordinator back up, and the losers must back
+    off and redial instead of dying at t=0.
     """
     global _initialized
     if _initialized:
@@ -50,12 +58,17 @@ def init_distributed(
     process_id = process_id if process_id is not None else int(
         os.environ.get("JAX_PROCESS_ID", "0")
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+
+    def connect() -> None:
+        fault_point("distributed.init")
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+
+    retry_call(connect, policy=retry, describe="distributed.init")
     _initialized = True
     logger.info(
         "init_distributed: process %d/%d via %s",

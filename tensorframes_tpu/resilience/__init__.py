@@ -1,0 +1,61 @@
+"""Resilience subsystem: fault injection, retry policies, step guards.
+
+The reference delegated every failure mode to Spark task retry/lineage
+(SURVEY.md §5: "Failure detection / elastic recovery: none in-repo"). A
+TPU-native runtime has no JVM scheduler underneath it, so this package
+re-owns those guarantees explicitly, DrJAX-style — failure semantics
+live in the host driver, not the compiled program:
+
+* :mod:`~tensorframes_tpu.resilience.faults` — a deterministic, seedable
+  fault-injection registry. Production code is instrumented with named
+  ``fault_point(site)`` hooks (executor block execution, prefetch
+  device_put, frame save/load, checkpoint save/restore, distributed
+  init); tests and drills turn faults on with the ``inject()`` context
+  manager. Zero overhead when no injection is active.
+* :mod:`~tensorframes_tpu.resilience.retry` — configurable retry
+  policies (max attempts, exponential backoff + deterministic jitter,
+  per-attempt watchdog timeout, retryable-exception classification)
+  for host-side IO and device-put paths.
+* :mod:`~tensorframes_tpu.resilience.guards` — training-step guards
+  that detect non-finite losses / states and skip the step, roll back
+  to the last good state, or raise; plugged into
+  ``training.run_resumable(guard=...)``.
+
+Checkpoint integrity (per-array CRC32 manifests, fsync-before-rename,
+corrupted-step fallback) lives in :mod:`tensorframes_tpu.checkpoint`
+and is exercised through the fault sites defined here.
+"""
+
+from __future__ import annotations
+
+from .faults import (  # noqa: F401
+    SITES,
+    active_sites,
+    fault_point,
+    inject,
+    reset,
+)
+from .guards import NonFiniteError, StepGuard, tree_all_finite  # noqa: F401
+from .retry import (  # noqa: F401
+    AttemptTimeout,
+    RetryError,
+    RetryPolicy,
+    retry_call,
+    retryable,
+)
+
+__all__ = [
+    "SITES",
+    "active_sites",
+    "fault_point",
+    "inject",
+    "reset",
+    "AttemptTimeout",
+    "RetryError",
+    "RetryPolicy",
+    "retry_call",
+    "retryable",
+    "NonFiniteError",
+    "StepGuard",
+    "tree_all_finite",
+]

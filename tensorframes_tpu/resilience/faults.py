@@ -1,0 +1,195 @@
+"""Deterministic, seedable fault injection.
+
+Production code paths declare **named sites** by calling
+:func:`fault_point` — a no-op (one falsy dict check, no lock) unless a
+test, drill, or chaos exercise has armed an injection for that site with
+:func:`inject`:
+
+    from tensorframes_tpu.resilience import faults
+
+    with faults.inject("checkpoint.save", OSError("disk wobble"), every_n=2):
+        ckpt.save(10, state)   # every 2nd save attempt raises OSError
+
+Injections fire **deterministically** (``every_n`` / ``after`` /
+``max_times`` counters) or **probabilistically but reproducibly**
+(``p=`` with a seeded PRNG), so a drill that exposed a bug replays
+bit-for-bit. The registry is process-global and thread-safe: prefetch
+workers, retry watchdogs, and the driver thread all hit the same
+counters, which is exactly what a transient-IO drill wants.
+
+Instrumented sites (the stable names; any string is accepted so layers
+can add sites without touching this module):
+
+==============================  =============================================
+site                            raised from
+==============================  =============================================
+``executor.run_block``          CompiledProgram.run_block (block execution)
+``executor.run_rows``           CompiledProgram.run_rows (vmapped execution)
+``io.prefetch.device_put``      prefetch_to_device worker (host→HBM transfer)
+``io.save_frame``               io.save_frame (frame persistence write)
+``io.load_frame``               io.load_frame (frame persistence read)
+``checkpoint.save``             Checkpointer.save (inside the retry scope)
+``checkpoint.restore``          Checkpointer restore of one step directory
+``distributed.init``            parallel.distributed.init_distributed
+==============================  =============================================
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Tuple, Union
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+#: The site names instrumented across the package (documentation +
+#: typo guard for tests; fault_point accepts arbitrary names).
+SITES: Tuple[str, ...] = (
+    "executor.run_block",
+    "executor.run_rows",
+    "io.prefetch.device_put",
+    "io.save_frame",
+    "io.load_frame",
+    "checkpoint.save",
+    "checkpoint.restore",
+    "distributed.init",
+)
+
+ErrorSpec = Union[BaseException, type]
+
+
+class Injection:
+    """One armed fault: bookkeeping for when it fires.
+
+    ``hits`` counts every time the site was reached while this injection
+    was armed; ``fired`` counts the times it actually raised — both are
+    readable after the ``with`` block for assertions.
+    """
+
+    def __init__(
+        self,
+        site: str,
+        error: ErrorSpec,
+        every_n: int = 1,
+        after: int = 0,
+        max_times: Optional[int] = None,
+        p: Optional[float] = None,
+        seed: int = 0,
+    ):
+        if every_n < 1:
+            raise ValueError(f"every_n must be >= 1, got {every_n}")
+        if p is not None and not (0.0 <= p <= 1.0):
+            raise ValueError(f"p must be in [0, 1], got {p}")
+        self.site = site
+        self.error = error
+        self.every_n = every_n
+        self.after = after
+        self.max_times = max_times
+        self.p = p
+        self._rng = random.Random(seed)
+        self.hits = 0
+        self.fired = 0
+
+    def _should_fire(self) -> bool:
+        # caller holds the registry lock
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.max_times is not None and self.fired >= self.max_times:
+            return False
+        if self.p is not None:
+            fire = self._rng.random() < self.p
+        else:
+            fire = (self.hits - self.after) % self.every_n == 0
+        if fire:
+            self.fired += 1
+        return fire
+
+    def make_error(self) -> BaseException:
+        err = self.error
+        if isinstance(err, BaseException):
+            return err
+        return err(f"injected fault at {self.site!r}")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"Injection({self.site!r}, {self.error!r}, every_n={self.every_n}, "
+            f"hits={self.hits}, fired={self.fired})"
+        )
+
+
+_lock = threading.Lock()
+_registry: Dict[str, List[Injection]] = {}
+
+
+def fault_point(site: str) -> None:
+    """Instrumentation hook: raise if an armed injection elects to fire.
+
+    The un-armed fast path is a single truthiness check on a module
+    dict — cheap enough for per-block call sites.
+    """
+    if not _registry:
+        return
+    with _lock:
+        injections = _registry.get(site)
+        if not injections:
+            return
+        err = None
+        for inj in injections:
+            if inj._should_fire():
+                err = inj.make_error()
+                break
+    if err is not None:
+        logger.debug("fault_point(%s): raising injected %r", site, err)
+        raise err
+
+
+@contextmanager
+def inject(
+    site: str,
+    error: ErrorSpec = RuntimeError,
+    every_n: int = 1,
+    after: int = 0,
+    max_times: Optional[int] = None,
+    p: Optional[float] = None,
+    seed: int = 0,
+) -> Iterator[Injection]:
+    """Arm a fault at ``site`` for the duration of the ``with`` block.
+
+    ``error`` is an exception instance (raised as-is, same object every
+    firing) or class (instantiated per firing). Deterministic schedule:
+    skip the first ``after`` hits, then fire every ``every_n``-th hit,
+    at most ``max_times`` times. Alternatively ``p=``/``seed=`` fires
+    with probability ``p`` from a dedicated seeded PRNG — reproducible
+    chaos. Yields the :class:`Injection` for hit/fire assertions.
+    """
+    inj = Injection(
+        site, error, every_n=every_n, after=after, max_times=max_times,
+        p=p, seed=seed,
+    )
+    with _lock:
+        _registry.setdefault(site, []).append(inj)
+    try:
+        yield inj
+    finally:
+        with _lock:
+            lst = _registry.get(site, [])
+            if inj in lst:
+                lst.remove(inj)
+            if not lst:
+                _registry.pop(site, None)
+
+
+def active_sites() -> Tuple[str, ...]:
+    """Site names with at least one armed injection (drill introspection)."""
+    with _lock:
+        return tuple(sorted(_registry))
+
+
+def reset() -> None:
+    """Disarm everything (test hygiene after a failed drill)."""
+    with _lock:
+        _registry.clear()

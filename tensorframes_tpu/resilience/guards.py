@@ -1,0 +1,193 @@
+"""Training-step guards: detect non-finite losses/states and recover.
+
+A diverged step on TPU does not crash — it silently poisons every
+parameter with NaN and the run burns accelerator-hours producing
+garbage. :class:`StepGuard` is the host-side tripwire: after each
+``step_fn`` the driver hands it the candidate state and metrics, and it
+either admits the update, **skips** it (keep the pre-step state),
+**rolls back** to the last known-good snapshot, or **raises**
+:class:`NonFiniteError`. ``training.run_resumable(guard=...)`` wires it
+into the loop; pass a policy string (``"skip"`` / ``"rollback"`` /
+``"raise"``) or a configured instance.
+
+The finiteness check materializes float leaves to host, which
+synchronizes the device stream — that is the price of detection. Use
+``check="metrics"`` to inspect only the (small) metrics pytree when the
+loss alone is a good enough canary, or ``every_n`` to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+_POLICIES = ("raise", "skip", "rollback")
+_CHECKS = ("metrics", "state", "both")
+
+
+class NonFiniteError(FloatingPointError):
+    """A training step produced NaN/Inf and the guard policy is to stop."""
+
+
+def _array_finite(arr: np.ndarray) -> bool:
+    if arr.dtype == object:
+        return True
+    if np.issubdtype(arr.dtype, np.floating) or np.issubdtype(
+        arr.dtype, np.complexfloating
+    ):
+        return bool(np.isfinite(arr).all())
+    if arr.dtype.kind == "V":  # ml_dtypes (bfloat16, float8…)
+        return bool(np.isfinite(arr.astype(np.float32)).all())
+    return True  # ints/bools vacuously finite
+
+
+def tree_all_finite(tree: Any) -> bool:
+    """True when every floating/complex leaf of ``tree`` is finite.
+
+    Integer, bool and non-array leaves pass vacuously. Device arrays are
+    pulled to host (synchronizing) — call this off the step's critical
+    path or accept the sync. Multi-host global arrays are checked over
+    THIS process's addressable shards (no single process can materialize
+    the global array; NaN spreads through the collectives, so a local
+    check still trips). Materialization failures propagate — a guard
+    that silently treats an uncheckable leaf as finite is no guard.
+    """
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            for shard in leaf.addressable_shards:
+                if not _array_finite(np.asarray(shard.data)):
+                    return False
+            continue
+        try:
+            arr = np.asarray(leaf)
+        except (TypeError, ValueError):
+            continue  # genuinely non-array leaf (e.g. a string metric)
+        if not _array_finite(arr):
+            return False
+    return True
+
+
+class StepGuard:
+    """Admission control for training-step updates.
+
+    ``policy``:
+
+    * ``"raise"`` — any non-finite step raises :class:`NonFiniteError`.
+    * ``"skip"`` — discard the bad update, keep the pre-step state, and
+      keep consuming batches (a poison batch costs one step, not a run).
+    * ``"rollback"`` — revert to the last admitted-good snapshot (jax
+      arrays are immutable, so snapshots are reference-kept, not
+      copied). With ``snapshot_every > 1`` the snapshot may trail by up
+      to that many steps — cheaper bookkeeping, coarser recovery.
+
+    ``max_consecutive`` bad steps escalate to :class:`NonFiniteError`
+    under every policy: a persistently-diverged run must stop, not spin.
+    ``check`` selects what is inspected (``"metrics"``, ``"state"``, or
+    ``"both"``); ``every_n`` inspects only every n-th step.
+
+    Counters (``admitted``, ``skipped``, ``rollbacks``) are public for
+    drills and ``on_step`` telemetry.
+    """
+
+    def __init__(
+        self,
+        policy: str = "rollback",
+        check: str = "both",
+        max_consecutive: int = 10,
+        snapshot_every: int = 1,
+        every_n: int = 1,
+    ):
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        if check not in _CHECKS:
+            raise ValueError(f"check must be one of {_CHECKS}, got {check!r}")
+        if max_consecutive < 1:
+            raise ValueError("max_consecutive must be >= 1")
+        if snapshot_every < 1 or every_n < 1:
+            raise ValueError("snapshot_every and every_n must be >= 1")
+        self.policy = policy
+        self.check = check
+        self.max_consecutive = max_consecutive
+        self.snapshot_every = snapshot_every
+        self.every_n = every_n
+        self.admitted = 0
+        self.skipped = 0
+        self.rollbacks = 0
+        self._bad_streak = 0
+        self._good_state: Any = None
+        self._good_step: Optional[int] = None
+
+    @classmethod
+    def coerce(cls, guard) -> "StepGuard":
+        """``"skip"`` → ``StepGuard(policy="skip")``; instances pass through."""
+        if isinstance(guard, cls):
+            return guard
+        if isinstance(guard, str):
+            return cls(policy=guard)
+        raise TypeError(
+            f"guard must be a StepGuard or policy string {_POLICIES}, "
+            f"got {type(guard).__name__}"
+        )
+
+    def seed(self, step: int, state: Any) -> None:
+        """Register a known-good baseline (the restored checkpoint), so a
+        rollback before the first admitted step has somewhere to land."""
+        self._good_state = state
+        self._good_step = step
+
+    def _is_bad(self, state: Any, metrics: Any) -> bool:
+        if self.check in ("metrics", "both") and not tree_all_finite(metrics):
+            return True
+        if self.check in ("state", "both") and not tree_all_finite(state):
+            return True
+        return False
+
+    def admit(
+        self, step: int, new_state: Any, metrics: Any, prev_state: Any
+    ) -> Tuple[Any, bool]:
+        """Inspect the candidate update for step ``step``.
+
+        Returns ``(state_to_continue_with, admitted)``. Raises
+        :class:`NonFiniteError` under the ``"raise"`` policy or after
+        ``max_consecutive`` bad steps.
+        """
+        if self.every_n > 1 and step % self.every_n != 0:
+            self.admitted += 1
+            return new_state, True
+        if not self._is_bad(new_state, metrics):
+            self.admitted += 1
+            self._bad_streak = 0
+            if self.policy == "rollback" and step % self.snapshot_every == 0:
+                self._good_state = new_state
+                self._good_step = step
+            return new_state, True
+
+        self._bad_streak += 1
+        if self.policy == "raise" or self._bad_streak >= self.max_consecutive:
+            raise NonFiniteError(
+                f"non-finite loss/state at step {step} "
+                f"({self._bad_streak} consecutive; policy={self.policy!r})"
+            )
+        if self.policy == "skip":
+            self.skipped += 1
+            logger.warning(
+                "StepGuard: non-finite step %d skipped (streak %d/%d)",
+                step, self._bad_streak, self.max_consecutive,
+            )
+            return prev_state, False
+        # rollback
+        self.rollbacks += 1
+        target = self._good_state if self._good_state is not None else prev_state
+        logger.warning(
+            "StepGuard: non-finite step %d rolled back to step %s "
+            "(streak %d/%d)",
+            step, self._good_step, self._bad_streak, self.max_consecutive,
+        )
+        return target, False

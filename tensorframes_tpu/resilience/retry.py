@@ -1,0 +1,175 @@
+"""Retry policies for host-side IO and device-put paths.
+
+The reference rode on Spark's task retry; here the host driver owns the
+policy. :class:`RetryPolicy` is a small value object — attempt budget,
+exponential backoff with deterministic jitter, optional per-attempt
+watchdog timeout, and a retryable-exception classification — and
+:func:`retry_call` / :func:`retryable` apply it to any callable.
+
+The watchdog timeout runs the attempt on a daemon worker thread and
+abandons it when the deadline passes (Python cannot safely interrupt an
+arbitrary blocked call); the abandoned attempt may still complete in the
+background, so callers must only guard **idempotent** operations with a
+timeout — exactly the checkpoint-write / device-transfer / filesystem
+calls this package wires it into.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from functools import wraps
+from typing import Callable, Optional, Tuple, Type
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class AttemptTimeout(TimeoutError):
+    """A single attempt exceeded the policy's per-attempt timeout."""
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted; ``__cause__`` is the last failure."""
+
+
+#: Exceptions that are transient by default: filesystem/network wobble
+#: and watchdog timeouts. Everything else (ValueError, corruption
+#: errors, …) is a real bug and must propagate on the first attempt.
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    OSError,
+    ConnectionError,
+    TimeoutError,
+)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How to retry a transient failure.
+
+    ``backoff`` is the first sleep; attempt *k* sleeps
+    ``min(backoff * 2**(k-1), backoff_max) * (1 + U[0, jitter))``. With
+    the default ``seed=None`` the jitter PRNG seeds from OS entropy per
+    call, so a fleet of workers sharing one policy gets **decorrelated**
+    backoff (no thundering herd on the coordinator redial). Pass an
+    explicit ``seed`` for deterministic drill schedules. ``timeout``
+    (seconds) arms the per-attempt watchdog; ``None`` disables it.
+    """
+
+    max_attempts: int = 3
+    backoff: float = 0.05
+    backoff_max: float = 2.0
+    jitter: float = 0.25
+    timeout: Optional[float] = None
+    retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.backoff < 0 or self.backoff_max < 0 or self.jitter < 0:
+            raise ValueError("backoff, backoff_max and jitter must be >= 0")
+
+    def delay(self, attempt: int, rng) -> float:
+        """Sleep before retry number ``attempt`` (1-based)."""
+        base = min(self.backoff * (2.0 ** (attempt - 1)), self.backoff_max)
+        return base * (1.0 + rng.random() * self.jitter)
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retryable)
+
+
+def _run_with_watchdog(fn: Callable, args, kwargs, timeout: float):
+    """Run ``fn`` on a worker thread; raise :class:`AttemptTimeout` if it
+    outlives ``timeout`` seconds (the attempt is abandoned, not killed)."""
+    outcome: dict = {}
+    done = threading.Event()
+
+    def attempt():
+        try:
+            outcome["value"] = fn(*args, **kwargs)
+        except BaseException as e:  # re-raised on the caller thread
+            outcome["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=attempt, daemon=True, name="tfs-retry-attempt")
+    t.start()
+    if not done.wait(timeout):
+        raise AttemptTimeout(
+            f"attempt still running after {timeout:.3g}s (abandoned)"
+        )
+    if "error" in outcome:
+        raise outcome["error"]
+    return outcome["value"]
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: Optional[RetryPolicy] = None,
+    describe: Optional[str] = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` under ``policy``.
+
+    ``policy=None`` means **no retries** — a plain call — so call sites
+    can thread an optional policy straight through without branching
+    (and a user who never opted in can never get surprise re-execution).
+    Retryable failures (per ``policy.retryable``) are logged, backed off
+    and re-attempted; non-retryable ones propagate immediately. When the
+    attempt budget runs out, :class:`RetryError` raises ``from`` the last
+    failure. ``on_retry(attempt, exc)`` observes each scheduled retry
+    (drill hooks / metrics).
+    """
+    import random
+
+    if policy is None:
+        return fn(*args, **kwargs)
+    rng = random.Random(policy.seed)
+    name = describe or getattr(fn, "__qualname__", repr(fn))
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            if policy.timeout is not None:
+                return _run_with_watchdog(fn, args, kwargs, policy.timeout)
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            if not policy.is_retryable(e):
+                raise
+            last = e
+            if attempt == policy.max_attempts:
+                break
+            delay = policy.delay(attempt, rng)
+            logger.warning(
+                "retry %s: attempt %d/%d failed (%s: %s); retrying in %.3fs",
+                name, attempt, policy.max_attempts, type(e).__name__, e, delay,
+            )
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delay > 0:
+                time.sleep(delay)
+    raise RetryError(
+        f"{name}: all {policy.max_attempts} attempts failed"
+    ) from last
+
+
+def retryable(policy: Optional[RetryPolicy] = None, **policy_kwargs):
+    """Decorator form: ``@retryable(max_attempts=5)`` or
+    ``@retryable(policy)``. The wrapped function keeps its signature."""
+    if policy is not None and policy_kwargs:
+        raise ValueError("pass either a RetryPolicy or keyword fields, not both")
+    pol = policy or RetryPolicy(**policy_kwargs)
+
+    def deco(fn: Callable) -> Callable:
+        @wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, policy=pol, **kwargs)
+
+        wrapped.retry_policy = pol  # type: ignore[attr-defined]
+        return wrapped
+
+    return deco

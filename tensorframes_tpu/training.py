@@ -10,13 +10,17 @@ training loop, not per-task retry.
 ``run_resumable`` wraps a jitted step function with periodic
 checkpointing (Checkpointer) and resume-on-restart: a relaunched process
 calls it with the same arguments and continues from the last saved step.
+A ``guard=`` policy (resilience subsystem) additionally detects
+non-finite losses/states and skips, rolls back, or aborts — the NaN
+tripwire the silent-divergence failure mode needs.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Optional, Tuple
+from typing import Any, Callable, Iterable, Optional, Tuple, Union
 
 from .checkpoint import Checkpointer
+from .resilience.guards import StepGuard
 from .utils import get_logger
 
 logger = get_logger(__name__)
@@ -31,6 +35,8 @@ def run_resumable(
     save_every: int = 100,
     on_step: Optional[Callable[[int, Any], None]] = None,
     skip_consumed: bool = True,
+    guard: Optional[Union[StepGuard, str]] = None,
+    resume_from: Optional[Tuple[int, Any]] = None,
 ) -> Tuple[Any, int]:
     """Run up to ``num_steps`` of ``state, metrics = step_fn(state, batch)``,
     checkpointing every ``save_every`` steps and resuming from the latest
@@ -41,14 +47,35 @@ def run_resumable(
     already completed per the checkpoint are skipped so the data order
     stays deterministic across preemptions. Returns (final_state,
     steps_run_in_this_process).
+
+    Failure handling: a best-effort checkpoint is written on normal loop
+    exit AND before an uncaught exception propagates, so a crash between
+    ``save_every`` boundaries loses at most the in-flight step
+    (``save_every=0`` disables only the periodic saves). ``guard`` — a
+    :class:`~tensorframes_tpu.resilience.StepGuard` or one of its policy
+    strings (``"skip"`` / ``"rollback"`` / ``"raise"``) — inspects every
+    update for non-finite losses/states and recovers per its policy; the
+    restored checkpoint seeds its rollback baseline.
     """
+    if guard is not None:
+        guard = StepGuard.coerce(guard)
     start_step = 0
     state = init_state
-    latest = checkpointer.latest_step()
-    if latest is not None:
-        state = checkpointer.restore(step=latest, like=init_state)
-        start_step = latest
+    if resume_from is not None:
+        # the caller already restored (train_on_frame does, so it can
+        # position its iterator to the step that actually loaded without
+        # a second full checkpoint read)
+        start_step, state = resume_from
+        logger.info("run_resumable: resuming from step %d (caller-restored)",
+                    start_step)
+    elif checkpointer.latest_step() is not None:
+        # restore_latest, not restore(step=latest): a step torn by the
+        # previous crash must fall back to the prior intact one, and the
+        # batch replay below must skip to the step that actually loaded
+        start_step, state = checkpointer.restore_latest(like=init_state)
         logger.info("run_resumable: resuming from step %d", start_step)
+    if guard is not None:
+        guard.seed(start_step, state)
     if start_step >= num_steps:
         return state, 0  # already complete: don't touch the iterator
 
@@ -75,7 +102,15 @@ def run_resumable(
                 batch = next(it)
             except StopIteration:
                 break
-            state, metrics = step_fn(state, batch)
+            candidate, metrics = step_fn(state, batch)
+            if guard is not None:
+                # admit BEFORE committing to `state`: if the guard
+                # raises, `state` still holds the last good pytree, so
+                # the emergency checkpoint below cannot persist NaNs
+                candidate, _admitted = guard.admit(
+                    step + 1, candidate, metrics, prev_state=state
+                )
+            state = candidate
             step += 1
             ran += 1
             if on_step is not None:
@@ -84,14 +119,18 @@ def run_resumable(
                 checkpointer.save(step, state)
     except BaseException:
         # best-effort barrier checkpoint on the way down (preemption
-        # SIGTERM arrives as an exception in most launchers)
+        # SIGTERM arrives as an exception in most launchers): save
+        # BEFORE re-raising so the relaunch resumes at the crash point
         try:
             checkpointer.save(step, state)
             logger.warning("run_resumable: saved emergency checkpoint @ %d", step)
         except Exception:  # pragma: no cover
             logger.exception("run_resumable: emergency checkpoint failed")
         raise
-    if save_every and step % save_every != 0 and ran:
+    # best-effort final checkpoint on loop exit — also when periodic
+    # saves are disabled (save_every=0), so a later relaunch never
+    # replays completed work
+    if ran and (not save_every or step % save_every != 0):
         checkpointer.save(step, state)
     return state, ran
 
@@ -193,6 +232,7 @@ def train_on_frame(
     seed: int = 0,
     prefetch: int = 2,
     on_step: Optional[Callable[[int, Any], None]] = None,
+    guard: Optional[Union[StepGuard, str]] = None,
 ) -> Tuple[Any, int]:
     """Train straight off a frame: epoch-cycling minibatches from the
     frame's columns (reshuffled per epoch), background host→device
@@ -205,7 +245,10 @@ def train_on_frame(
     Batches are uniform (the per-epoch remainder is dropped) so one XLA
     executable serves every step. ``on_step(i, metrics)`` receives the
     GLOBAL step index — after a resume it continues from the checkpoint
-    (e.g. 701), matching ``run_resumable``.
+    (e.g. 701), matching ``run_resumable``. ``guard`` is forwarded to
+    :func:`run_resumable` (non-finite-step detection; requires a
+    ``checkpointer`` only for the resume leg — without one the guard
+    still runs in the plain loop below).
     """
     import itertools
 
@@ -227,10 +270,17 @@ def train_on_frame(
     raw = batches()
     try:
         if checkpointer is not None:
-            # fast-forward the replay HOST-SIDE before the prefetch wrapper
-            # exists, so resume never pays device transfers for batches it
-            # only discards
-            latest = checkpointer.latest_step() or 0
+            # restore FIRST (restore_latest falls back past corrupted
+            # steps and reports the step that actually loaded), then
+            # fast-forward the replay HOST-SIDE to exactly that step —
+            # before the prefetch wrapper exists, so resume never pays
+            # device transfers for batches it only discards, and the
+            # skip count can never desynchronize from the restored state
+            resume = None
+            latest = 0
+            if checkpointer.latest_step() is not None:
+                latest, restored = checkpointer.restore_latest(like=init_state)
+                resume = (latest, restored)
             for _ in itertools.islice(raw, min(latest, num_steps)):
                 pass
             stream = (
@@ -245,13 +295,21 @@ def train_on_frame(
                 save_every=save_every,
                 on_step=on_step,
                 skip_consumed=False,
+                guard=guard,
+                resume_from=resume,
             )
+        if guard is not None:
+            guard = StepGuard.coerce(guard)
+            guard.seed(0, init_state)
         stream = prefetch_to_device(raw, size=prefetch) if prefetch else raw
         state = init_state
         ran = 0
         for batch in itertools.islice(stream, num_steps):
+            prev_state = state
             state, metrics = step_fn(state, batch)
             ran += 1
+            if guard is not None:
+                state, _ = guard.admit(ran, state, metrics, prev_state=prev_state)
             if on_step is not None:
                 on_step(ran, metrics)
         return state, ran

@@ -1,0 +1,149 @@
+"""Crash/resume integration: a training subprocess is killed with
+SIGKILL mid-loop (the real preemption shape — no atexit, no exception
+path, no emergency checkpoint), relaunched, and must converge to exactly
+the state an uninterrupted run produces. This is the end-to-end proof of
+the checkpoint subsystem's atomicity+fsync+fallback story: whatever
+instant the KILL lands — including mid-``save`` — the relaunch finds an
+intact step and replays deterministically."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# the trainer run as a subprocess: float32 multiply-accumulate steps so
+# replay order matters (a wrong resume point changes the result bits)
+_TRAINER = """
+import os, sys, time
+ckdir, num_steps, sleep_s, save_every = (
+    sys.argv[1], int(sys.argv[2]), float(sys.argv[3]), int(sys.argv[4])
+)
+import jax.numpy as jnp
+import numpy as np
+from tensorframes_tpu.checkpoint import Checkpointer
+from tensorframes_tpu.training import run_resumable
+
+def step(state, batch):
+    time.sleep(sleep_s)   # slow the loop so SIGKILL lands mid-run
+    new = {"w": state["w"] * jnp.float32(1.01) + batch}
+    return new, {"loss": new["w"].sum()}
+
+batches = [jnp.full((4,), float(i % 7), jnp.float32) for i in range(num_steps)]
+init = {"w": jnp.zeros((4,), jnp.float32)}
+state, ran = run_resumable(
+    step, init, Checkpointer(ckdir, backend="npz"), batches,
+    num_steps=num_steps, save_every=save_every,
+)
+np.save(os.path.join(ckdir, "final.npy"), np.asarray(state["w"]))
+print("DONE", ran, flush=True)
+"""
+
+
+def _spawn(ckdir: str, num_steps: int, sleep_s: float, save_every: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-c", _TRAINER, ckdir, str(num_steps),
+         str(sleep_s), str(save_every)],
+        env=env, cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _wait_for_checkpoint(proc, ckdir: str, min_step: int, timeout: float = 180.0):
+    """Block until a step_>=min_step dir exists; fail fast if the trainer
+    exits first (its stderr is the diagnosis)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        steps = [
+            int(n.split("_")[1]) for n in os.listdir(ckdir)
+            if n.startswith("step_") and ".tmp" not in n
+        ]
+        if steps and max(steps) >= min_step:
+            return max(steps)
+        if proc.poll() is not None:
+            out, err = proc.communicate()
+            raise AssertionError(
+                f"trainer exited (rc={proc.returncode}) before writing a "
+                f"checkpoint >= {min_step}\nstdout: {out}\nstderr: {err}"
+            )
+        time.sleep(0.01)
+    proc.kill()
+    raise AssertionError(f"no checkpoint >= {min_step} within {timeout}s")
+
+
+def _run_to_completion(ckdir: str, num_steps: int, save_every: int,
+                       timeout: float = 300.0) -> np.ndarray:
+    proc = _spawn(ckdir, num_steps, 0.0, save_every)
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, f"trainer failed\nstdout: {out}\nstderr: {err}"
+    assert "DONE" in out
+    return np.load(os.path.join(ckdir, "final.npy"))
+
+
+def _reference(num_steps: int) -> np.ndarray:
+    import jax.numpy as jnp
+
+    w = jnp.zeros((4,), jnp.float32)
+    for i in range(num_steps):
+        w = w * jnp.float32(1.01) + jnp.full((4,), float(i % 7), jnp.float32)
+    return np.asarray(w)
+
+
+def test_kill9_mid_training_resumes_to_identical_state(tmp_path):
+    """Single-kill fast variant (tier-1): SIGKILL after the first
+    checkpoint lands, relaunch, final state bit-identical to an
+    uninterrupted run."""
+    ckdir = str(tmp_path / "run")
+    os.makedirs(ckdir)
+    num_steps, save_every = 60, 2
+    proc = _spawn(ckdir, num_steps, 0.05, save_every)
+    try:
+        killed_at = _wait_for_checkpoint(proc, ckdir, min_step=save_every)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:  # pragma: no cover - cleanup on test bugs
+            proc.kill()
+    assert killed_at < num_steps  # genuinely mid-run
+    assert not os.path.exists(os.path.join(ckdir, "final.npy"))
+
+    final = _run_to_completion(ckdir, num_steps, save_every)
+    np.testing.assert_array_equal(final, _reference(num_steps))
+
+
+@pytest.mark.slow
+def test_repeated_kill9_still_converges(tmp_path):
+    """Three consecutive preemptions at whatever instants the scheduler
+    deals — including possibly mid-save — then a clean finish; the result
+    must still match the uninterrupted run exactly."""
+    ckdir = str(tmp_path / "run")
+    os.makedirs(ckdir)
+    num_steps, save_every = 80, 2
+    for round_ in range(3):
+        proc = _spawn(ckdir, num_steps, 0.04, save_every)
+        try:
+            prev = [
+                int(n.split("_")[1]) for n in os.listdir(ckdir)
+                if n.startswith("step_") and ".tmp" not in n
+            ]
+            target = (max(prev) if prev else 0) + save_every
+            if target >= num_steps:
+                proc.kill()
+                break
+            _wait_for_checkpoint(proc, ckdir, min_step=target)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:  # pragma: no cover
+                proc.kill()
+    final = _run_to_completion(ckdir, num_steps, save_every)
+    np.testing.assert_array_equal(final, _reference(num_steps))

@@ -188,3 +188,16 @@ def test_relational_pipeline_example():
     for (gc, gs), (wc, ws) in zip(out["top"], want):
         assert gc == wc
         assert abs(gs - ws) < 0.1
+
+
+def test_fault_injection_example(capsys):
+    """All three resilience drills in the example recover (transient IO
+    faults absorbed, poison batch skipped, torn checkpoint fallback)."""
+    from examples import fault_injection
+
+    fault_injection.main()
+    out = capsys.readouterr().out
+    assert "all drills recovered" in out
+    assert "all absorbed" in out
+    assert "final state finite = True" in out
+    assert "fell back" in out

@@ -113,6 +113,51 @@ def test_iterate_batches_rejects_empty_selection():
         list(tfio.iterate_batches(_frame(4), columns=[]))
 
 
+def test_prefetch_source_raises_mid_stream():
+    """Regression: a batch iterator failing MID-stream must surface to
+    the consumer promptly — every staged good batch is delivered, then
+    the very next ``__next__`` re-raises instead of draining silently or
+    hanging."""
+    def bad_source():
+        for i in range(3):
+            yield {"x": np.full(2, float(i))}
+        raise RuntimeError("disk died at batch 3")
+
+    it = tfio.prefetch_to_device(bad_source(), size=8)
+    got = []
+    with pytest.raises(RuntimeError, match="disk died"):
+        for b in it:
+            got.append(float(np.asarray(b["x"])[0]))
+    assert got == [0.0, 1.0, 2.0]  # nothing lost, nothing extra
+
+
+def test_prefetch_immediate_source_failure():
+    def dead_source():
+        raise OSError("no such dataset")
+        yield  # pragma: no cover
+
+    it = tfio.prefetch_to_device(dead_source(), size=2)
+    with pytest.raises(OSError, match="no such dataset"):
+        next(it)
+
+
+def test_prefetch_shutdown_join_is_bounded():
+    """close() must return promptly even while the worker is between
+    batches (bounded join, not an unbounded wait)."""
+    import time
+
+    def slow_source():
+        for i in range(100):
+            time.sleep(0.05)
+            yield {"x": np.zeros(2)}
+
+    it = tfio.prefetch_to_device(slow_source(), size=2, join_timeout=2.0)
+    next(it)
+    t0 = time.time()
+    it.close()
+    assert time.time() - t0 < 3.0
+
+
 # ---------------------------------------------------------------------------
 # Frame persistence
 # ---------------------------------------------------------------------------
