@@ -57,7 +57,7 @@ def _record_mfu(name: str, program, rows_per_sec: float, n_rows: int) -> None:
                 n_rows / rows_per_sec,
                 rows=n_rows,
                 flops=fpr * n_rows,
-                bytes=bpr * n_rows,
+                bytes_accessed=bpr * n_rows,
             )
     except Exception as e:  # cost model unavailable on some backends
         print(f"# mfu accounting unavailable for {name}: {e}")
@@ -1190,6 +1190,18 @@ def main():
     ]
     for ln in mfu_rows:
         print(f"# mfu | {ln}")
+
+    # observability snapshot: the run's jit-cache hit/miss + compile
+    # counts (and any retry/guard/prefetch activity) ride along in
+    # BENCH_*.json rounds as comment lines, so a rows/sec movement can
+    # be cross-read against recompile behavior from the record alone
+    try:
+        from tensorframes_tpu.observability.metrics import REGISTRY
+
+        for ln in REGISTRY.summary_lines():
+            print(f"# obs | {ln}")
+    except Exception as e:  # never let telemetry kill the JSON line
+        print(f"# obs | snapshot unavailable: {e}")
 
     # The published baseline is full-scale-on-TPU (BASELINE.json). The
     # ratio is only meaningful TPU-vs-TPU: a CPU fallback run uses a

@@ -82,7 +82,19 @@ run_step "Install check (package metadata + import from install target)" \
   env PYTHONPATH="$SITE" python -c "import tensorframes_tpu, importlib.metadata as md; print('installed', md.version('tensorframes-tpu'))"
 
 run_step "Test (8-device virtual CPU mesh)" \
-  python -m pytest tests/ -x -q
+  env TFTPU_OBS_EXPORT="$WORK/obs" python -m pytest tests/ -x -q
+
+# ci.yml's observability smoke: the telemetry example must produce all
+# three artifacts (Chrome trace, metrics JSONL, step log) and the tier-1
+# run above must have exported its own pair
+run_step "Observability smoke (telemetry example + artifact check)" bash -c "
+  env TFTPU_OBS_EXPORT='$WORK/obs' python -m examples.telemetry &&
+  test -s '$WORK/obs/trace.json' &&
+  test -s '$WORK/obs/metrics.jsonl' &&
+  test -s '$WORK/obs/steps.jsonl' &&
+  test -s '$WORK/obs/tier1_metrics.jsonl' &&
+  test -s '$WORK/obs/tier1_trace.json'
+"
 
 run_step "Resilience drill (kill–resume, corrupted restore, fault injection)" \
   bash "$CLONE/dev/resilience_drill.sh"

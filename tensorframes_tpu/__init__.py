@@ -113,6 +113,8 @@ from .io import (  # noqa: E402,F401
     write_parquet,
 )
 from .utils import profiling  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
+from .observability import StepTelemetry  # noqa: E402,F401
 
 __version__ = "0.3.0"
 
@@ -147,6 +149,8 @@ __all__ = [
     "StepGuard",
     "run_resumable",
     "profiling",
+    "observability",
+    "StepTelemetry",
     "io",
     "save_frame",
     "load_frame",

@@ -46,15 +46,44 @@ import uuid
 import zlib
 from typing import Any, Dict, List, Optional
 
+import time
+
 import jax
 import numpy as np
 
+from .observability import events as _events
+from .observability.metrics import counter as _counter
+from .observability.metrics import histogram as _histogram
 from .resilience.faults import fault_point
 from .resilience.retry import RetryError, RetryPolicy, retry_call
 from .utils import get_logger
 from .utils.npz import decode_array, encode_array
 
 logger = get_logger(__name__)
+
+# Checkpoint-leg telemetry (registered at import). Durations cover the
+# full save/restore including retries; bytes count the payload actually
+# written/read; CRC failures count per-restore/verify detections — the
+# number that turns "restore fell back" from a log line into a graph.
+_SAVE_SECONDS = _histogram(
+    "tftpu_checkpoint_save_seconds", "Checkpointer.save wall-clock"
+)
+_RESTORE_SECONDS = _histogram(
+    "tftpu_checkpoint_restore_seconds",
+    "Checkpointer restore wall-clock (per step dir attempted)",
+)
+_SAVE_BYTES = _counter(
+    "tftpu_checkpoint_save_bytes_total",
+    "Bytes published to checkpoint step directories",
+)
+_RESTORE_BYTES = _counter(
+    "tftpu_checkpoint_restore_bytes_total",
+    "Raw array bytes read back from checkpoint payloads",
+)
+_CRC_FAILURES = _counter(
+    "tftpu_checkpoint_crc_failures_total",
+    "Steps whose CRC/size verification found corruption",
+)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_RE = re.compile(r"^step_\d+\.tmp(\d+)")
@@ -281,7 +310,24 @@ class Checkpointer:
                 shutil.rmtree(tmp, ignore_errors=True)
                 _live_tmps.discard(tmp)
 
+        t0 = time.perf_counter()
         self._io(write, f"checkpoint.save(step={step})")
+        dt = time.perf_counter() - t0
+        _SAVE_SECONDS.observe(dt)
+        try:
+            nbytes = sum(
+                os.path.getsize(os.path.join(dirpath, f))
+                for dirpath, _dirs, files in os.walk(final)
+                for f in files
+            )
+            _SAVE_BYTES.inc(nbytes)
+        except OSError:  # pragma: no cover - racing GC on the step dir
+            nbytes = -1
+        if _events.TRACER.enabled:
+            _events.TRACER.emit_complete(
+                "checkpoint.save", t0, dt,
+                args={"step": step, "bytes": nbytes}, cat="checkpoint",
+            )
         self._gc()
         return final
 
@@ -358,7 +404,23 @@ class Checkpointer:
                     f"orbax restore of {path} failed: {e}"
                 ) from e
 
-        return self._io(read, f"checkpoint.restore(step={step})")
+        # observe in finally: the interesting restores (corruption
+        # fallback sweeps, retry exhaustion) are the ones that raise,
+        # and they must still land in the histogram and on the timeline
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            out = self._io(read, f"checkpoint.restore(step={step})")
+            ok = True
+            return out
+        finally:
+            dt = time.perf_counter() - t0
+            _RESTORE_SECONDS.observe(dt)
+            if _events.TRACER.enabled:
+                _events.TRACER.emit_complete(
+                    "checkpoint.restore", t0, dt,
+                    args={"step": step, "ok": ok}, cat="checkpoint",
+                )
 
     # -- integrity audit ----------------------------------------------------
 
@@ -393,6 +455,8 @@ class Checkpointer:
                         )
                     else:
                         errs = self._crc_errors(manifest, raws)
+                        if errs:
+                            _CRC_FAILURES.inc()
                         entry["errors"].extend(errs)
                         entry["ok"] = not errs
                 except CheckpointCorruptionError as e:
@@ -465,10 +529,16 @@ class Checkpointer:
         blips) propagate untouched so a configured retry policy can
         classify and retry them instead of silently falling back to an
         older step."""
+        # _CRC_FAILURES counts each npz-payload integrity DETECTION (here
+        # and in _restore_npz's CRC/missing-array checks) — not every
+        # CheckpointCorruptionError construction, which would over-count
+        # restore_latest's no-intact-checkpoint summary raise and count
+        # orbax structural wrappers as "CRC" failures
         try:
             with open(os.path.join(path, "manifest.json")) as f:
                 manifest = json.load(f)
         except (FileNotFoundError, json.JSONDecodeError) as e:
+            _CRC_FAILURES.inc()
             raise CheckpointCorruptionError(
                 f"unreadable manifest.json in {path}: {e}"
             ) from e
@@ -478,12 +548,14 @@ class Checkpointer:
             with np.load(os.path.join(path, "arrays.npz")) as data:
                 raws = {k: data[k] for k in data.files}
         except FileNotFoundError as e:
+            _CRC_FAILURES.inc()
             raise CheckpointCorruptionError(
                 f"missing arrays.npz in {path}: {e}"
             ) from e
         except OSError:
             raise  # transient IO: retryable, not corruption
         except Exception as e:
+            _CRC_FAILURES.inc()
             raise CheckpointCorruptionError(
                 f"unreadable arrays.npz in {path}: {e}"
             ) from e
@@ -517,10 +589,12 @@ class Checkpointer:
 
     def _restore_npz(self, path: str, like: Any, verify: bool = True) -> Any:
         manifest, raws = self._read_npz_payload(path)
+        _RESTORE_BYTES.inc(sum(int(r.nbytes) for r in raws.values()))
         legacy = bool(manifest) and isinstance(manifest[0], str)
         if not legacy and verify:
             errors = self._crc_errors(manifest, raws)
             if errors:
+                _CRC_FAILURES.inc()
                 raise CheckpointCorruptionError(
                     f"{path}: " + "; ".join(errors)
                 )
@@ -529,6 +603,7 @@ class Checkpointer:
             try:
                 raw = raws[f"a{i}"]
             except KeyError:
+                _CRC_FAILURES.inc()
                 raise CheckpointCorruptionError(
                     f"{path}: array a{i} missing from arrays.npz"
                 ) from None

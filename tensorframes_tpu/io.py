@@ -13,17 +13,43 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Dict, Iterator, Iterable, List, Optional, Sequence
 
 import jax
 import numpy as np
 
+from .observability.metrics import counter as _counter
+from .observability.metrics import gauge as _gauge
+from .observability.metrics import histogram as _histogram
 from .resilience.faults import fault_point
 from .resilience.retry import RetryPolicy, retry_call
 from .utils import get_logger
 from .utils.npz import decode_array, encode_array
 
 logger = get_logger(__name__)
+
+# Prefetch pipeline telemetry (registered at import; see
+# observability/metrics.py). The two wait histograms are the overlap
+# diagnostic: a consumer that never waits is compute-bound (prefetch is
+# doing its job); a producer that never waits means the buffer is too
+# small or the loader too slow.
+_PREFETCH_DEPTH = _gauge(
+    "tftpu_prefetch_queue_depth",
+    "Batches currently staged in the prefetch buffer",
+)
+_PREFETCH_BATCHES = _counter(
+    "tftpu_prefetch_batches_total",
+    "Batches delivered to the consumer by prefetch_to_device",
+)
+_PRODUCER_WAIT = _histogram(
+    "tftpu_prefetch_producer_wait_seconds",
+    "Time the prefetch worker blocked waiting for buffer space",
+)
+_CONSUMER_WAIT = _histogram(
+    "tftpu_prefetch_consumer_wait_seconds",
+    "Time the consumer blocked waiting for a staged batch",
+)
 
 
 def iterate_batches(
@@ -104,9 +130,13 @@ def prefetch_to_device(
         # bounded put that aborts when the consumer is gone, so an
         # abandoned iterator can't pin the worker (and its staged HBM
         # buffers) forever
+        t0 = time.perf_counter()
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                if item is not _SENTINEL:
+                    _PRODUCER_WAIT.observe(time.perf_counter() - t0)
+                    _PREFETCH_DEPTH.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -130,6 +160,10 @@ def prefetch_to_device(
     t.start()
 
     try:
+        # wait_t0 spans every empty poll until the next item lands (and
+        # is re-armed after each yield resumes), so the histogram records
+        # true per-batch consumer stall, not just the last 0.2s slice
+        wait_t0 = time.perf_counter()
         while True:
             try:
                 item = q.get(timeout=0.2)
@@ -150,16 +184,24 @@ def prefetch_to_device(
                 if err[0] is not None:
                     raise err[0]
                 return
+            _CONSUMER_WAIT.observe(time.perf_counter() - wait_t0)
+            _PREFETCH_DEPTH.set(q.qsize())
+            _PREFETCH_BATCHES.inc()
             yield item
+            wait_t0 = time.perf_counter()
     finally:
         # consumer finished or bailed early: release the worker, drop
-        # any staged batches, and bound the shutdown wait
+        # any staged batches, and bound the shutdown wait. The depth
+        # gauge goes to 0 here — a finished stream must not export
+        # phantom staged batches (the sentinel, or batches a bailing
+        # consumer abandoned) in an end-of-run snapshot
         stop.set()
         try:
             while True:
                 q.get_nowait()
         except queue.Empty:
             pass
+        _PREFETCH_DEPTH.set(0)
         t.join(timeout=join_timeout)
         if t.is_alive():  # pragma: no cover - requires a wedged transfer
             logger.warning(

@@ -1,0 +1,68 @@
+"""Observability subsystem: structured tracing, metrics, exporters.
+
+PR 1's resilience layer (retries, NaN guards, verified checkpoints) ran
+blind — no counter recorded a retry attempt, a guard trip, or a cache
+miss. This package gives every layer first-class telemetry, the way
+DrJAX instruments its MapReduce primitives for scale debugging: a perf
+or reliability claim without exported numbers is a vibe.
+
+* :mod:`~tensorframes_tpu.observability.events` — structured event
+  tracer (nested spans, instants, monotonic µs timestamps, thread ids)
+  exporting Chrome ``trace_event`` JSON for Perfetto /
+  ``chrome://tracing``; layered on top of the ``utils/profiling.py``
+  span aggregates (every profiling span lands on the timeline when
+  tracing is enabled).
+* :mod:`~tensorframes_tpu.observability.metrics` — process-wide
+  registry of named counters / gauges / fixed-bucket histograms with
+  JSONL snapshot export, Prometheus text exposition
+  (``to_prometheus()``), and a ``metrics_server(port)`` scrape
+  endpoint.
+* :mod:`~tensorframes_tpu.observability.steps` — ``StepTelemetry``, the
+  per-step training callback (step time, loss, rows/s → registry +
+  JSONL step log + trace), wired into
+  ``training.run_resumable(telemetry=...)`` / ``train_on_frame``.
+
+Instrumented out of the box: ``ops/executor.py`` (jit-cache hits /
+misses, first-compile seconds, bucket-padding waste rows), ``io.py``
+prefetch (queue depth, producer/consumer waits), ``checkpoint.py``
+(save/restore seconds + bytes, CRC failures), ``resilience/`` (retry
+attempts / exhaustions / backoff seconds, guard trips by policy, fault
+injections fired), and the training loops. All instruments register at
+import time, so an exposition always carries the full catalog — an
+idle counter reads 0 instead of vanishing.
+"""
+
+from __future__ import annotations
+
+from . import events  # noqa: F401
+from . import metrics  # noqa: F401
+from .events import TRACER, Tracer  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+    metrics_server,
+)
+from .steps import StepTelemetry  # noqa: F401
+
+__all__ = [
+    "events",
+    "metrics",
+    "Tracer",
+    "TRACER",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "counter",
+    "gauge",
+    "histogram",
+    "metrics_server",
+    "StepTelemetry",
+]

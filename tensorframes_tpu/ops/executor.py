@@ -20,6 +20,7 @@ accounting SURVEY.md §7 hard-part 1 calls for.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,11 +29,38 @@ import numpy as np
 
 from .. import dtypes as dt
 from ..config import get_config
+from ..observability import events as _events
+from ..observability.metrics import counter as _counter
+from ..observability.metrics import histogram as _histogram
 from ..program import Program
 from ..resilience.faults import fault_point
 from ..utils import get_logger
 
 logger = get_logger(__name__)
+
+# Registered at import so the exposition always carries the executor
+# family (a cold cache reads hits=0, it does not vanish). "Hit" means
+# this CompiledProgram has already dispatched this exact feed-shape key;
+# a miss's first dispatch wall-clock (trace + XLA compile + run) lands
+# in the compile-seconds histogram — the honest recompile accounting
+# SURVEY §7 hard-part 1 asks for, now exported instead of only
+# introspectable via cache_sizes().
+_JIT_HITS = _counter(
+    "tftpu_executor_jit_cache_hits_total",
+    "Block/row dispatches whose feed-shape key was already compiled",
+)
+_JIT_MISSES = _counter(
+    "tftpu_executor_jit_cache_misses_total",
+    "Block/row dispatches that triggered a fresh trace+compile",
+)
+_COMPILE_SECONDS = _histogram(
+    "tftpu_executor_compile_seconds",
+    "Wall-clock of first dispatch per feed-shape key (trace + compile + run)",
+)
+_PADDING_WASTE = _counter(
+    "tftpu_executor_padding_waste_rows_total",
+    "Rows added by bucket padding of the vmapped lead dim",
+)
 
 
 def donation_supported() -> bool:
@@ -75,6 +103,7 @@ def pad_lead_dim(
     to ``n`` rows by the caller)."""
     if target == n:
         return feeds
+    _PADDING_WASTE.inc(target - n)
     out = {}
     for k, v in feeds.items():
         v = np.asarray(v)
@@ -114,11 +143,33 @@ class CompiledProgram:
         self._jit_block_donate = None
         self._jit_vmap_donate = None
         self._hoisted: Dict[Tuple, object] = {}
+        # feed-shape keys already dispatched at least once, per entry
+        # kind — the basis of the exported jit-cache hit/miss counters
+        # (mirrors what XLA's own cache will decide, without reaching
+        # into jax internals on the hot path)
+        self._dispatched: set = set()
 
-    def _entry(self, kind: str, fn, feeds):
-        key = (kind,) + tuple(
+    @staticmethod
+    def _feeds_key(kind: str, feeds) -> Tuple:
+        return (kind,) + tuple(
             sorted((k, np.shape(v), str(v.dtype)) for k, v in feeds.items())
         )
+
+    def _note_dispatch(self, key: Tuple, donate: bool) -> bool:
+        """Count a cache hit or miss for this dispatch; True on miss.
+        ``donate`` is part of the dispatch identity — the donating
+        variants compile through separate jitted callables, so a first
+        donate=True call at a known shape is still a fresh compile."""
+        if donate:
+            key = key + ("donate",)
+        if key in self._dispatched:
+            _JIT_HITS.inc()
+            return False
+        self._dispatched.add(key)
+        _JIT_MISSES.inc()
+        return True
+
+    def _entry(self, key: Tuple, fn, feeds):
         entry = self._hoisted.get(key)
         if entry is None:
             try:
@@ -140,7 +191,14 @@ class CompiledProgram:
         fault_point("executor.run_block")
         donate = donate and donation_supported()
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-        entry = self._entry("block", self.program.fn, feeds) if self.hoist else None
+        key = self._feeds_key("block", feeds)
+        # NOTE: the hoisted entry is keyed WITHOUT donate (one
+        # HoistedProgram serves both; donation is a call-time argument),
+        # while the hit/miss identity includes it (plain-path donate
+        # variants are separate compiles)
+        fresh = self._note_dispatch(key, donate)
+        t0 = time.perf_counter()
+        entry = self._entry(key, self.program.fn, feeds) if self.hoist else None
         if entry:
             out = entry(feeds, donate=donate)
         elif donate:
@@ -151,6 +209,14 @@ class CompiledProgram:
             out = self._jit_block_donate(feeds)
         else:
             out = self.jit_block(feeds)
+        dt = time.perf_counter() - t0
+        if fresh:
+            _COMPILE_SECONDS.observe(dt)
+        if _events.TRACER.enabled:
+            _events.TRACER.emit_complete(
+                "executor.run_block", t0, dt,
+                args={"compiled": fresh}, cat="executor",
+            )
         if not to_numpy:
             return out  # stay in HBM: sharded frames chain without transfers
         return {k: np.asarray(v) for k, v in out.items()}
@@ -164,8 +230,11 @@ class CompiledProgram:
         fault_point("executor.run_rows")
         donate = donate and donation_supported()
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        key = self._feeds_key("vmap", feeds)
+        fresh = self._note_dispatch(key, donate)
+        t0 = time.perf_counter()
         entry = (
-            self._entry("vmap", jax.vmap(self.program.fn), feeds)
+            self._entry(key, jax.vmap(self.program.fn), feeds)
             if self.hoist
             else None
         )
@@ -179,6 +248,14 @@ class CompiledProgram:
             out = self._jit_vmap_donate(feeds)
         else:
             out = self.jit_vmap(feeds)
+        dt = time.perf_counter() - t0
+        if fresh:
+            _COMPILE_SECONDS.observe(dt)
+        if _events.TRACER.enabled:
+            _events.TRACER.emit_complete(
+                "executor.run_rows", t0, dt,
+                args={"compiled": fresh}, cat="executor",
+            )
         if not to_numpy:
             return out
         return {k: np.asarray(v) for k, v in out.items()}

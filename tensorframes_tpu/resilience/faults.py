@@ -41,9 +41,15 @@ import threading
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Tuple, Union
 
+from ..observability.metrics import counter as _counter
 from ..utils import get_logger
 
 logger = get_logger(__name__)
+
+_INJECTIONS_FIRED = _counter(
+    "tftpu_fault_injections_fired_total",
+    "Armed fault injections that actually raised at a fault_point",
+)
 
 #: The site names instrumented across the package (documentation +
 #: typo guard for tests; fault_point accepts arbitrary names).
@@ -143,6 +149,7 @@ def fault_point(site: str) -> None:
                 err = inj.make_error()
                 break
     if err is not None:
+        _INJECTIONS_FIRED.inc()
         logger.debug("fault_point(%s): raising injected %r", site, err)
         raise err
 

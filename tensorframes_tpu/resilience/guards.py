@@ -22,12 +22,25 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from ..observability.metrics import counter as _counter
 from ..utils import get_logger
 
 logger = get_logger(__name__)
 
 _POLICIES = ("raise", "skip", "rollback")
 _CHECKS = ("metrics", "state", "both")
+
+# One trip counter per policy, pre-registered so the exposition always
+# carries all three series (a run that never tripped reads 0 everywhere
+# instead of omitting the family a dashboard alerts on).
+_TRIP_COUNTERS = {
+    p: _counter(
+        "tftpu_guard_trips_total",
+        "Non-finite training steps caught by StepGuard, by policy",
+        labels={"policy": p},
+    )
+    for p in _POLICIES
+}
 
 
 class NonFiniteError(FloatingPointError):
@@ -170,6 +183,7 @@ class StepGuard:
             return new_state, True
 
         self._bad_streak += 1
+        _TRIP_COUNTERS[self.policy].inc()
         if self.policy == "raise" or self._bad_streak >= self.max_consecutive:
             raise NonFiniteError(
                 f"non-finite loss/state at step {step} "

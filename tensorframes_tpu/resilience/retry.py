@@ -22,9 +22,28 @@ from dataclasses import dataclass
 from functools import wraps
 from typing import Callable, Optional, Tuple, Type
 
+from ..observability.metrics import counter as _counter
 from ..utils import get_logger
 
 logger = get_logger(__name__)
+
+# Retry telemetry (registered at import so an exposition always carries
+# the family): attempts counts each failed-then-rescheduled attempt,
+# exhaustions each RetryError, backoff-seconds the total sleep the
+# policy injected — retries that silently absorb a flaky disk are now
+# a graph, not a debug log.
+_RETRY_ATTEMPTS = _counter(
+    "tftpu_retry_attempts_total",
+    "Failed attempts that were backed off and rescheduled",
+)
+_RETRY_EXHAUSTIONS = _counter(
+    "tftpu_retry_exhaustions_total",
+    "retry_call budgets exhausted (RetryError raised)",
+)
+_RETRY_BACKOFF_SECONDS = _counter(
+    "tftpu_retry_backoff_seconds_total",
+    "Total backoff sleep injected between retry attempts",
+)
 
 
 class AttemptTimeout(TimeoutError):
@@ -144,6 +163,8 @@ def retry_call(
             if attempt == policy.max_attempts:
                 break
             delay = policy.delay(attempt, rng)
+            _RETRY_ATTEMPTS.inc()
+            _RETRY_BACKOFF_SECONDS.inc(delay)
             logger.warning(
                 "retry %s: attempt %d/%d failed (%s: %s); retrying in %.3fs",
                 name, attempt, policy.max_attempts, type(e).__name__, e, delay,
@@ -152,6 +173,7 @@ def retry_call(
                 on_retry(attempt, e)
             if delay > 0:
                 time.sleep(delay)
+    _RETRY_EXHAUSTIONS.inc()
     raise RetryError(
         f"{name}: all {policy.max_attempts} attempts failed"
     ) from last

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable, Optional, Tuple, Union
 
 from .checkpoint import Checkpointer
+from .observability.steps import StepTelemetry
 from .resilience.guards import StepGuard
 from .utils import get_logger
 
@@ -37,6 +38,7 @@ def run_resumable(
     skip_consumed: bool = True,
     guard: Optional[Union[StepGuard, str]] = None,
     resume_from: Optional[Tuple[int, Any]] = None,
+    telemetry: Optional[StepTelemetry] = None,
 ) -> Tuple[Any, int]:
     """Run up to ``num_steps`` of ``state, metrics = step_fn(state, batch)``,
     checkpointing every ``save_every`` steps and resuming from the latest
@@ -55,7 +57,11 @@ def run_resumable(
     :class:`~tensorframes_tpu.resilience.StepGuard` or one of its policy
     strings (``"skip"`` / ``"rollback"`` / ``"raise"``) — inspects every
     update for non-finite losses/states and recovers per its policy; the
-    restored checkpoint seeds its rollback baseline.
+    restored checkpoint seeds its rollback baseline. ``telemetry`` — a
+    :class:`~tensorframes_tpu.observability.StepTelemetry` — records
+    per-step time/loss/rows-per-sec to the metrics registry, a JSONL
+    step log, and (when tracing is enabled) the event timeline; it runs
+    after ``on_step``, with the same (global step, metrics) arguments.
     """
     if guard is not None:
         guard = StepGuard.coerce(guard)
@@ -115,6 +121,8 @@ def run_resumable(
             ran += 1
             if on_step is not None:
                 on_step(step, metrics)
+            if telemetry is not None:
+                telemetry(step, metrics)
             if save_every and step % save_every == 0:
                 checkpointer.save(step, state)
     except BaseException:
@@ -233,6 +241,7 @@ def train_on_frame(
     prefetch: int = 2,
     on_step: Optional[Callable[[int, Any], None]] = None,
     guard: Optional[Union[StepGuard, str]] = None,
+    telemetry: Optional[StepTelemetry] = None,
 ) -> Tuple[Any, int]:
     """Train straight off a frame: epoch-cycling minibatches from the
     frame's columns (reshuffled per epoch), background host→device
@@ -248,11 +257,17 @@ def train_on_frame(
     (e.g. 701), matching ``run_resumable``. ``guard`` is forwarded to
     :func:`run_resumable` (non-finite-step detection; requires a
     ``checkpointer`` only for the resume leg — without one the guard
-    still runs in the plain loop below).
+    still runs in the plain loop below). ``telemetry`` — a
+    :class:`~tensorframes_tpu.observability.StepTelemetry` — records
+    per-step time/loss/rows-per-sec; its ``rows_per_step`` is filled in
+    from ``batch_size`` when unset, so rows/s works out of the box.
     """
     import itertools
 
     from .io import iterate_batches, prefetch_to_device
+
+    if telemetry is not None and telemetry.rows_per_step is None:
+        telemetry.rows_per_step = batch_size
 
     def batches():
         epoch = 0
@@ -297,6 +312,7 @@ def train_on_frame(
                 skip_consumed=False,
                 guard=guard,
                 resume_from=resume,
+                telemetry=telemetry,
             )
         if guard is not None:
             guard = StepGuard.coerce(guard)
@@ -312,6 +328,8 @@ def train_on_frame(
                 state, _ = guard.admit(ran, state, metrics, prev_state=prev_state)
             if on_step is not None:
                 on_step(ran, metrics)
+            if telemetry is not None:
+                telemetry(ran, metrics)
         return state, ran
     finally:
         # the epoch stream is infinite: close it (and the prefetch

@@ -1,3 +1,3 @@
-from .logging import get_logger
+from .logging import clear_level, get_logger, set_level
 
-__all__ = ["get_logger"]
+__all__ = ["clear_level", "get_logger", "set_level"]

@@ -22,11 +22,29 @@ import contextlib
 import dataclasses
 import threading
 import time
+import warnings
 from typing import Dict, Iterator, Optional
 
 from .logging import get_logger
 
 logger = get_logger(__name__)
+
+
+_events_mod = None
+
+
+def _trace_events():
+    """The structured event tracer (observability/events.py), imported
+    lazily (then cached) to keep utils free of package-level import
+    edges. Spans land on the Chrome-trace timeline whenever tracing is
+    enabled — the aggregate table here and the timeline there come from
+    the same instrumentation points."""
+    global _events_mod
+    if _events_mod is None:
+        from ..observability import events
+
+        _events_mod = events
+    return _events_mod
 
 
 @dataclasses.dataclass
@@ -56,7 +74,9 @@ _stats: Dict[str, SpanStats] = {}
 
 @contextlib.contextmanager
 def span(name: str, rows: int = 0) -> Iterator[None]:
-    """Accumulate wall-clock (and optional row count) under ``name``."""
+    """Accumulate wall-clock (and optional row count) under ``name``.
+    When structured tracing is enabled (``observability.events``), the
+    span also lands on the Chrome-trace timeline as a complete event."""
     t0 = time.perf_counter()
     try:
         yield
@@ -67,6 +87,12 @@ def span(name: str, rows: int = 0) -> Iterator[None]:
             s.calls += 1
             s.seconds += dt
             s.rows += rows
+        ev = _trace_events()
+        if ev.TRACER.enabled:
+            ev.TRACER.emit_complete(
+                name, t0, dt, args={"rows": rows} if rows else None,
+                cat="profiling",
+            )
 
 
 def record(
@@ -74,20 +100,52 @@ def record(
     seconds: float,
     rows: int = 0,
     flops: float = 0.0,
-    bytes: float = 0.0,
+    bytes_accessed: Optional[float] = None,
+    **kwargs: float,
 ) -> None:
     """Directly accumulate one measurement (for code that times itself).
-    ``flops``/``bytes`` let callers attach XLA cost-model counts (e.g.
-    from ``Program.flops_per_row``/``bytes_per_row``) so :func:`report`
-    can print achieved FLOP/s, HBM GB/s, and — when ``config.peak_flops``
-    is set — MFU."""
+    ``flops``/``bytes_accessed`` let callers attach XLA cost-model
+    counts (e.g. from ``Program.flops_per_row``/``bytes_per_row``) so
+    :func:`report` can print achieved FLOP/s, HBM GB/s, and — when
+    ``config.peak_flops`` is set — MFU.
+
+    ``bytes=`` is the deprecated spelling of ``bytes_accessed`` (it
+    shadowed the builtin); accepted for one release with a
+    DeprecationWarning."""
+    if "bytes" in kwargs:
+        warnings.warn(
+            "profiling.record(bytes=...) is deprecated; use "
+            "bytes_accessed= (the old name shadowed the builtin)",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        if bytes_accessed is not None:
+            raise TypeError(
+                "record() got both bytes_accessed= and deprecated bytes="
+            )
+        bytes_accessed = kwargs.pop("bytes")
+    if kwargs:
+        raise TypeError(
+            f"record() got unexpected keyword arguments {sorted(kwargs)}"
+        )
+    if bytes_accessed is None:
+        bytes_accessed = 0.0
     with _lock:
         s = _stats.setdefault(name, SpanStats())
         s.calls += 1
         s.seconds += seconds
         s.rows += rows
         s.flops += flops
-        s.bytes += bytes
+        s.bytes += bytes_accessed
+    ev = _trace_events()
+    if ev.TRACER.enabled:
+        # callers record immediately after timing (the verbs do
+        # ``record(name, perf_counter() - t0, ...)``), so "it just
+        # ended" reconstructs the start closely enough for a timeline
+        ev.TRACER.emit_complete(
+            name, time.perf_counter() - seconds, seconds,
+            args={"rows": rows} if rows else None, cat="profiling",
+        )
 
 
 def metrics() -> Dict[str, SpanStats]:

@@ -31,6 +31,33 @@ assert len(jax.devices()) >= 8, (
 import pytest  # noqa: E402
 
 
+def pytest_sessionstart(session):
+    """TFTPU_OBS_EXPORT=<dir>: arm the structured tracer for the whole
+    suite so the session-end export (below) carries a real timeline —
+    CI uploads the pair as its observability artifact."""
+    if os.environ.get("TFTPU_OBS_EXPORT"):
+        from tensorframes_tpu.observability import events
+
+        events.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Write the suite's metrics snapshot (JSONL) + Chrome trace into
+    $TFTPU_OBS_EXPORT. Best-effort: telemetry export must never turn a
+    green suite red."""
+    out = os.environ.get("TFTPU_OBS_EXPORT")
+    if not out:
+        return
+    try:
+        from tensorframes_tpu.observability import REGISTRY, events
+
+        os.makedirs(out, exist_ok=True)
+        REGISTRY.write_jsonl(os.path.join(out, "tier1_metrics.jsonl"))
+        events.save(os.path.join(out, "tier1_trace.json"))
+    except Exception as e:  # pragma: no cover - diagnostic path
+        print(f"TFTPU_OBS_EXPORT failed: {e}")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_graph():
     """Graph-state hygiene: every test runs in a fresh naming context
