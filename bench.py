@@ -1203,6 +1203,33 @@ def main():
     except Exception as e:  # never let telemetry kill the JSON line
         print(f"# obs | snapshot unavailable: {e}")
 
+    # static-analysis posture of a benched program (ISSUE 3): lint the
+    # logreg scoring program (config 3's fixture — cheap to rebuild, and
+    # the lint is tracing-only so it never compiles or dispatches) and
+    # record diagnostic counts by severity, so BENCH rounds carry lint
+    # posture next to throughput
+    try:
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu.analysis import lint_program
+        from tensorframes_tpu.models import logreg as _logreg
+
+        x_a, _ = _logreg.make_synthetic_mnist(64)
+        a_frame = tfs.frame_from_arrays({"features": x_a})
+        a_scoring = _logreg.scoring_program(_logreg.init_params())
+        a_prog = tfs.compile_program(
+            lambda features: a_scoring(features), a_frame
+        )
+        a_rep = lint_program(a_prog, subject="bench.logreg")
+        a_counts = a_rep.counts_by_severity()
+        codes = sorted({d.code for d in a_rep}) or ["-"]
+        print(
+            "# analysis | bench.logreg "
+            f"errors={a_counts['error']} warnings={a_counts['warn']} "
+            f"info={a_counts['info']} codes={','.join(codes)}"
+        )
+    except Exception as e:  # never let lint kill the JSON line
+        print(f"# analysis | unavailable: {e}")
+
     # The published baseline is full-scale-on-TPU (BASELINE.json). The
     # ratio is only meaningful TPU-vs-TPU: a CPU fallback run uses a
     # shrunken model, so it carries the recorded TPU baseline alongside

@@ -75,6 +75,19 @@ cd "$CLONE"
 run_step "py311 static gate (the 3.11-leg stand-in that CAN run here)" \
   bash "$CLONE/dev/py311_check.sh"
 
+# ci.yml's lint job. ruff is pip-installed on real runners; the
+# zero-egress image may not carry it — the repo self-lint and the
+# program analyzer (both stdlib + baked-in jax) always run.
+if command -v ruff >/dev/null 2>&1; then
+  run_step "Lint: ruff (correctness rules)" ruff check .
+else
+  echo "=== step: Lint: ruff — SKIPPED (ruff not in zero-egress image; runs on real CI)" | tee -a "$LOG"
+fi
+run_step "Lint: repo self-lint (dev/lint_rules.py)" \
+  python "$CLONE/dev/lint_rules.py"
+run_step "Lint: static program diagnostics (examples, strict)" \
+  python -m tensorframes_tpu.analysis --demo --strict --explain
+
 run_step "Install (clean-clone package, --no-deps: zero-egress image carries deps)" \
   python -m pip install . --no-deps --no-build-isolation --quiet --target "$SITE"
 
@@ -93,7 +106,8 @@ run_step "Observability smoke (telemetry example + artifact check)" bash -c "
   test -s '$WORK/obs/metrics.jsonl' &&
   test -s '$WORK/obs/steps.jsonl' &&
   test -s '$WORK/obs/tier1_metrics.jsonl' &&
-  test -s '$WORK/obs/tier1_trace.json'
+  test -s '$WORK/obs/tier1_trace.json' &&
+  test -f '$WORK/obs/tier1_diagnostics.jsonl'
 "
 
 run_step "Resilience drill (kill–resume, corrupted restore, fault injection)" \

@@ -19,7 +19,6 @@ import os
 import socket
 import subprocess
 import sys
-import tempfile
 
 
 def worker_main(coordinator: str, num_processes: int, process_id: int) -> None:

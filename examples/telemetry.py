@@ -27,7 +27,6 @@ import os
 import tempfile
 
 import jax
-import numpy as np
 import optax
 
 import tensorframes_tpu as tfs

@@ -88,7 +88,9 @@ from .graphdef import (  # noqa: E402,F401
     program_from_graphdef,
 )
 from .bundle import restore_variables  # noqa: E402,F401
-from .validation import ValidationError  # noqa: E402,F401
+from .validation import StaticAnalysisError, ValidationError  # noqa: E402,F401
+from . import analysis  # noqa: E402,F401
+from .analysis import analyze_frame, lint_program  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
     aggregate,
     compile_program,
@@ -198,4 +200,9 @@ __all__ = [
     "save_program",
     "load_program",
     "ValidationError",
+    # static analysis (tfguard)
+    "analysis",
+    "analyze_frame",
+    "lint_program",
+    "StaticAnalysisError",
 ]

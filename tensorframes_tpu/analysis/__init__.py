@@ -1,0 +1,36 @@
+"""tfguard: pre-execution static diagnostics over captured Programs.
+
+The TPU-native stack validates "will it run" before execution
+(:mod:`tensorframes_tpu.validation`, ≙ the reference's
+``SchemaTransforms``); this package answers "will it run *well*" —
+statically, from the captured jaxpr + specs, before the first
+(expensive) XLA compile. See docs/analysis.md for the rule catalog.
+
+Surfaces:
+
+* :func:`lint_program` / ``Program.lint()`` — lint one program;
+* :func:`analyze_frame` — lint fetches against a frame, normalized
+  exactly as the verbs would run them;
+* ``python -m tensorframes_tpu.analysis`` — lint serialized StableHLO
+  bundles (CLI);
+* ``strict=True`` on the verbs — raise
+  :class:`~tensorframes_tpu.validation.StaticAnalysisError` on any
+  error-severity diagnostic before dispatch.
+"""
+
+from .analyzer import analyze_frame, lint_program  # noqa: F401
+from .diagnostics import (  # noqa: F401
+    CODES,
+    Diagnostic,
+    DiagnosticReport,
+    save_jsonl,
+)
+
+__all__ = [
+    "CODES",
+    "Diagnostic",
+    "DiagnosticReport",
+    "analyze_frame",
+    "lint_program",
+    "save_jsonl",
+]

@@ -1,0 +1,7 @@
+"""Entry point: ``python -m tensorframes_tpu.analysis``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,165 @@
+"""The pre-execution static pass: trace once, run the rule catalog.
+
+``lint_program(program)`` traces the program's function to a jaxpr with
+``jax.make_jaxpr`` against abstract inputs (the same probe substitution
+:func:`~tensorframes_tpu.program.analyze_program` uses) and hands the
+result to every rule in :mod:`.rules`. **No execution, no XLA compile,
+no device transfer** — tracing builds avals only, which is why a lint
+of any program leaves the executor's jit-cache and compile-seconds
+metrics untouched (the acceptance check in tests/test_analysis.py).
+
+Three surfaces share this pass:
+
+* ``program.lint(...)`` / ``lint_program(program, ...)`` — the API;
+* ``analyze_frame(frame, fetches, ...)`` — lints fetches *against a
+  frame* (schema-normalized exactly as the verbs would run them, plus
+  frame-level context such as distinct block shapes — without forcing
+  a lazy frame);
+* ``python -m tensorframes_tpu.analysis`` — lints serialized StableHLO
+  bundles from disk (see :mod:`.cli`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from .diagnostics import DiagnosticReport
+from .rules import RuleContext, run_rules
+
+__all__ = ["lint_program", "analyze_frame"]
+
+
+def _trace(program, probe: int):
+    """Trace ``program.fn`` at abstract probe inputs. Returns
+    (closed_jaxpr, in_names, in_avals, out_names, out_avals, error);
+    on failure everything except the error is empty and spec-level
+    rules still run."""
+    import jax
+
+    from ..program import _abstract_inputs
+
+    abstract = _abstract_inputs(program.inputs, probe)
+    try:
+        closed, out_shape = jax.make_jaxpr(program.fn, return_shape=True)(
+            abstract
+        )
+    except Exception as e:
+        return None, (), (), (), (), e
+    in_paths = jax.tree_util.tree_flatten_with_path(abstract)[0]
+    in_names = [_path_leaf_name(p) for p, _ in in_paths]
+    in_avals = [leaf for _, leaf in in_paths]
+    out_paths = jax.tree_util.tree_flatten_with_path(out_shape)[0]
+    out_names = [_path_leaf_name(p) for p, _ in out_paths]
+    out_avals = [leaf for _, leaf in out_paths]
+    return closed, in_names, in_avals, out_names, out_avals, None
+
+
+def _path_leaf_name(path) -> str:
+    """Render one pytree path to the dict key users named the tensor."""
+    if not path:
+        return "<out>"
+    last = path[-1]
+    key = getattr(last, "key", None)
+    if key is None:
+        key = getattr(last, "idx", None)
+    return str(key) if key is not None else str(last)
+
+
+def _effective_program(program):
+    """Mirror the verb path's x64 demotion: lint traces the program at
+    the same (possibly demoted) input dtypes the executor will feed."""
+    from .. import dtypes as dt
+    from ..program import Program, TensorSpec
+
+    if not dt.demotion_active():
+        return program
+    if all(dt.demote(s.dtype) is s.dtype for s in program.inputs):
+        return program
+    demoted = [
+        TensorSpec(s.name, dt.demote(s.dtype), s.shape)
+        for s in program.inputs
+    ]
+    eff = Program(program.fn, demoted, program.outputs or None,
+                  fetch_order=program.fetch_order)
+    eff._cost_cache = getattr(program, "_cost_cache", None) or {}
+    return eff
+
+
+def lint_program(
+    program,
+    probe: int = 8,
+    rules: Optional[Sequence[str]] = None,
+    block_mode: Optional[bool] = None,
+    block_row_counts: Optional[Tuple[int, ...]] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    subject: str = "",
+) -> DiagnosticReport:
+    """Statically lint a :class:`~tensorframes_tpu.program.Program`.
+
+    ``rules`` selects diagnostic codes (default: all). ``probe``
+    substitutes Unknown dims for the trace (≙ analyze_program).
+    ``hbm_budget_bytes`` overrides the device budget for TFG106 (by
+    default the first device's reported ``bytes_limit``; the rule is
+    silent when the backend reports none, as XLA:CPU does).
+    """
+    eff = _effective_program(program)
+    closed, in_names, in_avals, out_names, out_avals, err = _trace(eff, probe)
+    ctx = RuleContext(
+        program=eff,
+        probe=probe,
+        closed=closed,
+        in_names=in_names,
+        in_avals=in_avals,
+        out_names=out_names,
+        out_avals=out_avals,
+        block_mode=block_mode,
+        block_row_counts=block_row_counts,
+        hbm_budget_bytes=hbm_budget_bytes,
+        trace_error=err,
+    )
+    diags = run_rules(ctx, codes=rules)
+    return DiagnosticReport(
+        diags,
+        subject=subject or f"Program(inputs={[s.name for s in program.inputs]})",
+    )
+
+
+def analyze_frame(
+    frame,
+    fetches,
+    block: bool = True,
+    feed_dict=None,
+    reduce_mode: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    probe: int = 8,
+    hbm_budget_bytes: Optional[int] = None,
+) -> DiagnosticReport:
+    """Lint fetches *as a verb would run them* against ``frame``.
+
+    The fetches normalize through the verbs' own path (DSL nodes /
+    plain functions / Programs, feed_dict renames, x64 demotion), then
+    lint with frame context: block-shape distribution feeds the
+    TFG101 storm check **only when the frame is already materialized**
+    — analysis never forces a lazy frame's pending computation.
+    """
+    from ..ops.verbs import _apply_feed_dict, _normalize_program
+
+    program, _ = _normalize_program(
+        fetches, frame.schema, block=block, reduce_mode=reduce_mode,
+        feed_dict=feed_dict,
+    )
+    program = _apply_feed_dict(program, feed_dict)
+    counts: Optional[Tuple[int, ...]] = None
+    if frame.is_materialized:
+        from ..frame import _block_num_rows
+
+        counts = tuple(_block_num_rows(b) for b in frame.blocks())
+    return lint_program(
+        program,
+        probe=probe,
+        rules=rules,
+        block_mode=block,
+        block_row_counts=counts,
+        hbm_budget_bytes=hbm_budget_bytes,
+        subject=f"fetches×frame({', '.join(frame.schema.names)})",
+    )

@@ -1,0 +1,165 @@
+"""``python -m tensorframes_tpu.analysis`` — lint serialized programs.
+
+Positional arguments are paths to serialized StableHLO program bundles
+(written by :func:`tensorframes_tpu.save_program`); each is loaded with
+:func:`~tensorframes_tpu.program.load_program` and linted **without
+compiling or executing it** (deserialization + tracing only).
+
+``--demo`` builds the stock example programs (the README add-3 map, the
+logreg scoring program, the geom-mean log-transform) in-process, lints
+them, round-trips one through a temporary StableHLO bundle, and lints
+that too — the CI lint job runs this over a checkout with no fixtures
+on disk.
+
+Exit status: 0 on success; with ``--strict``, 1 when any error-severity
+diagnostic was found; 2 on unreadable inputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .analyzer import lint_program
+
+__all__ = ["main"]
+
+
+def _lint_path(path: str, args) -> "tuple[int, int]":
+    """Lint one bundle file; returns (n_errors, exit_hint)."""
+    from ..program import load_program
+
+    try:
+        program = load_program(path)
+    except Exception as e:
+        print(f"{path}: cannot load program bundle ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return 0, 2
+    report = lint_program(
+        program,
+        probe=args.probe,
+        hbm_budget_bytes=args.hbm_budget,
+        subject=path,
+    )
+    _emit(report, args)
+    return len(report.errors), 0
+
+
+def _emit(report, args) -> None:
+    if args.json:
+        payload = {
+            "subject": report.subject,
+            "counts": report.counts_by_severity(),
+            "diagnostics": [d.to_dict() for d in report.diagnostics],
+        }
+        print(json.dumps(payload, sort_keys=True))
+    else:
+        print(report.pretty(explain=args.explain))
+
+
+def _demo_reports(args) -> List:
+    """The built-in example programs (mirrors examples/: the README
+    add-3 quickstart, examples/train_logreg.py's scoring program, and
+    examples/geom_mean.py's log-transform), each normalized through
+    compile_program — tracing/eval_shape only, never an XLA compile."""
+    import os
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import logreg
+
+    reports = []
+
+    frame = tfs.frame_from_arrays(
+        {"x": np.arange(16, dtype=np.float32)}, num_blocks=2
+    )
+    add3 = tfs.compile_program(lambda x: {"z": x + 3.0}, frame)
+    reports.append(lint_program(add3, subject="examples: README add-3",
+                                hbm_budget_bytes=args.hbm_budget))
+
+    feats, _ = logreg.make_synthetic_mnist(8)
+    lr_frame = tfs.frame_from_arrays({"features": feats})
+    scoring = logreg.scoring_program(logreg.init_params())
+    lr_prog = tfs.compile_program(
+        lambda features: scoring(features), lr_frame
+    )
+    reports.append(lint_program(lr_prog, subject="examples: logreg scoring",
+                                hbm_budget_bytes=args.hbm_budget))
+
+    gm_frame = tfs.frame_from_arrays(
+        {"v": np.asarray([1.0, 2.0, 4.0], np.float64)}
+    )
+    with tfs.with_graph():
+        v = tfs.block(gm_frame, "v")
+        fetch = tfs.apply_fn(jnp.log, v, name="t")
+        gm_prog = tfs.compile_program(fetch, gm_frame)
+    reports.append(lint_program(
+        gm_prog, subject="examples: geom-mean log transform",
+        hbm_budget_bytes=args.hbm_budget,
+    ))
+
+    # round-trip: export the add-3 program to a StableHLO bundle and lint
+    # the *file*, exercising the same path the positional arguments take
+    tmp = tempfile.mkdtemp(prefix="tfguard_demo.")
+    bundle = os.path.join(tmp, "add3.stablehlo")
+    try:
+        tfs.save_program(add3, bundle)
+        loaded = tfs.load_program(bundle)
+        reports.append(lint_program(
+            loaded, subject=f"examples: reloaded bundle {bundle}",
+            hbm_budget_bytes=args.hbm_budget,
+        ))
+    finally:
+        try:
+            os.remove(bundle)
+            os.rmdir(tmp)
+        except OSError:
+            pass
+    return reports
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tensorframes_tpu.analysis",
+        description="Statically lint serialized StableHLO program bundles "
+                    "(no compile, no execution).",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="program bundles written by tfs.save_program")
+    parser.add_argument("--demo", action="store_true",
+                        help="lint the built-in example programs (CI mode)")
+    parser.add_argument("--json", action="store_true",
+                        help="one JSON object per linted subject")
+    parser.add_argument("--explain", action="store_true",
+                        help="include fix suggestions and rule pointers")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 when any error-severity diagnostic fires")
+    parser.add_argument("--probe", type=int, default=8,
+                        help="rows substituted for Unknown dims (default 8)")
+    parser.add_argument("--hbm-budget", type=int, default=None,
+                        help="device memory budget in bytes for TFG106 "
+                             "(default: the backend's reported limit)")
+    args = parser.parse_args(argv)
+    if not args.paths and not args.demo:
+        parser.error("nothing to lint: pass bundle paths or --demo")
+
+    n_errors = 0
+    rc = 0
+    if args.demo:
+        for report in _demo_reports(args):
+            _emit(report, args)
+            n_errors += len(report.errors)
+    for path in args.paths:
+        errs, hint = _lint_path(path, args)
+        n_errors += errs
+        rc = max(rc, hint)
+    if rc:
+        return rc
+    if args.strict and n_errors:
+        return 1
+    return 0

@@ -1,0 +1,583 @@
+"""The rule catalog: pure functions from a traced-program context to
+:class:`~tensorframes_tpu.analysis.diagnostics.Diagnostic` lists.
+
+Every rule is grounded in a hazard this codebase has already paid for:
+
+* **TFG101 recompile-storm** — unknown dims the executor's lead-dim
+  bucket table (:func:`tensorframes_tpu.ops.executor.bucket_table`)
+  cannot bound: inner Unknown dims compile one executable per distinct
+  extent, and frames presenting ≥3 distinct block shapes storm the
+  block-mode cache (SURVEY §7 hard-part 1; the r3 TPU collapse).
+* **TFG102 f64-leak** — float64 creeping back in past the x64 demotion
+  boundary (``config.demote_x64_on_tpu``): f64 is software-emulated on
+  TPU, so one captured ``np.float64`` constant (the old DSL
+  ``zeros``/``ones`` default) silently re-promotes the whole program.
+* **TFG103 unused-input** — jaxpr invars consumed by no output still pay
+  validation, marshalling and host→HBM transfer per block.
+* **TFG104 donation-alias** — a donated feed kept as a column: XLA may
+  reuse the donated input buffer for outputs, corrupting the kept data
+  (the executor only guards *device-resident* columns at runtime).
+* **TFG105 nan-hazard** — ``log``/``div``/``rsqrt``/``sqrt`` whose
+  operand is not provably positive (resp. nonneg / nonzero) under a
+  small sign-lattice walk of the jaxpr. ``resilience.guards.StepGuard``
+  only catches the NaN *after* the step burned the accelerator time.
+* **TFG106 hbm-budget** — static residency estimate (hoisted consts +
+  probe-batch inputs + outputs) against the device memory budget, a
+  warning *before* the first OOM instead of a crash after it.
+
+Rules never execute or compile anything: they read specs, the traced
+jaxpr, and config. Tracing itself (``jax.make_jaxpr``) happens once in
+:mod:`.analyzer`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..shape import Unknown
+from .diagnostics import Diagnostic
+
+__all__ = ["RuleContext", "RULES", "run_rules"]
+
+
+@dataclasses.dataclass
+class RuleContext:
+    """Everything a rule may read. ``closed`` is the program's
+    ``ClosedJaxpr`` (None when tracing failed — spec-level rules still
+    run); ``in_names``/``in_avals`` follow the jaxpr's flat invar order."""
+
+    program: object
+    probe: int = 8
+    closed: object = None
+    in_names: Sequence[str] = ()
+    in_avals: Sequence[object] = ()
+    out_names: Sequence[str] = ()
+    out_avals: Sequence[object] = ()
+    #: True for block-mode use, False for row-mode, None when unknown.
+    block_mode: Optional[bool] = None
+    #: Distinct block row counts of an already-materialized frame
+    #: (None when no frame context / frame is lazy — never forces one).
+    block_row_counts: Optional[Tuple[int, ...]] = None
+    hbm_budget_bytes: Optional[int] = None
+    trace_error: Optional[BaseException] = None
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking helpers (version-tolerant: duck-typed Literal / sub-jaxpr)
+# ---------------------------------------------------------------------------
+
+def _is_literal(v) -> bool:
+    """jax Literals carry ``val``; Vars carry ``aval`` only."""
+    return hasattr(v, "val")
+
+
+def _literal_value(v):
+    return np.asarray(v.val)
+
+
+def _sub_jaxprs(eqn):
+    """Yield (jaxpr, consts) for any sub-jaxpr in the eqn's params
+    (pjit / custom_jvp_call / scan / while …)."""
+    for p in eqn.params.values():
+        if hasattr(p, "jaxpr") and hasattr(p, "consts"):  # ClosedJaxpr
+            yield p.jaxpr, p.consts
+        elif hasattr(p, "eqns") and hasattr(p, "invars"):  # raw Jaxpr
+            yield p, ()
+
+
+def _iter_eqns(jaxpr):
+    """Depth-first over eqns, recursing into sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub, _ in _sub_jaxprs(eqn):
+            yield from _iter_eqns(sub)
+
+
+# ---------------------------------------------------------------------------
+# TFG101 — recompile-storm
+# ---------------------------------------------------------------------------
+
+def _rule_recompile_storm(ctx: RuleContext) -> List[Diagnostic]:
+    from ..ops.executor import bucket_table
+
+    out: List[Diagnostic] = []
+    table = bucket_table()
+    head = ", ".join(str(b) for b in table[:6]) + ("…" if len(table) > 6 else "")
+    for spec in ctx.program.inputs:
+        dims = spec.shape.dims
+        if any(d == Unknown for d in dims[1:]):
+            out.append(Diagnostic(
+                "TFG101", "warn",
+                f"input {spec.name!r} has unknown non-leading dim(s) in "
+                f"{spec.shape}: the executor buckets only the LEAD dim "
+                f"(bucket table: [{head}]), so every distinct inner extent "
+                "triggers a fresh XLA compile",
+                subject=spec.name,
+                fix="pin the inner dims in the placeholder/TensorSpec (or "
+                    "pad the data to a fixed extent) so the jit cache stays "
+                    "O(log n) instead of O(#shapes)",
+            ))
+        if dims and dims[0] == Unknown and len(table) <= 1:
+            out.append(Diagnostic(
+                "TFG101", "warn",
+                f"input {spec.name!r} has an unknown batch dim but lead-dim "
+                "bucketing is disabled (config.max_bucket_doublings <= 0): "
+                "every distinct row count compiles fresh",
+                subject=spec.name,
+                fix="re-enable bucketing (configure(max_bucket_doublings=...)"
+                    ") or feed fixed-size blocks",
+            ))
+    if (
+        ctx.block_mode is True
+        and ctx.block_row_counts is not None
+        and len(set(ctx.block_row_counts)) >= 3
+    ):
+        sizes = sorted(set(ctx.block_row_counts))
+        shown = ", ".join(str(s) for s in sizes[:6])
+        out.append(Diagnostic(
+            "TFG101", "warn",
+            f"frame presents {len(sizes)} distinct block row counts "
+            f"([{shown}{'…' if len(sizes) > 6 else ''}]); block-mode "
+            "dispatch compiles one executable per distinct shape — the "
+            "bucket table bounds map_rows only",
+            subject="frame",
+            fix="repartition() the frame (the partitioner yields at most "
+                "two block sizes) or switch to map_rows, whose vmapped "
+                "lead dim is bucketed",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TFG102 — f64-leak
+# ---------------------------------------------------------------------------
+
+def _rule_f64_leak(ctx: RuleContext) -> List[Diagnostic]:
+    from .. import dtypes as dt
+
+    if ctx.closed is None:
+        return []
+    f64 = np.dtype(np.float64)
+    if any(np.dtype(a.dtype) == f64 for a in ctx.in_avals):
+        return []  # a genuinely-f64 program: nothing is "leaking"
+    demoting = dt.demotion_active()
+    severity = "warn" if demoting else "info"
+    boundary = (
+        "re-promotes past the active x64 demotion boundary "
+        "(config.demote_x64_on_tpu)" if demoting
+        else "promotes an otherwise sub-64-bit program to float64"
+    )
+    out: List[Diagnostic] = []
+    jaxpr = ctx.closed.jaxpr
+    for var, const in zip(jaxpr.constvars, ctx.closed.consts):
+        dtype = getattr(const, "dtype", None)
+        if dtype is not None and np.dtype(dtype) == f64:
+            shape = tuple(getattr(const, "shape", ()))
+            out.append(Diagnostic(
+                "TFG102", severity,
+                f"captured float64 constant (shape {shape}) {boundary}",
+                subject=f"const{shape}",
+                fix="build the constant at the framework dtype policy — "
+                    "dsl.zeros/ones/fill now default to dtypes."
+                    "default_float(); for raw numpy use dtype=np.float32 "
+                    "(or dtypes.default_float().np_dtype)",
+            ))
+    seen = 0
+    for i, eqn in enumerate(_iter_eqns(jaxpr)):
+        in_f64 = any(
+            not _is_literal(v) and np.dtype(v.aval.dtype) == f64
+            for v in eqn.invars
+            if hasattr(v, "aval") or _is_literal(v)
+        )
+        out_f64 = any(
+            np.dtype(v.aval.dtype) == f64
+            for v in eqn.outvars if hasattr(v, "aval")
+        )
+        if out_f64 and not in_f64:
+            seen += 1
+            if seen > 8:  # cap the spam; the first sites locate the leak
+                break
+            out.append(Diagnostic(
+                "TFG102", severity,
+                f"{eqn.primitive.name} at eqn#{i} emits float64 from "
+                f"non-float64 operands — {boundary}",
+                subject=f"eqn#{i}:{eqn.primitive.name}",
+                fix="pin the op's dtype (e.g. dtype=jnp.float32) or drop "
+                    "the float64 literal feeding it",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TFG103 — unused-input
+# ---------------------------------------------------------------------------
+
+def _rule_unused_input(ctx: RuleContext) -> List[Diagnostic]:
+    if ctx.closed is None:
+        return []
+    jaxpr = ctx.closed.jaxpr
+    used = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if not _is_literal(v):
+                used.add(id(v))
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            used.add(id(v))
+    out: List[Diagnostic] = []
+    for name, var in zip(ctx.in_names, jaxpr.invars):
+        if id(var) not in used:
+            out.append(Diagnostic(
+                "TFG103", "info",
+                f"input {name!r} is consumed by no output (dead fetch): it "
+                "still pays schema validation, marshalling and host→HBM "
+                "transfer on every block",
+                subject=name,
+                fix=f"drop {name!r} from the program's inputs (or from the "
+                    "feed_dict) so the column never ships to the device",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TFG104 — donation-alias
+# ---------------------------------------------------------------------------
+
+def _rule_donation_alias(ctx: RuleContext) -> List[Diagnostic]:
+    from ..config import get_config
+
+    in_names = [s.name for s in ctx.program.inputs]
+    out_names = (
+        [s.name for s in ctx.program.outputs]
+        if ctx.program.outputs else list(ctx.out_names)
+    )
+    clash = sorted(set(in_names) & set(out_names))
+    if not clash:
+        return []
+    donating = get_config().donate_inputs
+    severity = "error" if donating else "info"
+    state = (
+        "input donation is enabled (config.donate_inputs)" if donating
+        else "input donation is currently disabled, but enabling it would "
+             "corrupt the kept column"
+    )
+    return [Diagnostic(
+        "TFG104", severity,
+        f"feed(s) {clash} are also kept as output column(s) while {state}: "
+        "XLA may reuse a donated input buffer for an output, so the kept "
+        "column can alias freed memory",
+        subject=",".join(clash),
+        fix="fetch the column under a different output name (e.g. via "
+            "identity(...).named('x_out')) or run with "
+            "configure(donate_inputs=False)",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# TFG105 — nan-hazard (sign-lattice walk)
+# ---------------------------------------------------------------------------
+
+_POS, _NONNEG, _UNK = "positive", "nonnegative", "unknown"
+
+
+def _sign_of_value(val) -> str:
+    try:
+        arr = np.asarray(val)
+        if arr.size == 0 or arr.dtype.kind not in "ifub":
+            return _UNK
+        if np.all(arr > 0):
+            return _POS
+        if np.all(arr >= 0):
+            return _NONNEG
+    except Exception:
+        pass
+    return _UNK
+
+
+def _sign_of_aval(aval) -> str:
+    dtype = np.dtype(getattr(aval, "dtype", np.float32))
+    if dtype.kind in ("u", "b"):  # unsigned ints / bools
+        return _NONNEG
+    return _UNK
+
+
+def _join2(a: str, b: str, table: Dict[Tuple[str, str], str]) -> str:
+    return table.get((a, b)) or table.get((b, a)) or _UNK
+
+
+_ADD = {(_POS, _POS): _POS, (_POS, _NONNEG): _POS, (_NONNEG, _NONNEG): _NONNEG}
+_MUL = {(_POS, _POS): _POS, (_POS, _NONNEG): _NONNEG,
+        (_NONNEG, _NONNEG): _NONNEG}
+_MAX = {(_POS, _POS): _POS, (_POS, _NONNEG): _POS, (_POS, _UNK): _POS,
+        (_NONNEG, _NONNEG): _NONNEG, (_NONNEG, _UNK): _NONNEG}
+
+#: primitives that preserve their (single) operand's sign
+_SIGN_PRESERVING = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims", "transpose",
+    "slice", "dynamic_slice", "convert_element_type", "copy",
+    "stop_gradient", "reduce_max", "reduce_min", "rev", "gather",
+})
+
+
+def _meet(signs) -> str:
+    """Strongest sign every element of a mixed bag satisfies (used for
+    concatenate: the result is only as positive as its WEAKEST operand)."""
+    signs = list(signs)
+    if signs and all(s == _POS for s in signs):
+        return _POS
+    if signs and all(s in (_POS, _NONNEG) for s in signs):
+        return _NONNEG
+    return _UNK
+
+#: hazard primitive → (operand index, sign required to be safe, hazard text)
+_HAZARDS = {
+    "log": (0, _POS, "log of a non-positive value is -inf/NaN"),
+    "div": (1, _POS, "division by a value not provably nonzero"),
+    "rsqrt": (0, _POS, "rsqrt of a non-positive value is inf/NaN"),
+    "sqrt": (0, _NONNEG, "sqrt of a negative value is NaN"),
+}
+
+_SAFE_REQ = {_POS: (_POS,), _NONNEG: (_POS, _NONNEG)}
+
+
+def _nonzero_of_value(val) -> bool:
+    try:
+        arr = np.asarray(val)
+        return arr.size > 0 and arr.dtype.kind in "ifub" and bool(
+            np.all(arr != 0)
+        )
+    except Exception:
+        return False
+
+
+def _walk_signs(jaxpr, consts, init_env, hazards, depth=0):
+    """Forward sign pass over one jaxpr; appends (site, prim, sign, text)
+    hazard tuples. ``init_env`` maps var id → sign for the invars. A
+    parallel nonzero lattice covers the div hazard for values that are
+    provably nonzero without being positive (e.g. a ``-2.0`` literal)."""
+    env: Dict[int, str] = dict(init_env)
+    nz: Dict[int, bool] = {}
+    for var, const in zip(jaxpr.constvars, consts):
+        env[id(var)] = _sign_of_value(const)
+        nz[id(var)] = _nonzero_of_value(const)
+
+    def sign_of(v) -> str:
+        if _is_literal(v):
+            return _sign_of_value(_literal_value(v))
+        return env.get(id(v), _UNK)
+
+    def nonzero_of(v) -> bool:
+        if _is_literal(v):
+            return _nonzero_of_value(_literal_value(v))
+        return nz.get(id(v), False) or env.get(id(v), _UNK) == _POS
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        ins = [sign_of(v) for v in eqn.invars]
+        ins_nz = [nonzero_of(v) for v in eqn.invars]
+        if name in _HAZARDS:
+            idx, need, text = _HAZARDS[name]
+            got = ins[idx] if idx < len(ins) else _UNK
+            safe = got in _SAFE_REQ[need]
+            if name == "div" and idx < len(ins_nz) and ins_nz[idx]:
+                safe = True  # nonzero (even negative) denominator: no NaN
+            if not safe:
+                hazards.append((f"eqn#{i}:{name}", name, got, text))
+        # transfer
+        res_nz = False
+        if name == "exp":
+            res = _POS
+            res_nz = True
+        elif name == "neg":
+            res = _UNK
+            res_nz = ins_nz[0] if ins_nz else False
+        elif name == "concatenate":
+            # only as positive as the WEAKEST operand (a single possibly-
+            # negative part poisons the whole result)
+            res = _meet(ins)
+            res_nz = bool(ins_nz) and all(ins_nz)
+        elif name in ("abs", "square"):
+            res = _POS if ins and ins[0] == _POS else _NONNEG
+            res_nz = ins_nz[0] if ins_nz else False
+        elif name == "integer_pow":
+            y = eqn.params.get("y", 0)
+            if y % 2 == 0:
+                res = _POS if ins and ins[0] == _POS else _NONNEG
+            else:
+                res = ins[0] if ins else _UNK
+        elif name in ("add", "reduce_sum"):
+            res = ins[0] if len(ins) == 1 else _join2(ins[0], ins[1], _ADD)
+            if name == "reduce_sum" and res == _POS:
+                # an empty reduction yields 0, degrading POS to NONNEG —
+                # but reduced extents are concrete at trace time, so a
+                # provably non-empty sum of positives stays positive
+                # (softmax denominators: sum(exp(x)) over a real axis)
+                axes = eqn.params.get("axes", ())
+                shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+                nonempty = all(
+                    0 <= ax < len(shape) and shape[ax] > 0 for ax in axes
+                ) if axes else True
+                if not nonempty:
+                    res = _NONNEG
+        elif name in ("mul", "div"):
+            res = _join2(ins[0], ins[1], _MUL) if len(ins) == 2 else _UNK
+            res_nz = len(ins_nz) == 2 and all(ins_nz)
+        elif name == "max":
+            res = _join2(ins[0], ins[1], _MAX) if len(ins) == 2 else _UNK
+        elif name in ("sqrt", "rsqrt"):
+            res = ins[0] if ins and ins[0] in (_POS, _NONNEG) else _UNK
+            if name == "rsqrt" and res == _NONNEG:
+                res = _UNK  # rsqrt(0) = inf
+            res_nz = ins_nz[0] if ins_nz else False
+        elif name in _SIGN_PRESERVING:
+            res = ins[0] if ins else _UNK
+            res_nz = ins_nz[0] if ins_nz else False
+        else:
+            # opaque primitive: recurse into any sub-jaxpr so hazards
+            # inside pjit/custom_jvp bodies still surface; result UNK
+            for sub, sub_consts in _sub_jaxprs(eqn):
+                if depth < 4 and len(sub.invars) == len(eqn.invars):
+                    sub_env = {
+                        id(sv): s for sv, s in zip(sub.invars, ins)
+                    }
+                    _walk_signs(
+                        sub, sub_consts, sub_env, hazards, depth + 1
+                    )
+            res = _UNK
+        for ov in eqn.outvars:
+            env[id(ov)] = res
+            nz[id(ov)] = res_nz or res == _POS
+
+
+def _rule_nan_hazard(ctx: RuleContext) -> List[Diagnostic]:
+    if ctx.closed is None:
+        return []
+    init = {
+        id(v): _sign_of_aval(v.aval)
+        for v in ctx.closed.jaxpr.invars
+    }
+    hazards: List[Tuple[str, str, str, str]] = []
+    _walk_signs(ctx.closed.jaxpr, ctx.closed.consts, init, hazards)
+    out: List[Diagnostic] = []
+    for site, prim, got, text in hazards[:8]:
+        out.append(Diagnostic(
+            "TFG105", "warn",
+            f"{text} (operand sign statically {got}) at {site}",
+            subject=site,
+            fix="clamp or guard the operand before the op (e.g. "
+                "jnp.maximum(x, eps), jnp.where(mask, x, safe)); "
+                "resilience.guards.StepGuard only catches the NaN after "
+                "the step already ran",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TFG106 — hbm-budget
+# ---------------------------------------------------------------------------
+
+def _aval_bytes(avals) -> int:
+    total = 0
+    for a in avals:
+        shape = tuple(getattr(a, "shape", ()))
+        dtype = np.dtype(getattr(a, "dtype", np.float32))
+        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return total
+
+
+def _device_budget_bytes() -> Optional[int]:
+    """``bytes_limit`` of the first addressable device, when the backend
+    reports memory stats (TPU/GPU do; XLA:CPU returns None)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit")
+            return int(limit) if limit else None
+    except Exception:
+        return None
+    return None
+
+
+def _rule_hbm_budget(ctx: RuleContext) -> List[Diagnostic]:
+    if ctx.closed is None:
+        return []
+    budget = ctx.hbm_budget_bytes
+    if budget is None:
+        budget = _device_budget_bytes()
+    if not budget:
+        return []  # no budget known: the rule has nothing to compare against
+    const_b = _aval_bytes(ctx.closed.consts)
+    in_b = _aval_bytes(ctx.in_avals)
+    out_b = _aval_bytes(ctx.out_avals)
+    est = const_b + in_b + out_b
+    # prefer XLA's own numbers when a cost analysis was already memoized
+    # (cost_analysis COMPILES, so the rule never triggers one itself)
+    cost_note = ""
+    cache = getattr(ctx.program, "_cost_cache", None)
+    if cache:
+        peak = max(
+            (float(c.get("bytes accessed", 0.0)) for c in cache.values()),
+            default=0.0,
+        )
+        if peak:
+            cost_note = (
+                f"; memoized XLA cost model reports {peak / 1e6:.1f} MB "
+                "accessed"
+            )
+            est = max(est, int(peak))
+    if est <= budget:
+        return []
+    return [Diagnostic(
+        "TFG106", "warn",
+        f"static residency estimate {est / 1e6:.1f} MB (consts "
+        f"{const_b / 1e6:.1f} + inputs {in_b / 1e6:.1f} + outputs "
+        f"{out_b / 1e6:.1f} MB at probe batch {ctx.probe}) exceeds the "
+        f"device budget {budget / 1e6:.1f} MB{cost_note} — expect OOM "
+        "before the first result",
+        subject="program",
+        fix="shrink the per-call batch (more blocks / smaller buckets), "
+            "quantize the weights (int8 keeps s8 residency under the "
+            "hoisted path), or shard the frame over more chips",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
+    "TFG101": _rule_recompile_storm,
+    "TFG102": _rule_f64_leak,
+    "TFG103": _rule_unused_input,
+    "TFG104": _rule_donation_alias,
+    "TFG105": _rule_nan_hazard,
+    "TFG106": _rule_hbm_budget,
+}
+
+
+def run_rules(
+    ctx: RuleContext, codes: Optional[Sequence[str]] = None
+) -> List[Diagnostic]:
+    """Run the selected rules (all by default) over one context. A rule
+    that raises is a bug in the rule, not the user's program — it
+    degrades to a single info diagnostic naming itself, so lint can
+    never make a valid program un-runnable."""
+    out: List[Diagnostic] = []
+    for code, rule in RULES.items():
+        if codes is not None and code not in codes:
+            continue
+        try:
+            out.extend(rule(ctx))
+        except Exception as e:  # pragma: no cover - rule bug safety net
+            out.append(Diagnostic(
+                code, "info",
+                f"rule crashed ({type(e).__name__}: {e}); finding skipped",
+                subject="analyzer",
+                fix="report this as an analyzer bug",
+            ))
+    return out

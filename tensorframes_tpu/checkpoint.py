@@ -92,7 +92,7 @@ _TMP_RE = re.compile(r"^step_\d+\.tmp(\d+)")
 # init-time GC distinguish "our live save on another thread" from "a
 # corpse left by a previous same-pid incarnation" (pid 1 in a restarted
 # container is the same pid every time)
-_live_tmps: set = set()
+_live_tmps: set = set()  # lint: guarded (set add/discard are GIL-atomic; the GC reader tolerates a stale view — worst case it spares one dead tmp until the next init)
 
 
 class CheckpointCorruptionError(RuntimeError):

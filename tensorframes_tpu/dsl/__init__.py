@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..shape import Shape, Unknown
+from ..shape import Unknown
 from .node import (  # noqa: F401
     GraphContext,
     Node,

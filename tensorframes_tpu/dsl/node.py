@@ -33,8 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import dtypes as dt
-from ..program import Program, TensorSpec, analyze_program
-from ..shape import Shape, Unknown
+from ..program import Program, TensorSpec
+from ..shape import Shape
 
 
 class GraphContext:
@@ -190,7 +190,9 @@ def placeholder(
     )
 
 
-def constant(value: ConstLike, name: Optional[str] = None) -> Node:
+def constant(
+    value: ConstLike, name: Optional[str] = None, dtype=None
+) -> Node:
     """Embed a constant (≙ dsl/package.scala:53-58; DenseTensor constants).
 
     Plain Python scalars behave exactly like literals in jnp code
@@ -199,8 +201,10 @@ def constant(value: ConstLike, name: Optional[str] = None) -> Node:
     keep their exact dtype — floats default to float64, ints to int64,
     matching frame inference. The node's declared dtype records the
     default; weak literals may narrow to the operand's dtype at trace
-    time."""
-    arr = np.asarray(value)
+    time. Pass ``dtype=`` to pin the embedded dtype explicitly (e.g.
+    ``dtypes.default_float().np_dtype`` to follow the framework policy
+    — a float64 constant in a demoted program is a TFG102 leak)."""
+    arr = np.asarray(value) if dtype is None else np.asarray(value, dtype=dtype)
     scalar = dt.from_numpy(arr.dtype)
     if arr.ndim == 0 and isinstance(value, (int, float)) and not isinstance(
         value, bool
@@ -221,16 +225,44 @@ def constant(value: ConstLike, name: Optional[str] = None) -> Node:
     )
 
 
-def zeros(shape, dtype=np.float64, name=None) -> Node:
-    return constant(np.zeros(shape, dtype=dtype), name=name or "zeros")
+def _policy_dtype(dtype):
+    """Resolve a constructor's ``dtype=None`` default to the framework
+    float policy (:func:`tensorframes_tpu.dtypes.default_float`).
+
+    .. deprecated:: 0.3
+       These constructors previously hard-coded ``np.float64`` and
+       silently relied on the x64 demotion pass to cast back down —
+       exactly the pattern the TFG102 f64-leak rule flags. With x64 on
+       and demotion off (the default CPU config) the policy still
+       resolves to float64, so reference-parity programs are unchanged;
+       pass ``dtype=np.float64`` explicitly to keep the old behavior
+       under demotion."""
+    if dtype is not None:
+        return dtype
+    return dt.default_float().np_dtype
 
 
-def ones(shape, dtype=np.float64, name=None) -> Node:
-    return constant(np.ones(shape, dtype=dtype), name=name or "ones")
+def zeros(shape, dtype=None, name=None) -> Node:
+    """≙ dsl/package.scala:60-64; dtype defaults to the framework float
+    policy (see :func:`_policy_dtype` for the deprecation note)."""
+    return constant(np.zeros(shape, dtype=_policy_dtype(dtype)),
+                    name=name or "zeros")
 
 
-def fill(shape, value, name=None) -> Node:
-    return constant(np.full(shape, value), name=name or "fill")
+def ones(shape, dtype=None, name=None) -> Node:
+    """≙ dsl/package.scala:66-70; dtype defaults to the framework float
+    policy (see :func:`_policy_dtype`)."""
+    return constant(np.ones(shape, dtype=_policy_dtype(dtype)),
+                    name=name or "ones")
+
+
+def fill(shape, value, dtype=None, name=None) -> Node:
+    """≙ dsl/package.scala:72-76. Float fills follow the framework float
+    policy; int/bool fills keep numpy's inference (int64/bool), matching
+    frame inference for those kinds."""
+    if dtype is None and isinstance(value, float):
+        dtype = dt.default_float().np_dtype
+    return constant(np.full(shape, value, dtype=dtype), name=name or "fill")
 
 
 def unary(op: str, fn: Callable, x: Node, name=None) -> Node:

@@ -116,6 +116,23 @@ def demotion_active() -> bool:
     return False
 
 
+def default_float() -> ScalarType:
+    """The framework's float *policy* dtype for constructed constants
+    (DSL ``zeros``/``ones``/``fill``): float32 whenever the x64 demotion
+    pass is active or x64 is disabled — otherwise float64 (reference
+    parity: Double columns, datatypes.scala:265-267).
+
+    Before this policy existed the DSL constructors hard-coded
+    ``np.float64`` and silently relied on the later demotion pass to
+    cast it back down; the static analyzer's TFG102 rule now flags that
+    pattern (see docs/analysis.md#tfg102)."""
+    from .config import get_config
+
+    if demotion_active() or not get_config().enable_x64:
+        return float32
+    return float64
+
+
 def all_types():
     return list(_ALL_TYPES)
 

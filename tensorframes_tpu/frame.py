@@ -31,9 +31,8 @@ Shape discovery parity:
 from __future__ import annotations
 
 import functools as _functools
-import math
 import threading
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -1523,29 +1522,33 @@ class TensorFrame:
     # the Scala API pimps DataFrame with the verbs; here they are plain
     # methods delegating to the functional API) ----------------------------
 
-    def map_blocks(self, fetches, feed_dict=None, trim: bool = False):
+    def map_blocks(self, fetches, feed_dict=None, trim: bool = False,
+                   strict: bool = False):
         from .ops.verbs import map_blocks
 
-        return map_blocks(fetches, self, feed_dict=feed_dict, trim=trim)
+        return map_blocks(fetches, self, feed_dict=feed_dict, trim=trim,
+                          strict=strict)
 
-    def map_blocks_trimmed(self, fetches, feed_dict=None):
+    def map_blocks_trimmed(self, fetches, feed_dict=None,
+                           strict: bool = False):
         """≙ ``mapBlocksTrimmed`` (dsl/Implicits.scala:49-55)."""
-        return self.map_blocks(fetches, feed_dict=feed_dict, trim=True)
+        return self.map_blocks(fetches, feed_dict=feed_dict, trim=True,
+                               strict=strict)
 
-    def map_rows(self, fetches, feed_dict=None):
+    def map_rows(self, fetches, feed_dict=None, strict: bool = False):
         from .ops.verbs import map_rows
 
-        return map_rows(fetches, self, feed_dict=feed_dict)
+        return map_rows(fetches, self, feed_dict=feed_dict, strict=strict)
 
-    def reduce_rows(self, fetches):
+    def reduce_rows(self, fetches, strict: bool = False):
         from .ops.verbs import reduce_rows
 
-        return reduce_rows(fetches, self)
+        return reduce_rows(fetches, self, strict=strict)
 
-    def reduce_blocks(self, fetches):
+    def reduce_blocks(self, fetches, strict: bool = False):
         from .ops.verbs import reduce_blocks
 
-        return reduce_blocks(fetches, self)
+        return reduce_blocks(fetches, self, strict=strict)
 
     def analyze(self) -> "TensorFrame":
         """≙ ``RichDataFrame.analyze`` (dsl/Implicits.scala:69-71)."""
@@ -1572,12 +1575,12 @@ class GroupedData:
         self.frame = frame
         self.keys = keys
 
-    def aggregate(self, fetches) -> "TensorFrame":
+    def aggregate(self, fetches, strict: bool = False) -> "TensorFrame":
         """≙ ``RichRelationalGroupedDataset.aggregate``
         (dsl/Implicits.scala:107-116)."""
         from .ops.verbs import aggregate
 
-        return aggregate(fetches, self)
+        return aggregate(fetches, self, strict=strict)
 
     def count(self) -> "TensorFrame":
         """Rows per key (the ``groupBy().count()`` affordance): sums a

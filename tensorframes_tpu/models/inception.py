@@ -25,7 +25,7 @@ A ``channel_scale`` knob shrinks widths for tests; ``tiny()`` runs on
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

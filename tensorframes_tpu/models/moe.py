@@ -23,7 +23,7 @@ that equivalence is the correctness test.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -90,7 +90,6 @@ def _route(cfg: MoEConfig, router_w, x, capacity: int):
     Returns (dispatch [t, e, c] one-hot, combine [t, e, c] gate-weighted,
     aux load-balancing stats).
     """
-    t = x.shape[0]
     logits = x.astype(jnp.float32) @ router_w  # [t, e]
     gates = jax.nn.softmax(logits, axis=-1)
     idx = jnp.argmax(gates, axis=-1)  # [t]

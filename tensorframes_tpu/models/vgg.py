@@ -23,7 +23,7 @@ This is that workload re-designed TPU-first:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

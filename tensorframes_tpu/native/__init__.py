@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import os
 import subprocess
-import sys
 import sysconfig
 import threading
 from typing import Dict, List, Optional, Sequence

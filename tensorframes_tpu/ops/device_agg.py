@@ -116,12 +116,12 @@ def _stacked_minmax(*cols):
 # follow-up: "aggregate's device plan pays per-call relay transfers").
 # id()-keyed with a weakref finalizer so entries die with their array
 # (ids recycle only after the finalizer has already evicted the entry).
-_minmax_memo: Dict[int, tuple] = {}
+_minmax_memo: Dict[int, tuple] = {}  # lint: guarded (benign race: concurrent writers memoize the same immutable probe; worst case one redundant device_get)
 
 # Same lifetime discipline for the dictionary plan's encode: keyed by
 # the tuple of key-column array ids; holds (staged dense ids on device,
 # group key columns, K). Evicted when any key array is collected.
-_dict_encode_memo: Dict[tuple, tuple] = {}
+_dict_encode_memo: Dict[tuple, tuple] = {}  # lint: guarded (benign race: same-key writers store identical staged values)
 
 
 def _cached_minmax(cols):

@@ -94,6 +94,21 @@ def bucket_rows(n: int) -> int:
     return n
 
 
+def bucket_table() -> List[int]:
+    """The lead-dim bucket ladder :func:`bucket_rows` rounds into under
+    the current config: ``[min_bucket, min_bucket*2, …]``, one entry per
+    allowed doubling. The static analyzer's recompile-storm rule
+    (TFG101) cross-checks program shapes against this table — an
+    Unknown dim the ladder cannot bound compiles per distinct extent."""
+    cfg = get_config()
+    b = max(1, int(cfg.min_bucket))
+    out = [b]
+    for _ in range(max(0, int(cfg.max_bucket_doublings))):
+        b *= 2
+        out.append(b)
+    return out
+
+
 def pad_lead_dim(
     feeds: Dict[str, np.ndarray], n: int, target: int
 ) -> Dict[str, np.ndarray]:

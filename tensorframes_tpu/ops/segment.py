@@ -17,11 +17,9 @@ also exercised on CPU in interpreter mode by the tests.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 # rows per grid step (sublane-aligned); lanes carry the feature dim

@@ -181,6 +181,23 @@ def _demote_cast(v, spec: TensorSpec):
     return v
 
 
+def _strict_lint(program: Program, frame, block_mode: Optional[bool]) -> None:
+    """The verbs' ``strict=True`` hook: run the static analyzer
+    (:mod:`tensorframes_tpu.analysis`) on the normalized program and
+    raise :class:`~tensorframes_tpu.validation.StaticAnalysisError` on
+    any error-severity diagnostic — before the first dispatch. Block
+    shapes feed the recompile-storm rule only when the frame is already
+    materialized (lint never forces a pending computation)."""
+    from ..analysis import lint_program
+
+    counts = None
+    if getattr(frame, "is_materialized", False):
+        counts = tuple(_block_num_rows(b) for b in frame.blocks())
+    lint_program(
+        program, block_mode=block_mode, block_row_counts=counts,
+    ).raise_on_errors()
+
+
 def _sorted_output_infos(program: Program, block_mode: bool) -> List[ColumnInfo]:
     """Output columns first, sorted by name (≙ DebugRowOps.scala:353-379)."""
     infos = []
@@ -283,6 +300,7 @@ def map_blocks(
     frame,
     feed_dict: Optional[Dict[str, str]] = None,
     trim: bool = False,
+    strict: bool = False,
 ) -> "TensorFrame":
     """Transform a frame block by block, appending one column per output
     (or replacing all columns when ``trim=True``, in which case the output
@@ -291,14 +309,19 @@ def map_blocks(
     ≙ ``tfs.map_blocks`` (core.py:267-313) → DebugRowOps.mapBlocks
     (DebugRowOps.scala:305-400); trimmed variant ≙ mapBlocksTrimmed.
     Lazy: returns a frame with a pending computation (core.py:278-279).
+    ``strict=True`` additionally runs the static analyzer and raises on
+    error-severity diagnostics before any dispatch.
     """
     if _is_pandas(frame):
-        return _map_pandas(fetches, frame, feed_dict, block=True)
+        return _map_pandas(fetches, frame, feed_dict, block=True,
+                           strict=strict)
     program, _ = _normalize_program(
         fetches, frame.schema, block=True, feed_dict=feed_dict
     )
     program = _apply_feed_dict(program, feed_dict)
     validate_map(program, frame.schema, block=True, trim=trim)
+    if strict:
+        _strict_lint(program, frame, block_mode=True)
     compiled = program.compiled()
     out_infos = _sorted_output_infos(program, block_mode=True)
     if trim:
@@ -578,6 +601,7 @@ def map_rows(
     fetches: Fetches,
     frame,
     feed_dict: Optional[Dict[str, str]] = None,
+    strict: bool = False,
 ) -> "TensorFrame":
     """Transform a frame row by row (placeholders are cell-shaped).
 
@@ -587,12 +611,15 @@ def map_rows(
     per-cell-shape compile cache.
     """
     if _is_pandas(frame):
-        return _map_pandas(fetches, frame, feed_dict, block=False)
+        return _map_pandas(fetches, frame, feed_dict, block=False,
+                           strict=strict)
     program, _ = _normalize_program(
         fetches, frame.schema, block=False, feed_dict=feed_dict
     )
     program = _apply_feed_dict(program, feed_dict)
     validate_map(program, frame.schema, block=False)
+    if strict:
+        _strict_lint(program, frame, block_mode=False)
     compiled = program.compiled()
     out_infos = _sorted_output_infos(program, block_mode=False)
     schema = Schema(out_infos + frame.schema.columns)
@@ -682,14 +709,16 @@ def map_rows(
     return result
 
 
-def _map_pandas(fetches, pdf, feed_dict, block: bool):
+def _map_pandas(fetches, pdf, feed_dict, block: bool, strict: bool = False):
     """Local pandas path (≙ ``_map_pd``, core.py:171-183): run the program
-    on the pandas columns and append the outputs to a copy of the frame."""
+    on the pandas columns and append the outputs to a copy of the frame.
+    ``strict`` rides through to the converted-frame map_blocks so the
+    pandas interop honors the same pre-dispatch analysis gate."""
     from ..frame import frame_from_pandas
 
     tf_frame = frame_from_pandas(pdf, num_blocks=1)
     # the reference's _map_pd always feeds whole columns (block semantics)
-    result = map_blocks(fetches, tf_frame, feed_dict=feed_dict)
+    result = map_blocks(fetches, tf_frame, feed_dict=feed_dict, strict=strict)
     out = pdf.copy()
     for name in result.schema.names:
         if name not in pdf.columns:
@@ -742,7 +771,9 @@ def _sharded_reduce_rows_fn(program: Program, out_names, mesh, axis):
     )
 
 
-def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
+def reduce_rows(
+    fetches: Fetches, frame, strict: bool = False
+) -> Union[np.ndarray, list]:
     """Pairwise-reduce all rows to a single row. Each fetch ``x`` consumes
     placeholders ``x_1``/``x_2`` (Operations.scala:83-96). Eager
     (core.py:197 "not lazy").
@@ -758,6 +789,8 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
         fetches, frame.schema, block=False, reduce_mode="rows"
     )
     validate_reduce_rows(program, frame.schema)
+    if strict:
+        _strict_lint(program, frame, block_mode=False)
     out_names = [o.name for o in program.outputs]
     fold = make_pair_fold(program, out_names)
     t0 = time.perf_counter()
@@ -839,7 +872,9 @@ def reduce_rows(fetches: Fetches, frame) -> Union[np.ndarray, list]:
 # reduce_blocks
 # ---------------------------------------------------------------------------
 
-def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
+def reduce_blocks(
+    fetches: Fetches, frame, strict: bool = False
+) -> Union[np.ndarray, list]:
     """Block-reduce all rows to a single row. Each fetch ``x`` consumes a
     placeholder ``x_input`` with one extra (Unknown) leading dim
     (Operations.scala:98-108). Eager.
@@ -852,6 +887,8 @@ def reduce_blocks(fetches: Fetches, frame) -> Union[np.ndarray, list]:
         fetches, frame.schema, block=True, reduce_mode="blocks"
     )
     validate_reduce_blocks(program, frame.schema)
+    if strict:
+        _strict_lint(program, frame, block_mode=True)
     out_names = [o.name for o in program.outputs]
     compiled = program.compiled()
     t0 = time.perf_counter()
@@ -1158,7 +1195,9 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
     return assemble_key_cols(frame, keys, group_key_cols), out_cols
 
 
-def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
+def aggregate(
+    fetches: Fetches, grouped: GroupedData, strict: bool = False
+) -> "TensorFrame":
     """Algebraic aggregation over grouped data: one output row per key.
 
     ≙ ``tfs.aggregate`` (core.py:401-419) → DebugRowOps.aggregate via
@@ -1186,6 +1225,8 @@ def aggregate(fetches: Fetches, grouped: GroupedData) -> "TensorFrame":
         fetches, frame.schema, block=True, reduce_mode="blocks"
     )
     validate_reduce_blocks(program, frame.schema)
+    if strict:
+        _strict_lint(program, frame, block_mode=True)
     out_names = [o.name for o in program.outputs]
 
     def _assemble(out_key_cols, out_cols, n_rows):

@@ -28,7 +28,7 @@ reference's shape-hints workaround for dims the graph pruned
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -224,6 +224,25 @@ class Program:
                 f"vmap: {sizes['vmap']}}}"
             )
         return f"Program(inputs=[{ins}], outputs=[{outs}]{extra})"
+
+    def lint(
+        self,
+        probe: int = 8,
+        rules: Optional[Sequence[str]] = None,
+        hbm_budget_bytes: Optional[int] = None,
+    ):
+        """Pre-execution static diagnostics over this program's jaxpr +
+        specs (:mod:`tensorframes_tpu.analysis`): recompile storms, f64
+        leaks, dead inputs, donation aliasing, NaN hazards, HBM budget.
+        Purely static — tracing only, zero XLA compiles, zero transfers.
+        Returns a :class:`~tensorframes_tpu.analysis.DiagnosticReport`;
+        chain ``.raise_on_errors()`` for strict behavior."""
+        from .analysis import lint_program
+
+        return lint_program(
+            self, probe=probe, rules=rules,
+            hbm_budget_bytes=hbm_budget_bytes,
+        )
 
     def cost_analysis(self, probe: int = 8) -> Dict[str, float]:
         """XLA's compiled cost model for this program: flops, bytes

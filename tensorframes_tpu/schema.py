@@ -20,10 +20,10 @@ annotate.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional
 
 from . import dtypes as dt
-from .shape import Shape, Unknown
+from .shape import Shape
 
 
 @dataclasses.dataclass(frozen=True)

@@ -24,7 +24,7 @@ at trace time instead of ``TensorShapeProto``.
 from __future__ import annotations
 
 import math
-from typing import Iterable, Optional, Sequence, Tuple
+from typing import Iterable, Optional, Tuple
 
 Unknown: int = -1
 

@@ -26,16 +26,26 @@ Contracts validated:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Sequence
 
 from . import dtypes as dt
 from .program import Program, TensorSpec
 from .schema import ColumnInfo, Schema
-from .shape import Shape, Unknown
 
 
 class ValidationError(ValueError):
     """A schema/program mismatch detected before execution."""
+
+
+class StaticAnalysisError(ValidationError):
+    """Error-severity static diagnostics under a verb's ``strict=True``
+    (or ``DiagnosticReport.raise_on_errors()``). Like every
+    ValidationError it fires *before* execution; ``diagnostics`` carries
+    the structured findings (:mod:`tensorframes_tpu.analysis`)."""
+
+    def __init__(self, message: str, diagnostics: Sequence = ()):
+        super().__init__(message)
+        self.diagnostics = list(diagnostics)
 
 
 def _no_collisions(outputs: Sequence[TensorSpec], schema: Schema) -> None:

@@ -56,6 +56,16 @@ def pytest_sessionfinish(session, exitstatus):
         events.save(os.path.join(out, "tier1_trace.json"))
     except Exception as e:  # pragma: no cover - diagnostic path
         print(f"TFTPU_OBS_EXPORT failed: {e}")
+    try:
+        # static-analysis findings the suite produced, next to the
+        # metrics artifact (ISSUE 3: lint posture rides along with CI).
+        # Own try: an analysis-import failure must not take the
+        # metrics/trace exports above down with it.
+        from tensorframes_tpu.analysis import save_jsonl as _save_diag
+
+        _save_diag(os.path.join(out, "tier1_diagnostics.jsonl"))
+    except Exception as e:  # pragma: no cover - diagnostic path
+        print(f"TFTPU_OBS_EXPORT diagnostics export failed: {e}")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,592 @@
+"""tfguard: the pre-execution static analyzer (ISSUE 3).
+
+Contract under test (docs/analysis.md):
+
+* each rule fires on a seeded-bad fixture program and stays silent on
+  the clean example programs;
+* the pass is purely static — a lint performs zero XLA compiles and
+  zero device transfers (the executor's jit-cache / compile-seconds
+  metrics are the witness);
+* ``strict=True`` on the verbs raises ``StaticAnalysisError`` on
+  error-severity diagnostics, before any dispatch;
+* the CLI lints an exported StableHLO bundle end-to-end;
+* every diagnostic increments the pre-registered
+  ``tftpu_analysis_diagnostics_total{code=}`` counter.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.analysis import (
+    CODES,
+    Diagnostic,
+    DiagnosticReport,
+    analyze_frame,
+    lint_program,
+    save_jsonl,
+)
+from tensorframes_tpu.analysis.cli import main as cli_main
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.observability.metrics import REGISTRY
+from tensorframes_tpu.program import Program, TensorSpec
+from tensorframes_tpu.shape import Shape
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _codes(report):
+    return {d.code for d in report}
+
+
+def _frame(n=16, blocks=2, dtype=np.float32, name="x"):
+    return tfs.frame_from_arrays(
+        {name: np.arange(n, dtype=dtype) + 1.0}, num_blocks=blocks
+    )
+
+
+@pytest.fixture
+def restore_config():
+    cfg = tfs.configure()
+    saved = {
+        k: getattr(cfg, k)
+        for k in ("demote_x64_on_tpu", "donate_inputs", "max_bucket_doublings")
+    }
+    yield
+    tfs.configure(**saved)
+
+
+# ---------------------------------------------------------------------------
+# clean programs stay silent
+# ---------------------------------------------------------------------------
+
+def test_clean_program_is_clean():
+    p = tfs.compile_program(lambda x: {"z": x + 3.0}, _frame())
+    report = p.lint()
+    assert len(report) == 0
+    assert "clean" in report.pretty()
+
+
+def test_clean_example_programs_stay_silent():
+    # the shipped example programs must not regress into findings
+    from tensorframes_tpu.models import logreg
+
+    feats, _ = logreg.make_synthetic_mnist(8)
+    fr = tfs.frame_from_arrays({"features": feats})
+    scoring = logreg.scoring_program(logreg.init_params())
+    p = tfs.compile_program(lambda features: scoring(features), fr)
+    assert len(p.lint()) == 0
+
+
+# ---------------------------------------------------------------------------
+# TFG101 recompile-storm
+# ---------------------------------------------------------------------------
+
+def test_tfg101_inner_unknown_dim_fires():
+    spec = TensorSpec("x", dt.float32, Shape([-1, -1]))
+    p = Program(lambda feeds: {"y": feeds["x"] * 2.0}, [spec])
+    report = lint_program(p)
+    [d] = report.by_code("TFG101")
+    assert d.severity == "warn"
+    assert "bucket table" in d.message
+    assert d.subject == "x"
+
+
+def test_tfg101_silent_when_only_lead_dim_unknown():
+    spec = TensorSpec("x", dt.float32, Shape([-1, 8]))
+    p = Program(lambda feeds: {"y": feeds["x"] * 2.0}, [spec])
+    assert not lint_program(p).by_code("TFG101")
+
+
+def test_tfg101_bucketing_disabled_fires(restore_config):
+    tfs.configure(max_bucket_doublings=0)
+    spec = TensorSpec("x", dt.float32, Shape([-1]))
+    p = Program(lambda feeds: {"y": feeds["x"] * 2.0}, [spec])
+    msgs = [d.message for d in lint_program(p).by_code("TFG101")]
+    assert any("bucketing is disabled" in m for m in msgs)
+
+
+def test_tfg101_block_shape_storm_via_analyze_frame():
+    base = _frame(4, blocks=1)
+    blocks = [
+        {"x": np.arange(n, dtype=np.float32) + 1.0} for n in (1, 2, 4, 8)
+    ]
+    stormy = TensorFrame(blocks, base.schema)
+    report = analyze_frame(stormy, lambda x: {"z": x * 2.0}, block=True)
+    [d] = [d for d in report.by_code("TFG101") if d.subject == "frame"]
+    assert "4 distinct block row counts" in d.message
+
+
+def test_tfg101_no_storm_on_partitioner_blocks():
+    # the partitioner yields at most two distinct sizes — never a storm
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(7, dtype=np.float32)}, num_blocks=3
+    )
+    fr.blocks()
+    report = analyze_frame(fr, lambda x: {"z": x * 2.0}, block=True)
+    assert not [d for d in report.by_code("TFG101") if d.subject == "frame"]
+
+
+# ---------------------------------------------------------------------------
+# TFG102 f64-leak
+# ---------------------------------------------------------------------------
+
+def test_tfg102_f64_const_under_demotion_fires(restore_config):
+    tfs.configure(demote_x64_on_tpu="always")
+    fr = tfs.frame_from_arrays({"v": np.arange(4, dtype=np.float64) + 1.0})
+    leak = np.float64(2.0)  # the old DSL zeros/ones default, in miniature
+    p = tfs.compile_program(lambda v: {"w": v * jnp.asarray(leak)}, fr)
+    diags = p.lint().by_code("TFG102")
+    assert diags and all(d.severity == "warn" for d in diags)
+    assert any("demotion boundary" in d.message for d in diags)
+
+
+def test_tfg102_info_without_demotion():
+    fr = _frame()
+    leak = np.float64(2.0)
+    p = tfs.compile_program(lambda x: {"w": x * jnp.asarray(leak)}, fr)
+    diags = p.lint().by_code("TFG102")
+    assert diags and all(d.severity == "info" for d in diags)
+
+
+def test_tfg102_silent_on_genuine_f64_program():
+    fr = tfs.frame_from_arrays({"v": np.arange(4, dtype=np.float64) + 1.0})
+    p = tfs.compile_program(lambda v: {"w": v * 2.0}, fr)
+    assert not p.lint().by_code("TFG102")
+
+
+def test_tfg102_seed_fixture_old_dsl_default(restore_config):
+    # the seed fixture from the satellite: explicit np.float64 DSL const
+    tfs.configure(demote_x64_on_tpu="always")
+    fr = tfs.frame_from_arrays({"v": np.float64([1.0, 2.0, 3.0])})
+    with tfs.with_graph():
+        v = tfs.block(fr, "v")
+        fetch = tfs.add(v, tfs.constant(np.float64(1.0)), name="w")
+        p = tfs.compile_program(fetch, fr)
+    assert p.lint().by_code("TFG102")
+
+
+# ---------------------------------------------------------------------------
+# TFG103 unused-input
+# ---------------------------------------------------------------------------
+
+def test_tfg103_unused_input_fires():
+    fr = tfs.frame_from_arrays({
+        "x": np.arange(8, dtype=np.float32),
+        "y": np.arange(8, dtype=np.float32),
+    })
+    p = tfs.compile_program(lambda x, y: {"z": x + 1.0}, fr)
+    [d] = p.lint().by_code("TFG103")
+    assert d.subject == "y" and d.severity == "info"
+    assert "dead fetch" in d.message
+
+
+def test_tfg103_silent_when_all_inputs_used():
+    fr = tfs.frame_from_arrays({
+        "x": np.arange(8, dtype=np.float32),
+        "y": np.arange(8, dtype=np.float32),
+    })
+    p = tfs.compile_program(lambda x, y: {"z": x + y}, fr)
+    assert not p.lint().by_code("TFG103")
+
+
+# ---------------------------------------------------------------------------
+# TFG104 donation-alias
+# ---------------------------------------------------------------------------
+
+def test_tfg104_error_when_donation_enabled(restore_config):
+    tfs.configure(donate_inputs=True)
+    p = tfs.compile_program(lambda x: {"x": x * 1.0}, _frame())
+    [d] = p.lint().by_code("TFG104")
+    assert d.severity == "error"
+    assert "donat" in d.message
+
+
+def test_tfg104_downgrades_to_info_when_donation_off(restore_config):
+    tfs.configure(donate_inputs=False)
+    p = tfs.compile_program(lambda x: {"x": x * 1.0}, _frame())
+    [d] = p.lint().by_code("TFG104")
+    assert d.severity == "info"
+
+
+def test_tfg104_silent_on_renamed_output():
+    p = tfs.compile_program(lambda x: {"x_out": x * 1.0}, _frame())
+    assert not p.lint().by_code("TFG104")
+
+
+# ---------------------------------------------------------------------------
+# TFG105 nan-hazard
+# ---------------------------------------------------------------------------
+
+def test_tfg105_log_of_unproven_operand_fires():
+    p = tfs.compile_program(lambda x: {"l": jnp.log(x)}, _frame())
+    [d] = p.lint().by_code("TFG105")
+    assert "log" in d.subject and d.severity == "warn"
+    assert "StepGuard" in d.fix  # ties into resilience.guards
+
+
+def test_tfg105_silent_when_operand_provably_positive():
+    p = tfs.compile_program(
+        lambda x: {"l": jnp.log(jnp.exp(x) + 1.0)}, _frame()
+    )
+    assert not p.lint().by_code("TFG105")
+
+
+def test_tfg105_division_by_unproven_denominator_fires():
+    fr = tfs.frame_from_arrays({
+        "x": np.arange(8, dtype=np.float32),
+        "y": np.arange(8, dtype=np.float32),
+    })
+    p = tfs.compile_program(lambda x, y: {"q": x / y}, fr)
+    assert p.lint().by_code("TFG105")
+
+
+def test_tfg105_silent_for_positive_literal_denominator():
+    p = tfs.compile_program(lambda x: {"q": x / 2.0}, _frame())
+    assert not p.lint().by_code("TFG105")
+
+
+def test_tfg105_rsqrt_fires_sqrt_of_square_silent():
+    fr = _frame()
+    p1 = tfs.compile_program(lambda x: {"r": jax_rsqrt(x)}, fr)
+    assert p1.lint().by_code("TFG105")
+    p2 = tfs.compile_program(lambda x: {"s": jnp.sqrt(jnp.square(x))}, fr)
+    assert not p2.lint().by_code("TFG105")
+
+
+def jax_rsqrt(x):
+    from jax import lax
+
+    return lax.rsqrt(x)
+
+
+def test_tfg105_concatenate_meets_operand_signs():
+    # concat of a positive and an unknown-sign part is NOT positive: the
+    # log hazard must still fire (review finding: ins[0]-only was unsound)
+    p = tfs.compile_program(
+        lambda x: {"l": jnp.log(jnp.concatenate([jnp.exp(x), x]))}, _frame()
+    )
+    assert p.lint().by_code("TFG105")
+    # all-positive parts stay positive: silent
+    p2 = tfs.compile_program(
+        lambda x: {"l": jnp.log(jnp.concatenate([jnp.exp(x), jnp.exp(x)]))},
+        _frame(),
+    )
+    assert not p2.lint().by_code("TFG105")
+
+
+def test_tfg105_negative_literal_denominator_is_nonzero_safe():
+    # -2.0 is not positive but IS provably nonzero: no div hazard
+    p = tfs.compile_program(lambda x: {"q": x / -2.0}, _frame())
+    assert not p.lint().by_code("TFG105")
+
+
+def test_strict_reaches_pandas_path(restore_config):
+    pd = pytest.importorskip("pandas")
+    tfs.configure(donate_inputs=True)
+    pdf = pd.DataFrame({"x": np.arange(4.0, dtype=np.float64)})
+    # warn-only program: strict admits it through the pandas interop
+    out = tfs.map_blocks(lambda x: {"z": x + 1.0}, pdf, strict=True)
+    assert "z" in out.columns
+
+
+def test_tfg105_softmax_denominator_is_not_flagged():
+    # sum(exp(x)) over a concrete non-empty axis is provably positive —
+    # the logreg scoring softmax must stay clean
+    p = tfs.compile_program(
+        lambda x: {"s": jnp.exp(x) / jnp.sum(jnp.exp(x))}, _frame()
+    )
+    assert not p.lint().by_code("TFG105")
+
+
+# ---------------------------------------------------------------------------
+# TFG106 hbm-budget
+# ---------------------------------------------------------------------------
+
+def test_tfg106_fires_against_tiny_budget():
+    p = tfs.compile_program(lambda x: {"z": x + 3.0}, _frame())
+    [d] = p.lint(hbm_budget_bytes=10).by_code("TFG106")
+    assert "exceeds the device budget" in d.message
+    assert d.severity == "warn"
+
+
+def test_tfg106_silent_under_roomy_budget():
+    p = tfs.compile_program(lambda x: {"z": x + 3.0}, _frame())
+    assert not p.lint(hbm_budget_bytes=1 << 30).by_code("TFG106")
+
+
+def test_tfg106_uses_memoized_cost_analysis_without_compiling():
+    p = tfs.compile_program(lambda x: {"z": x + 3.0}, _frame())
+    p.cost_analysis(probe=8)  # deliberate AOT compile, OUTSIDE the lint
+    [d] = p.lint(hbm_budget_bytes=10).by_code("TFG106")
+    assert "cost model" in d.message
+
+
+# ---------------------------------------------------------------------------
+# purity: a lint performs zero XLA compiles and zero device transfers
+# ---------------------------------------------------------------------------
+
+def test_lint_is_purely_static():
+    from tensorframes_tpu.ops.executor import (
+        _COMPILE_SECONDS,
+        _JIT_HITS,
+        _JIT_MISSES,
+    )
+
+    fr = tfs.frame_from_arrays({
+        "x": np.arange(8, dtype=np.float32),
+        "y": np.arange(8, dtype=np.float32),
+    })
+    programs = [
+        tfs.compile_program(lambda x: {"l": jnp.log(x)}, fr),
+        tfs.compile_program(lambda x, y: {"z": x + 1.0}, fr),
+        tfs.compile_program(lambda x: {"x": x * 1.0}, fr),
+    ]
+    before = (_JIT_HITS.value, _JIT_MISSES.value, _COMPILE_SECONDS.count)
+    for p in programs:
+        p.lint(hbm_budget_bytes=1 << 30)
+    analyze_frame(fr, lambda x: {"z": x * 2.0})
+    after = (_JIT_HITS.value, _JIT_MISSES.value, _COMPILE_SECONDS.count)
+    assert before == after, "lint must not touch the executor's jit path"
+
+
+# ---------------------------------------------------------------------------
+# strict= on the verbs
+# ---------------------------------------------------------------------------
+
+def test_strict_raises_on_error_severity(restore_config):
+    tfs.configure(donate_inputs=True)
+    fr = _frame()
+    p = tfs.compile_program(lambda x: {"x": x * 1.0}, fr)
+    with pytest.raises(tfs.StaticAnalysisError) as ei:
+        tfs.map_blocks(p, fr, trim=True, strict=True)
+    assert ei.value.diagnostics
+    assert ei.value.diagnostics[0].code == "TFG104"
+    assert isinstance(ei.value, tfs.ValidationError)  # error-family contract
+
+
+def test_strict_off_does_not_raise(restore_config):
+    tfs.configure(donate_inputs=True)
+    fr = _frame()
+    p = tfs.compile_program(lambda x: {"x": x * 1.0}, fr)
+    out = tfs.map_blocks(p, fr, trim=True).blocks()
+    assert len(out) >= 1
+
+
+def test_strict_clean_program_executes(restore_config):
+    fr = _frame(8, blocks=1)
+    out = tfs.map_blocks(lambda x: {"z": x + 3.0}, fr, strict=True)
+    np.testing.assert_allclose(
+        out.column_values("z"), np.arange(8, dtype=np.float32) + 4.0
+    )
+
+
+def test_strict_warn_only_does_not_raise():
+    fr = _frame(8, blocks=1)
+    # log hazard is warn-severity: strict admits it (strict raises on error)
+    out = tfs.map_rows(lambda x: {"l": jnp.log(x)}, fr, strict=True)
+    assert out.column_values("l").shape == (8,)
+
+
+def test_strict_on_fluent_forms(restore_config):
+    tfs.configure(donate_inputs=True)
+    fr = _frame()
+    p = tfs.compile_program(lambda x: {"x": x * 1.0}, fr)
+    with pytest.raises(tfs.StaticAnalysisError):
+        fr.map_blocks_trimmed(p, strict=True)
+
+
+# ---------------------------------------------------------------------------
+# reporting / telemetry surfaces
+# ---------------------------------------------------------------------------
+
+def test_diagnostic_explain_carries_fix_and_catalog_pointer():
+    p = tfs.compile_program(lambda x: {"l": jnp.log(x)}, _frame())
+    [d] = p.lint().by_code("TFG105")
+    text = d.explain()
+    assert "fix:" in text and "docs/analysis.md#tfg105" in text
+
+
+def test_report_ordering_and_counts(restore_config):
+    tfs.configure(donate_inputs=True)
+    fr = tfs.frame_from_arrays({
+        "x": np.arange(8, dtype=np.float32),
+        "y": np.arange(8, dtype=np.float32),
+    })
+    p = tfs.compile_program(lambda x, y: {"x": jnp.log(x)}, fr)
+    report = p.lint()
+    codes = [d.code for d in report]
+    assert codes[0] == "TFG104"  # errors sort first
+    counts = report.counts_by_severity()
+    assert counts["error"] == 1 and counts["info"] == 1
+    assert counts["warn"] >= 1
+
+
+def test_report_jsonl_round_trip(tmp_path):
+    p = tfs.compile_program(lambda x: {"l": jnp.log(x)}, _frame())
+    report = p.lint()
+    rows = [json.loads(ln) for ln in report.to_jsonl().splitlines()]
+    assert any(r["code"] == "TFG105" for r in rows)
+    out = tmp_path / "diag.jsonl"
+    n = save_jsonl(str(out))
+    assert n >= 1 and out.stat().st_size > 0
+
+
+def test_metrics_counter_increments_by_code():
+    def counter_value(code):
+        for m in REGISTRY.collect():
+            if m.name == "tftpu_analysis_diagnostics_total" and \
+                    dict(m.labels).get("code") == code:
+                return m.value
+        raise AssertionError("counter family missing")
+
+    before = counter_value("TFG103")
+    fr = tfs.frame_from_arrays({
+        "x": np.arange(8, dtype=np.float32),
+        "y": np.arange(8, dtype=np.float32),
+    })
+    tfs.compile_program(lambda x, y: {"z": x + 1.0}, fr).lint()
+    assert counter_value("TFG103") == before + 1
+
+
+def test_full_code_catalog_preregistered_in_exposition():
+    expo = REGISTRY.to_prometheus()
+    for code in CODES:
+        assert f'code="{code}"' in expo, f"{code} series missing at zero"
+
+
+def test_invalid_code_and_severity_rejected():
+    with pytest.raises(ValueError, match="unknown diagnostic code"):
+        Diagnostic("TFG999", "warn", "nope")
+    with pytest.raises(ValueError, match="unknown severity"):
+        Diagnostic("TFG101", "fatal", "nope")
+
+
+def test_analyze_frame_on_dsl_fetches():
+    fr = _frame(8, blocks=1)
+    with tfs.with_graph():
+        x = tfs.block(fr, "x")
+        fetch = tfs.log(x, name="lx")
+    report = analyze_frame(fr, [fetch])
+    assert "TFG105" in _codes(report)
+
+
+def test_analyze_frame_never_forces_a_lazy_frame():
+    fr = _frame(8, blocks=1)
+    lazy = tfs.map_blocks(lambda x: {"z": x + 1.0}, fr)  # pending compute
+    assert not lazy.is_materialized
+    analyze_frame(lazy, lambda z: {"w": z * 2.0})
+    assert not lazy.is_materialized
+
+
+# ---------------------------------------------------------------------------
+# CLI: StableHLO bundles end-to-end
+# ---------------------------------------------------------------------------
+
+def test_cli_lints_exported_bundle(tmp_path, capsys):
+    fr = _frame(8, blocks=1)
+    p = tfs.compile_program(lambda x: {"z": x + 3.0}, fr)
+    bundle = tmp_path / "add3.stablehlo"
+    tfs.save_program(p, str(bundle))
+    rc = cli_main([str(bundle)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "clean" in out and str(bundle) in out
+
+
+def test_cli_strict_exit_code_on_error_bundle(tmp_path, capsys, restore_config):
+    tfs.configure(donate_inputs=True)
+    fr = _frame(8, blocks=1)
+    p = tfs.compile_program(lambda x: {"x": x * 1.0}, fr)  # donation alias
+    bundle = tmp_path / "alias.stablehlo"
+    tfs.save_program(p, str(bundle))
+    assert cli_main([str(bundle)]) == 0  # non-strict: report only
+    capsys.readouterr()
+    assert cli_main(["--strict", str(bundle)]) == 1
+    assert "TFG104" in capsys.readouterr().out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    fr = _frame(8, blocks=1)
+    p = tfs.compile_program(lambda x: {"z": x + 3.0}, fr)
+    bundle = tmp_path / "add3.stablehlo"
+    tfs.save_program(p, str(bundle))
+    assert cli_main(["--json", str(bundle)]) == 0
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["counts"] == {"error": 0, "warn": 0, "info": 0}
+
+
+def test_cli_unreadable_bundle_exit_2(tmp_path, capsys):
+    bogus = tmp_path / "bogus.stablehlo"
+    bogus.write_bytes(b"not a bundle")
+    assert cli_main([str(bogus)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# DSL dtype-policy satellite
+# ---------------------------------------------------------------------------
+
+def test_dsl_zeros_ones_follow_float_policy_default():
+    # x64 on, demotion off (the suite default): policy is float64 —
+    # reference-parity programs unchanged
+    with tfs.with_graph():
+        assert tfs.zeros((2,)).dtype is dt.float64
+        assert tfs.ones((2,)).dtype is dt.float64
+        assert tfs.fill((2,), 1.5).dtype is dt.float64
+
+
+def test_dsl_zeros_ones_fill_demoted_policy(restore_config):
+    tfs.configure(demote_x64_on_tpu="always")
+    with tfs.with_graph():
+        assert tfs.zeros((2,)).dtype is dt.float32
+        assert tfs.ones((2,)).dtype is dt.float32
+        assert tfs.fill((2,), 1.5).dtype is dt.float32
+        # explicit dtype still wins (the documented escape hatch)
+        assert tfs.zeros((2,), dtype=np.float64).dtype is dt.float64
+        # int fills keep frame inference (int64), not the float policy
+        assert tfs.fill((2,), 3).dtype is dt.int64
+
+
+def test_dsl_constant_dtype_override():
+    with tfs.with_graph():
+        node = tfs.constant([1.0, 2.0], dtype=np.float32)
+        assert node.dtype is dt.float32
+
+
+# ---------------------------------------------------------------------------
+# repo self-lint (dev/lint_rules.py) — the CI lint job's second leg
+# ---------------------------------------------------------------------------
+
+def test_repo_self_lint_is_green():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "dev" / "lint_rules.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_rules_catches_seeded_violations(tmp_path):
+    bad = tmp_path / "tensorframes_tpu" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        "from tensorframes_tpu.observability.metrics import counter\n"
+        "_cache = {}\n"
+        "def f(x):\n"
+        "    _cache[x] = jax.jit(lambda v: v)\n"
+        "    return counter('late_metric')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "dev" / "lint_rules.py"), str(bad)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "TFL001" in proc.stdout  # bare jax.jit
+    assert "TFL002" in proc.stdout  # unguarded module state
+    assert "TFL003" in proc.stdout  # late metric registration

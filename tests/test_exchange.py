@@ -8,7 +8,6 @@ single-process degenerate exchange run on the virtual mesh.
 """
 
 import numpy as np
-import pytest
 
 from tensorframes_tpu.ops import exchange as xch
 

@@ -378,7 +378,7 @@ def test_f64_conv_graph_stays_faithful():
         x = tf.compat.v1.placeholder(tf.float64, [None, 8, 8, 2], name="x")
         c = tf.constant(w, dtype=tf.float64, name="w")
         y = tf.nn.conv2d(x, c, strides=[1, 1, 1, 1], padding="SAME", name="y")
-        m = tf.linalg.matmul(
+        tf.linalg.matmul(
             tf.reshape(y, [-1, 8 * 8 * 4]),
             tf.constant(rng.standard_normal((8 * 8 * 4, 3)), tf.float64),
             name="out",
@@ -441,7 +441,7 @@ def test_multi_output_ops_match_tf():
     # :k>0 into a single-output producer still rejected by name
     with tf.Graph().as_default() as g2:
         x2 = tf.compat.v1.placeholder(tf.float32, [None, 3], name="x")
-        c2 = tf.constant(np.eye(3, dtype=np.float32))
+        tf.constant(np.eye(3, dtype=np.float32))
         bm = tf.raw_ops.FusedBatchNorm(
             x=tf.reshape(x2, [-1, 1, 1, 3]), scale=[1.0, 1.0, 1.0],
             offset=[0.0, 0.0, 0.0], mean=[], variance=[],

@@ -314,7 +314,7 @@ def test_fused_batch_norm_inference_matches_tf():
             x, scale, offset, mean=mean, variance=var,
             epsilon=1e-3, is_training=False,
         )
-        out = tf.identity(y, name="out")
+        tf.identity(y, name="out")
     data = g.as_graph_def().SerializeToString()
     xv = np.random.default_rng(21).standard_normal((3, 5, 5, 4)).astype(
         np.float32

@@ -310,7 +310,6 @@ def test_read_csv_quoted_falls_back(tmp_path):
 
 
 def test_read_csv_native_matches_python(tmp_path):
-    import tensorframes_tpu.io as tio
     from tensorframes_tpu import native
 
     rng = np.random.default_rng(0)

@@ -2,7 +2,6 @@
 transformer integration (embedding quality + generation), HBM accounting."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

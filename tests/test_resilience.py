@@ -385,12 +385,11 @@ def _save_steps(root, steps, backend="npz"):
 def test_manifest_records_crc_and_size(tmp_path):
     import json
 
-    ck = _save_steps(tmp_path / "run", [1])
+    _save_steps(tmp_path / "run", [1])
     with open(tmp_path / "run" / "step_1" / "manifest.json") as f:
         manifest = json.load(f)
     entry = manifest[0]
     assert entry["nbytes"] == 4 * np.dtype(np.float64).itemsize or entry["nbytes"] > 0
-    raw = np.full((4,), 1.0).view(np.uint8)
     # crc matches an independent recomputation of the payload bytes
     assert entry["crc32"] == zlib.crc32(
         np.ascontiguousarray(np.full((4,), 1.0, np.dtype(entry["dtype"]))).tobytes()
@@ -711,7 +710,7 @@ def test_crashed_publish_heals_on_init(tmp_path):
     the new one leaves only step_N.old; the next Checkpointer init must
     rename it back so the step is never lost."""
     root = tmp_path / "run"
-    ck = _save_steps(root, [2, 4])
+    _save_steps(root, [2, 4])
     os.rename(root / "step_4", root / "step_4.old")  # simulate the window
     ck2 = Checkpointer(str(root), backend="npz")
     assert ck2.all_steps() == [2, 4]
@@ -755,7 +754,7 @@ def test_verify_returns_report_on_transient_read_errors(tmp_path, monkeypatch):
     """verify() must return its report — never raise — even when the
     payload read fails transiently (and keeps failing past the retry
     budget)."""
-    ck = _save_steps(tmp_path / "run", [1])
+    _save_steps(tmp_path / "run", [1])
     ck_flaky = Checkpointer(
         str(tmp_path / "run"), backend="npz",
         retry=RetryPolicy(max_attempts=2, backoff=0.001),

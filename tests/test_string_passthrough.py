@@ -17,7 +17,6 @@ form):
 * host columns round-trip binary content exactly.
 """
 
-import numpy as np
 import pytest
 
 import tensorframes_tpu as tfs

@@ -1,0 +1,307 @@
+"""validation.py error contract (ISSUE 3 satellite): every
+``ValidationError`` branch asserted via ``pytest.raises(match=...)`` on
+BOTH halves of the message — what the frame has ("available": columns /
+got-inputs) and what the program asked for ("requested": placeholders /
+expected inputs) — the reference's ``SchemaTransforms`` contract of
+enumerating both sides of every mismatch (DebugRowOps.scala:53-273).
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dtypes as dt
+from tensorframes_tpu.program import Program, TensorSpec
+from tensorframes_tpu.shape import Shape
+from tensorframes_tpu.validation import (
+    ValidationError,
+    validate_map,
+    validate_reduce_blocks,
+    validate_reduce_rows,
+)
+
+
+def _noop(feeds):
+    return feeds
+
+
+def _scalar_col_schema():
+    """One float32 column 'x' of scalar cells (block shape [?])."""
+    return tfs.frame_from_arrays({"x": np.arange(6, dtype=np.float32)}).schema
+
+
+def _vector_col_schema():
+    """One float32 column 'y' of (2,)-vector cells (block shape [?,2])."""
+    return tfs.frame_from_arrays(
+        {"y": np.zeros((6, 2), dtype=np.float32)}
+    ).schema
+
+
+# ---------------------------------------------------------------------------
+# validate_map
+# ---------------------------------------------------------------------------
+
+def test_map_unmatched_input_names_both_sides():
+    program = Program(_noop, [TensorSpec("zz", dt.float32, Shape([-1]))])
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Program input 'zz' does not match any column"
+              r".*Graph inputs: \['zz'\].*frame columns: \['x'\]",
+    ):
+        validate_map(program, _scalar_col_schema(), block=True)
+
+
+def test_map_dtype_mismatch_names_both_dtypes():
+    program = Program(_noop, [TensorSpec("x", dt.float64, Shape([-1]))])
+    with pytest.raises(
+        ValidationError,
+        match=r"Placeholder 'x' has dtype float64 but column 'x' has "
+              r"dtype float32\. No implicit casting",
+    ):
+        validate_map(program, _scalar_col_schema(), block=True)
+
+
+def test_map_rank_mismatch_names_both_ranks():
+    program = Program(_noop, [TensorSpec("x", dt.float32, Shape([-1, 3]))])
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Placeholder 'x' has rank 2 \(shape \[\?,3\]\) but the "
+              r"column's block shape is \[\?\] \(rank 1\)",
+    ):
+        validate_map(program, _scalar_col_schema(), block=True)
+
+
+def test_map_incompatible_shape_names_both_shapes():
+    program = Program(_noop, [TensorSpec("y", dt.float32, Shape([-1, 3]))])
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Placeholder 'y' declares shape \[\?,3\] which is "
+              r"incompatible with column shape \[\?,2\]",
+    ):
+        validate_map(program, _vector_col_schema(), block=True)
+
+
+def test_map_output_collision_names_outputs_and_columns():
+    program = Program(
+        _noop,
+        [TensorSpec("x", dt.float32, Shape([-1]))],
+        outputs=[TensorSpec("x", dt.float32, Shape([-1]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Output name\(s\) \['x'\] already exist as column\(s\)"
+              r".*\(columns: \['x'\]\).*must all differ",
+    ):
+        validate_map(program, _scalar_col_schema(), block=True)
+
+
+def test_map_scalar_block_output_rejected_with_alternatives():
+    program = Program(
+        _noop,
+        [TensorSpec("x", dt.float32, Shape([-1]))],
+        outputs=[TensorSpec("s", dt.float32, Shape(()))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)output 's' is a scalar; block outputs must have a "
+              r"leading row dimension.*trim=True.*reduce_blocks",
+    ):
+        validate_map(program, _scalar_col_schema(), block=True)
+
+
+def test_map_trim_allows_collision_and_scalars():
+    program = Program(
+        _noop,
+        [TensorSpec("x", dt.float32, Shape([-1]))],
+        outputs=[TensorSpec("x", dt.float32, Shape([-1]))],
+    )
+    validate_map(program, _scalar_col_schema(), block=True, trim=True)
+
+
+def test_map_demotion_exception_is_sanctioned(monkeypatch):
+    # the single allowed cast: f64 column → demoted f32 placeholder
+    schema = tfs.frame_from_arrays(
+        {"x": np.arange(6, dtype=np.float64)}
+    ).schema
+    program = Program(_noop, [TensorSpec("x", dt.float32, Shape([-1]))])
+    tfs.configure(demote_x64_on_tpu="always")
+    try:
+        validate_map(program, schema, block=True)  # no raise
+    finally:
+        tfs.configure(demote_x64_on_tpu=False)
+    with pytest.raises(ValidationError, match="No implicit casting"):
+        validate_map(program, schema, block=True)  # demotion off: rejected
+
+
+# ---------------------------------------------------------------------------
+# validate_reduce_blocks
+# ---------------------------------------------------------------------------
+
+def test_reduce_blocks_unknown_fetch_names_both_sides():
+    program = Program(
+        _noop,
+        [TensorSpec("nope_input", dt.float32, Shape([-1]))],
+        outputs=[TensorSpec("nope", dt.float32, Shape(()))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)reduce_blocks output 'nope' must correspond to an "
+              r"existing column.*Outputs: \['nope'\].*columns: \['y'\]",
+    ):
+        validate_reduce_blocks(program, _vector_col_schema())
+
+
+def test_reduce_blocks_wrong_input_set_names_expected_and_got():
+    program = Program(
+        _noop,
+        [TensorSpec("bad_input", dt.float32, Shape([-1, 2]))],
+        outputs=[TensorSpec("y", dt.float32, Shape([2]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)exactly one placeholder '<x>_input' per fetch"
+              r".*Expected inputs: \['y_input'\].*got: \['bad_input'\]",
+    ):
+        validate_reduce_blocks(program, _vector_col_schema())
+
+
+def test_reduce_blocks_placeholder_dtype_mismatch():
+    program = Program(
+        _noop,
+        [TensorSpec("y_input", dt.float64, Shape([-1, 2]))],
+        outputs=[TensorSpec("y", dt.float64, Shape([2]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"Placeholder 'y_input' has dtype float64 but column 'y' "
+              r"has dtype float32",
+    ):
+        validate_reduce_blocks(program, _vector_col_schema())
+
+
+def test_reduce_blocks_fetch_vs_input_dtype_mismatch():
+    program = Program(
+        _noop,
+        [TensorSpec("y_input", dt.float32, Shape([-1, 2]))],
+        outputs=[TensorSpec("y", dt.float64, Shape([2]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"Fetch 'y' has dtype float64 but its input 'y_input' has "
+              r"dtype float32; they must match",
+    ):
+        validate_reduce_blocks(program, _vector_col_schema())
+
+
+def test_reduce_blocks_rank_contract_names_both_shapes():
+    program = Program(
+        _noop,
+        [TensorSpec("y_input", dt.float32, Shape([-1, 2, 2]))],
+        outputs=[TensorSpec("y", dt.float32, Shape([2]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Placeholder 'y_input' \(shape \[\?,2,2\]\) must have "
+              r"exactly one more dimension than fetch 'y' \(shape \[2\]\)",
+    ):
+        validate_reduce_blocks(program, _vector_col_schema())
+
+
+def test_reduce_blocks_block_shape_incompatible():
+    program = Program(
+        _noop,
+        [TensorSpec("y_input", dt.float32, Shape([-1, 3]))],
+        outputs=[TensorSpec("y", dt.float32, Shape([3]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Placeholder 'y_input' declares shape \[\?,3\], "
+              r"incompatible with column block shape \[\?,2\]",
+    ):
+        validate_reduce_blocks(program, _vector_col_schema())
+
+
+# ---------------------------------------------------------------------------
+# validate_reduce_rows
+# ---------------------------------------------------------------------------
+
+def test_reduce_rows_unknown_fetch_names_both_sides():
+    program = Program(
+        _noop,
+        [
+            TensorSpec("nope_1", dt.float32, Shape(())),
+            TensorSpec("nope_2", dt.float32, Shape(())),
+        ],
+        outputs=[TensorSpec("nope", dt.float32, Shape(()))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)reduce_rows output 'nope' must correspond to an "
+              r"existing column.*Outputs: \['nope'\].*columns: \['x'\]",
+    ):
+        validate_reduce_rows(program, _scalar_col_schema())
+
+
+def test_reduce_rows_pairing_contract_names_expected_and_got():
+    program = Program(
+        _noop,
+        [TensorSpec("x_1", dt.float32, Shape(()))],  # x_2 missing
+        outputs=[TensorSpec("x", dt.float32, Shape(()))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)exactly two placeholders '<x>_1' and '<x>_2' per fetch"
+              r".*Expected: \['x_1', 'x_2'\].*got: \['x_1'\]",
+    ):
+        validate_reduce_rows(program, _scalar_col_schema())
+
+
+def test_reduce_rows_placeholder_dtype_mismatch():
+    program = Program(
+        _noop,
+        [
+            TensorSpec("x_1", dt.float64, Shape(())),
+            TensorSpec("x_2", dt.float64, Shape(())),
+        ],
+        outputs=[TensorSpec("x", dt.float64, Shape(()))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"Placeholder 'x_1' has dtype float64 but column 'x' has "
+              r"dtype float32",
+    ):
+        validate_reduce_rows(program, _scalar_col_schema())
+
+
+def test_reduce_rows_shape_contract_names_both_shapes():
+    program = Program(
+        _noop,
+        [
+            TensorSpec("x_1", dt.float32, Shape([3])),
+            TensorSpec("x_2", dt.float32, Shape(())),
+        ],
+        outputs=[TensorSpec("x", dt.float32, Shape(()))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Placeholder 'x_1' \(shape \[3\]\) must have the same "
+              r"shape as fetch 'x' \(shape \[\]\)",
+    ):
+        validate_reduce_rows(program, _scalar_col_schema())
+
+
+def test_reduce_rows_cell_shape_incompatible():
+    program = Program(
+        _noop,
+        [
+            TensorSpec("y_1", dt.float32, Shape([3])),
+            TensorSpec("y_2", dt.float32, Shape([3])),
+        ],
+        outputs=[TensorSpec("y", dt.float32, Shape([3]))],
+    )
+    with pytest.raises(
+        ValidationError,
+        match=r"(?s)Placeholder 'y_1' declares shape \[3\], incompatible "
+              r"with column cell shape \[2\]",
+    ):
+        validate_reduce_rows(program, _vector_col_schema())
