@@ -138,6 +138,53 @@ def _bench_add3(n_rows: int = 1_000_000, iters: int = 10,
     return _time_rows_per_sec(run_once, n_rows, iters)
 
 
+def _bench_chain3(n_rows: int = 1_000_000, iters: int = 8,
+                  num_blocks: int = 4):
+    """3-stage chained elementwise map (ISSUE 4): the plan layer fuses
+    the chain into ONE composed XLA program per block; TFTPU_FUSION=0
+    re-runs the identical chain per-stage. Returns (fused_wall_s,
+    unfused_wall_s); a ``# plan |`` summary (fused stages, intermediate
+    bytes avoided) prints from main() after the timed run."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import configure
+    from tensorframes_tpu.config import get_config
+
+    frame = tfs.frame_from_arrays(
+        {"x": np.arange(n_rows, dtype=np.float32)}, num_blocks=num_blocks
+    )
+    # stage programs pre-compiled once (the steady-state serving shape);
+    # each iteration rebuilds the chain, as a per-batch pipeline would
+    p1 = tfs.compile_program(lambda x: {"y": x * 2.0 + 1.0}, frame)
+    f1 = tfs.map_blocks(p1, frame)
+    p2 = tfs.compile_program(lambda y: {"z": y * 0.5 - 3.0}, f1)
+    f2 = tfs.map_blocks(p2, f1)
+    p3 = tfs.compile_program(lambda z: {"w": z * z + 1.0}, f2)
+
+    def run_once():
+        out = tfs.map_blocks(
+            p3, tfs.map_blocks(p2, tfs.map_blocks(p1, frame))
+        ).select(["w"])
+        for b in out.blocks():
+            _sync(b["w"])
+
+    def wall(iters_):
+        run_once()  # warm the jit caches out of the timed region
+        t0 = time.perf_counter()
+        for _ in range(iters_):
+            run_once()
+        return (time.perf_counter() - t0) / iters_
+
+    was = get_config().plan_fusion
+    try:
+        configure(plan_fusion=True)
+        fused_s = wall(iters)
+        configure(plan_fusion=False)  # the TFTPU_FUSION=0 path
+        unfused_s = wall(iters)
+    finally:
+        configure(plan_fusion=was)
+    return fused_s, unfused_s
+
+
 def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0,
                      int8: bool = False, sweep: Sequence[int] = (),
                      side: int = 299, compute_dtype: str = "bfloat16",
@@ -820,6 +867,31 @@ def main():
                       metric_keys=("logreg_map_blocks_rows_per_sec",))
     add3_rps = _try("add3", _bench_add3, 0.0,
                     metric_keys=("add3_map_blocks_rows_per_sec",))
+    chain3_fused_s, chain3_unfused_s = _try(
+        "chain3", _bench_chain3, (float("nan"), float("nan")),
+        metric_keys=("chain3_fused_1M_wall_s", "chain3_unfused_1M_wall_s"),
+    )
+    if chain3_fused_s == chain3_fused_s and chain3_unfused_s == chain3_unfused_s:
+        print(
+            "# plan | chain3 fused={:.4f}s unfused={:.4f}s ratio={:.2f}x "
+            "(acceptance: >= 1.5x on the CPU-fallback config)".format(
+                chain3_fused_s, chain3_unfused_s,
+                chain3_unfused_s / chain3_fused_s,
+            )
+        )
+    try:
+        from tensorframes_tpu.observability.metrics import (
+            REGISTRY as _plan_reg,
+        )
+
+        _plan_lines = [
+            ln for ln in _plan_reg.summary_lines()
+            if ln.startswith("tftpu_plan_")
+        ]
+        for ln in _plan_lines:
+            print(f"# plan | {ln}")
+    except Exception as e:  # telemetry must never kill the JSON line
+        print(f"# plan | snapshot unavailable: {e}")
     reduce_s = _try("reduce_blocks", _bench_reduce_blocks, float("nan"),
                     metric_keys=("reduce_blocks_1M_wall_s",))
     # HOST-frame variants: marshalling INCLUDED (the device-resident
@@ -1116,6 +1188,8 @@ def main():
         "read_csv_1M_rows_s": round(read_csv_s, 6),
         "add3_map_blocks_rows_per_sec": round(add3_rps),
         "add3_host_map_blocks_rows_per_sec": round(add3_host_rps),
+        "chain3_fused_1M_wall_s": round(chain3_fused_s, 6),
+        "chain3_unfused_1M_wall_s": round(chain3_unfused_s, 6),
         "logreg_host_map_blocks_rows_per_sec": round(logreg_host_rps),
         "reduce_blocks_1M_wall_s": round(reduce_s, 6),
         "reduce_blocks_host_1M_wall_s": round(reduce_host_s, 6),

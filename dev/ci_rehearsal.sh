@@ -97,6 +97,13 @@ run_step "Install check (package metadata + import from install target)" \
 run_step "Test (8-device virtual CPU mesh)" \
   env TFTPU_OBS_EXPORT="$WORK/obs" python -m pytest tests/ -x -q
 
+# ci.yml's fusion-off smoke: TFTPU_FUSION=0 (the plan layer's escape
+# hatch) must keep the verb/frame/sweep suites green on the per-stage
+# executor path (test_plan omitted: its fixture forces fusion ON; its
+# equivalence sweep runs the fallback internally)
+run_step "Fusion-off smoke (TFTPU_FUSION=0 fallback stays green)" \
+  env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py -q
+
 # ci.yml's observability smoke: the telemetry example must produce all
 # three artifacts (Chrome trace, metrics JSONL, step log) and the tier-1
 # run above must have exported its own pair

@@ -90,7 +90,9 @@ from .graphdef import (  # noqa: E402,F401
 from .bundle import restore_variables  # noqa: E402,F401
 from .validation import StaticAnalysisError, ValidationError  # noqa: E402,F401
 from . import analysis  # noqa: E402,F401
-from .analysis import analyze_frame, lint_program  # noqa: E402,F401
+from .analysis import analyze_frame, lint_plan, lint_program  # noqa: E402,F401
+from . import plan  # noqa: E402,F401  (registers tftpu_plan_* metrics)
+from .plan import explain_plan  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
     aggregate,
     compile_program,
@@ -143,6 +145,9 @@ __all__ = [
     "print_schema",
     "explain",
     "describe",
+    "plan",
+    "explain_plan",
+    "lint_plan",
     # aux subsystems
     "Checkpointer",
     "CheckpointCorruptionError",

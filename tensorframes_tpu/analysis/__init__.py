@@ -11,6 +11,8 @@ Surfaces:
 * :func:`lint_program` / ``Program.lint()`` — lint one program;
 * :func:`analyze_frame` — lint fetches against a frame, normalized
   exactly as the verbs would run them;
+* :func:`lint_plan` — lint a frame's logical plan chain (TFG107
+  fusion barriers between otherwise-fusable maps);
 * ``python -m tensorframes_tpu.analysis`` — lint serialized StableHLO
   bundles (CLI);
 * ``strict=True`` on the verbs — raise
@@ -18,7 +20,7 @@ Surfaces:
   error-severity diagnostic before dispatch.
 """
 
-from .analyzer import analyze_frame, lint_program  # noqa: F401
+from .analyzer import analyze_frame, lint_plan, lint_program  # noqa: F401
 from .diagnostics import (  # noqa: F401
     CODES,
     Diagnostic,
@@ -31,6 +33,7 @@ __all__ = [
     "Diagnostic",
     "DiagnosticReport",
     "analyze_frame",
+    "lint_plan",
     "lint_program",
     "save_jsonl",
 ]

@@ -26,7 +26,7 @@ from typing import Optional, Sequence, Tuple
 from .diagnostics import DiagnosticReport
 from .rules import RuleContext, run_rules
 
-__all__ = ["lint_program", "analyze_frame"]
+__all__ = ["lint_program", "analyze_frame", "lint_plan"]
 
 
 def _trace(program, probe: int):
@@ -162,4 +162,21 @@ def analyze_frame(
         block_row_counts=counts,
         hbm_budget_bytes=hbm_budget_bytes,
         subject=f"fetches×frame({', '.join(frame.schema.names)})",
+    )
+
+
+def lint_plan(frame) -> DiagnosticReport:
+    """Lint a frame's *logical plan* (TFG107 fusion-barrier): warn when
+    a chain's otherwise-fusable map stages are split by a barrier —
+    a host-callback stage, a ``to_host``/``to_numpy`` materialization
+    or repartition between maps, a trim map, or ragged source cells.
+    Each finding's ``explain()`` names the barrier. Purely static over
+    the recorded plan chain — never forces a lazy frame."""
+    from ..plan.ir import chain_barriers
+
+    n_maps, barriers = chain_barriers(frame)
+    ctx = RuleContext(program=None, plan_barriers=barriers)
+    diags = run_rules(ctx, codes=["TFG107"])
+    return DiagnosticReport(
+        diags, subject=f"plan({n_maps} map stage(s))"
     )

@@ -48,6 +48,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "TFG104": ("donation-alias", "error"),
     "TFG105": ("nan-hazard", "warn"),
     "TFG106": ("hbm-budget", "warn"),
+    "TFG107": ("fusion-barrier", "warn"),
 }
 
 # Pre-register the full counter family at import: one series per code,

@@ -24,6 +24,13 @@ Every rule is grounded in a hazard this codebase has already paid for:
 * **TFG106 hbm-budget** — static residency estimate (hoisted consts +
   probe-batch inputs + outputs) against the device memory budget, a
   warning *before* the first OOM instead of a crash after it.
+* **TFG107 fusion-barrier** — a verb chain whose otherwise-fusable map
+  stages are split by a fusion barrier (host callback, ``to_host`` /
+  ``to_numpy`` materialization, ragged regrouping, trim): each split
+  pays a fresh XLA dispatch plus intermediate materialization the plan
+  layer (:mod:`tensorframes_tpu.plan`) would otherwise have fused away.
+  Runs from :func:`~tensorframes_tpu.analysis.lint_plan` only — it
+  needs a frame's plan chain, not a single program.
 
 Rules never execute or compile anything: they read specs, the traced
 jaxpr, and config. Tracing itself (``jax.make_jaxpr``) happens once in
@@ -63,6 +70,9 @@ class RuleContext:
     block_row_counts: Optional[Tuple[int, ...]] = None
     hbm_budget_bytes: Optional[int] = None
     trace_error: Optional[BaseException] = None
+    #: Fusion barriers found on a frame's plan chain (lint_plan only):
+    #: dicts with ``reason``, ``upstream_maps``, ``downstream_maps``.
+    plan_barriers: Optional[Sequence[dict]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -547,6 +557,36 @@ def _rule_hbm_budget(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG107 — fusion-barrier (plan-chain rule: lint_plan only)
+# ---------------------------------------------------------------------------
+
+def _rule_fusion_barrier(ctx: RuleContext) -> List[Diagnostic]:
+    if not ctx.plan_barriers:
+        return []
+    out: List[Diagnostic] = []
+    for b in ctx.plan_barriers:
+        up = int(b.get("upstream_maps", 0))
+        down = int(b.get("downstream_maps", 0))
+        if up + down < 1:
+            continue  # a barrier with no fusable neighbor splits nothing
+        up_txt = str(up) if b.get("upstream_exact", True) else f">={up}"
+        out.append(Diagnostic(
+            "TFG107", "warn",
+            f"chain contains a fusion barrier — {b['reason']} — between "
+            f"otherwise-fusable map stages ({up_txt} upstream, {down} "
+            "downstream): each side dispatches its own XLA program and "
+            "the boundary materializes every intermediate column",
+            subject=str(b["reason"]).split(":")[0],
+            fix="move the barrier out of the hot chain (materialize once "
+                "up front, run analyze() to densify ragged columns, keep "
+                "host callbacks out of chained stages), or accept the "
+                "split — the plan layer already fuses each side "
+                "separately",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -557,6 +597,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG104": _rule_donation_alias,
     "TFG105": _rule_nan_hazard,
     "TFG106": _rule_hbm_budget,
+    "TFG107": _rule_fusion_barrier,
 }
 
 

@@ -97,6 +97,14 @@ class Config:
     # real-TPU window shows it beating the XLA structural fusion —
     # dev/tpu_smoke.py prints the adjudicating comparison.
     pallas_int8_matmul: bool = _env_bool("TFTPU_PALLAS_INT8_MM", False)
+    # Lazy verb-chain fusion (tensorframes_tpu/plan): chained lazy maps
+    # record a logical plan instead of nesting compute thunks, and each
+    # maximal fusable run lowers to ONE composed XLA program dispatched
+    # once per block — per-stage jit dispatch, device<->host transfers
+    # and intermediate materialization disappear. TFTPU_FUSION=0 is the
+    # escape hatch back to per-stage execution (bit-identical results;
+    # the fused path exists purely for speed).
+    plan_fusion: bool = _env_bool("TFTPU_FUSION", True)
     # Demote f64/i64 device columns to f32/i32 at the device boundary:
     # False = never (reference-parity precision, f64 emulated on TPU),
     # True = on TPU backends only, "always" = every backend (testing /

@@ -11,10 +11,13 @@ list of *blocks* (≙ Spark partitions); each block maps column name →
 
 Verbs are **lazy**, like the reference's map verbs under Spark
 (core.py:232-233 "the result is lazy and will not be computed until
-requested"): ``map_*`` returns a frame carrying a pending compute thunk;
-``collect()`` / ``blocks()`` forces it once and caches. Chained lazy maps
-therefore fuse into a single XLA program per block — a fusion win the
-reference structurally could not get across two Spark stages.
+requested"): ``map_*`` returns a frame carrying a pending computation;
+``collect()`` / ``blocks()`` forces it once and caches. Chained lazy
+maps record a logical plan (:mod:`tensorframes_tpu.plan`) and each
+maximal fusable run lowers to a SINGLE composed XLA program dispatched
+once per block — a fusion win the reference structurally could not get
+across two Spark stages (``TFTPU_FUSION=0`` restores per-stage
+execution; results are bit-identical either way).
 
 Shape discovery parity:
 
@@ -237,6 +240,13 @@ class TensorFrame:
                 if self._blocks is None:
                     self._blocks = self._pending()
                     self._pending = None
+                    # a recorded logical plan is spent once the blocks
+                    # exist: drop it so the node chain (and through it
+                    # the source frame's buffers) isn't pinned for this
+                    # frame's lifetime. Downstream lazy chains hold
+                    # their own node references and re-root here via
+                    # is_materialized, never through this attribute.
+                    self._plan = None
         return self._blocks
 
     @property
@@ -385,6 +395,29 @@ class TensorFrame:
         if self.is_materialized:
             blocks = [{n: b[n] for n in names} for b in self._blocks]
             return TensorFrame(blocks, schema)
+        from .plan import ir as _plan_ir
+
+        if _plan_ir.fusion_enabled():
+            # pending frame: record the projection on the logical plan —
+            # pushdown then prunes dead upstream outputs (and whole
+            # stages) so dropped columns are never computed, gathered,
+            # or transferred (plan/rules.py)
+            node = _plan_ir.PlanNode(
+                "select",
+                parent=_plan_ir.node_for_parent(self),
+                names=list(names),
+                schema=schema,
+            )
+
+            def pending():
+                from .plan.lower import execute_plan
+
+                return execute_plan(node)
+
+            out = TensorFrame(None, schema, pending=pending)
+            node.bind(out)
+            out._plan = node
+            return out
         parent = self
         return TensorFrame(
             None, schema, pending=lambda: [{n: b[n] for n in names} for b in parent.blocks()]
@@ -425,6 +458,38 @@ class TensorFrame:
         schema = self.schema
         names = list(schema.names)
         parent = self
+
+        if (
+            getattr(masked, "_plan", None) is not None
+            and not self.is_sharded
+        ):
+            import jax as _jax
+
+            from .plan import ir as _plan_ir
+
+            if _jax.process_count() == 1:
+                # single-process device-evaluable predicate: the mask
+                # program fuses into the upstream run (one dispatch
+                # computes upstream outputs AND the mask); the row
+                # subsetting itself splits the plan — its output row
+                # count is data-dependent. Multi-process and sharded
+                # frames keep the explicit paths below.
+                node = _plan_ir.PlanNode(
+                    "filter",
+                    parent=masked._plan,
+                    mask_name=mname,
+                    schema=schema,
+                )
+
+                def plan_pending():
+                    from .plan.lower import execute_plan
+
+                    return execute_plan(node)
+
+                out = TensorFrame(None, schema, pending=plan_pending)
+                node.bind(out)
+                out._plan = node
+                return out
 
         def compute() -> List[Block]:
             new_blocks: List[Block] = []
@@ -480,42 +545,13 @@ class TensorFrame:
                             nb[name] = np.asarray(v_loc)[m_loc]
                     new_blocks.append(nb)
                     continue
-                m = np.asarray(mv)
-                if m.dtype != np.bool_ or m.ndim != 1:
-                    raise ValueError(
-                        f"filter predicate output {mname!r} must be "
-                        f"bool[rows]; got {m.dtype} with shape {m.shape}"
-                    )
-                rows = _block_num_rows({n_: b[n_] for n_ in names})
-                if m.shape[0] != rows:
-                    # must fail LOUDLY on both paths: jax gather clamps
-                    # out-of-bounds indices, so an oversized mask would
-                    # silently duplicate the last row on device columns
-                    # where numpy's boolean index raises
-                    raise ValueError(
-                        f"filter predicate output {mname!r} has "
-                        f"{m.shape[0]} rows for a block of {rows}"
-                    )
-                nb: Block = {}
-                idx = None
-                for name in names:
-                    v = b[name]
-                    if isinstance(v, list):
-                        nb[name] = [x for x, keep in zip(v, m) if keep]
-                    elif _is_jax_array(v):
-                        # device columns subset ON DEVICE: only the
-                        # 1-byte-per-row mask crosses to host (to fix
-                        # the data-dependent output size); the payload
-                        # gathers in HBM instead of round-tripping
-                        # (r3 noted filter forced device frames host)
-                        if idx is None:
-                            import jax.numpy as jnp
+                # single-process subsetting (bool[rows] validation, loud
+                # row-count mismatch, device columns gathered in HBM)
+                # lives in ONE place, shared with the plan lowering's
+                # fused filter — the two paths must never diverge
+                from .plan.lower import _apply_mask
 
-                            idx = jnp.asarray(np.flatnonzero(m))
-                        nb[name] = v[idx]
-                    else:
-                        nb[name] = np.asarray(v)[m]
-                new_blocks.append(nb)
+                new_blocks.append(_apply_mask(b, names, mname))
             return new_blocks
 
         # lazy like every sibling transform: the mask + gather run when
@@ -1423,7 +1459,11 @@ class TensorFrame:
         out_blocks = []
         for lo, hi in bounds:
             out_blocks.append({k: v[lo:hi] for k, v in merged.items()})
-        return TensorFrame(out_blocks, self.schema)
+        out = TensorFrame(out_blocks, self.schema)
+        from .plan import ir as _plan_ir
+
+        _plan_ir.mark_barrier(out, "repartition materialization", self)
+        return out
 
     def cache(self) -> "TensorFrame":
         self.blocks()
@@ -1516,6 +1556,14 @@ class TensorFrame:
         frame = TensorFrame(host_blocks, self.schema)
         if num_blocks:
             frame = frame.repartition(num_blocks)
+        from .plan import ir as _plan_ir
+
+        # explicit materialization: downstream chains re-root here
+        # (TFG107 names this when fusable maps sit on both sides) —
+        # marked AFTER any repartition so the returned frame carries it
+        _plan_ir.mark_barrier(
+            frame, "to_host/to_numpy materialization", self
+        )
         return frame
 
     # -- verb methods (≙ Implicits.RichDataFrame, dsl/Implicits.scala:25-100:

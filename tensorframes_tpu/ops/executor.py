@@ -61,6 +61,12 @@ _PADDING_WASTE = _counter(
     "tftpu_executor_padding_waste_rows_total",
     "Rows added by bucket padding of the vmapped lead dim",
 )
+_GATHER_BYTES = _counter(
+    "tftpu_executor_gather_bytes_total",
+    "Bytes of feed columns gathered for program dispatch — the plan "
+    "layer's select pushdown shows up as this counter NOT growing for "
+    "pruned columns",
+)
 
 
 def donation_supported() -> bool:
@@ -331,6 +337,9 @@ def gather_feeds(
             if getattr(v, "dtype", None) != spec.dtype.np_dtype:
                 v = v.astype(spec.dtype.np_dtype)
         feeds[name] = v
+        nbytes = getattr(v, "nbytes", 0)
+        if nbytes:
+            _GATHER_BYTES.inc(int(nbytes))
     return feeds
 
 

@@ -65,6 +65,42 @@ logger = get_logger(__name__)
 Fetches = Union[Node, Sequence[Node], Program, Callable]
 
 
+def _plan_map_result(
+    frame, program: Program, schema: Schema, rows: bool
+) -> Optional["TensorFrame"]:
+    """Record this map stage on the frame's logical plan instead of
+    nesting another compute thunk (tensorframes_tpu/plan): at force
+    time the whole chain lowers to one composed XLA dispatch per block.
+    Returns None when planning is off (TFTPU_FUSION=0) or re-entrant
+    (the lowering pass executes through these same verbs)."""
+    from ..plan import ir as plan_ir
+
+    if not plan_ir.fusion_enabled():
+        return None
+    node = plan_ir.PlanNode(
+        "map",
+        parent=plan_ir.node_for_parent(frame),
+        program=program,
+        rows=rows,
+        out_names=[o.name for o in program.outputs],
+        schema=schema,
+    )
+
+    def pending():
+        from ..plan.lower import execute_plan
+
+        return execute_plan(node)
+
+    result = TensorFrame(None, schema, pending=pending)
+    node.bind(result)
+    result._plan = node
+    result._produced_by_map = True
+    if frame.is_sharded:
+        result._mesh = frame.mesh
+        result._axis = getattr(frame, "_axis", None)
+    return result
+
+
 def _is_pandas(obj) -> bool:
     try:
         import pandas as pd
@@ -322,12 +358,15 @@ def map_blocks(
     validate_map(program, frame.schema, block=True, trim=trim)
     if strict:
         _strict_lint(program, frame, block_mode=True)
-    compiled = program.compiled()
     out_infos = _sorted_output_infos(program, block_mode=True)
     if trim:
         schema = Schema(out_infos)
     else:
         schema = Schema(out_infos + frame.schema.columns)
+        planned = _plan_map_result(frame, program, schema, rows=False)
+        if planned is not None:
+            return planned
+    compiled = program.compiled()
     parent = frame
     input_names = program.input_names
     sharded = frame.is_sharded
@@ -414,6 +453,15 @@ def map_blocks(
         return out_blocks
 
     result = TensorFrame(None, schema, pending=compute)
+    result._produced_by_map = True
+    if trim:
+        # a row-count-changing map is a fusion barrier: downstream
+        # chains re-root here (TFG107 names it when maps sit both sides)
+        from ..plan import ir as plan_ir
+
+        plan_ir.mark_barrier(
+            result, "trim map_blocks (row-count-changing output)", frame
+        )
     if sharded:
         result._mesh = frame.mesh
         result._axis = getattr(frame, "_axis", None)
@@ -620,9 +668,12 @@ def map_rows(
     validate_map(program, frame.schema, block=False)
     if strict:
         _strict_lint(program, frame, block_mode=False)
-    compiled = program.compiled()
     out_infos = _sorted_output_infos(program, block_mode=False)
     schema = Schema(out_infos + frame.schema.columns)
+    planned = _plan_map_result(frame, program, schema, rows=True)
+    if planned is not None:
+        return planned
+    compiled = program.compiled()
     parent = frame
     input_names = program.input_names
 
@@ -703,6 +754,7 @@ def map_rows(
         return results
 
     result = TensorFrame(None, schema, pending=compute)
+    result._produced_by_map = True
     if frame.is_sharded:
         result._mesh = frame.mesh
         result._axis = getattr(frame, "_axis", None)

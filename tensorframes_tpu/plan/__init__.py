@@ -1,0 +1,46 @@
+"""Lazy verb-chain fusion: logical plans over frames, lowered to one
+XLA dispatch per block.
+
+The reference ran one TF Session per partition *per operation*; the
+port's frames were already lazy, but a chain like ``map_blocks ->
+map_rows -> select`` still materialized every intermediate and paid a
+fresh jit dispatch (plus device<->host transfers and output validation)
+per stage. This package records chains as a small plan IR instead
+(:mod:`.ir`), prunes and segments them (:mod:`.rules`), and lowers each
+maximal fusable run into a single composed Program dispatched once per
+block through the unchanged executor machinery (:mod:`.lower`).
+
+Fused and per-stage execution are bit-identical by contract; barriers
+(ragged cells, host callbacks, trim row-count changes, data-dependent
+filters, explicit materialization) split the plan honestly instead of
+changing semantics. ``TFTPU_FUSION=0`` / ``configure(plan_fusion=False)``
+disables planning entirely.
+
+Importing this package registers the ``tftpu_plan_*`` metrics family,
+so expositions carry it from process start.
+"""
+
+from .ir import (  # noqa: F401
+    PlanNode,
+    chain_barriers,
+    explain_plan,
+    fusion_enabled,
+    mark_barrier,
+    node_for_parent,
+    parent_is_fusable,
+    program_has_callback,
+    resolve_chain,
+)
+from .lower import execute_plan  # noqa: F401
+from .rules import SegmentPlan, plan_segment, split_segments  # noqa: F401
+
+__all__ = [
+    "PlanNode",
+    "SegmentPlan",
+    "chain_barriers",
+    "execute_plan",
+    "explain_plan",
+    "fusion_enabled",
+    "plan_segment",
+    "split_segments",
+]

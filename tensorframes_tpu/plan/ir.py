@@ -1,0 +1,315 @@
+"""The logical-plan IR: verb chains recorded as linked nodes.
+
+Every lazy verb on a frame appends one :class:`PlanNode` instead of
+nesting another compute thunk, so at force time the whole chain is
+visible at once and :mod:`.lower` can fuse each maximal run of map
+stages into a single composed XLA program per block (the Flare /
+HiFrames observation: operator-chain fusion dominates per-operator
+execution — and XLA gives us the kernel fusion for free once the chain
+is composed under one jit).
+
+Node kinds:
+
+* ``source`` — wraps a frame with no (live) plan: the chain's input.
+* ``map`` — one map_blocks (``rows=False``) or map_rows (``rows=True``)
+  stage carrying its normalized, feed_dict-renamed :class:`Program`.
+* ``select`` — column projection; drives pushdown pruning in
+  :mod:`.rules` so dead columns are never computed, gathered, or
+  transferred.
+* ``filter`` — row subsetting by the mask column its parent ``map``
+  stage computes; fuses the mask program into the upstream run and
+  splits the chain for downstream stages (a data-dependent row count is
+  a fusion barrier by nature).
+
+Nodes hold a **weak** reference to the frame they describe: if an
+intermediate frame was already forced (or an internal mask frame was
+collected), :func:`resolve_chain` re-roots the chain there instead of
+recomputing upstream stages.
+
+Fusion barriers that do NOT create nodes (trim maps, ``to_host``,
+``repartition``, host-callback programs) mark the frames they produce
+via :func:`mark_barrier`, which the TFG107 analysis rule reads through
+:func:`chain_barriers`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = [
+    "PlanNode",
+    "fusion_enabled",
+    "lowering",
+    "lowering_active",
+    "node_for_parent",
+    "resolve_chain",
+    "mark_barrier",
+    "parent_is_fusable",
+    "program_has_callback",
+    "chain_barriers",
+    "explain_plan",
+]
+
+
+class PlanNode:
+    """One step of a logical plan (immutable after construction)."""
+
+    __slots__ = (
+        "kind",        # 'source' | 'map' | 'select' | 'filter'
+        "parent",      # upstream PlanNode (None for source)
+        "source_frame",  # kind == 'source': the wrapped frame (strong ref)
+        "program",     # kind == 'map': normalized Program (feed_dict applied)
+        "rows",        # kind == 'map': True for map_rows semantics
+        "out_names",   # kind == 'map': the program's output column names
+        "names",       # kind == 'select': kept column names, in order
+        "mask_name",   # kind == 'filter': the mask column (parent map's out)
+        "schema",      # result Schema of this node's frame
+        "_frame_ref",  # weakref to the frame this node describes
+        "_extended",   # a downstream node already chains on this one
+    )
+
+    def __init__(
+        self,
+        kind: str,
+        parent: Optional["PlanNode"] = None,
+        source_frame=None,
+        program=None,
+        rows: bool = False,
+        out_names: Sequence[str] = (),
+        names: Sequence[str] = (),
+        mask_name: Optional[str] = None,
+        schema=None,
+    ):
+        self.kind = kind
+        self.parent = parent
+        self.source_frame = source_frame
+        self.program = program
+        self.rows = rows
+        self.out_names = tuple(out_names)
+        self.names = tuple(names)
+        self.mask_name = mask_name
+        self.schema = schema
+        self._frame_ref = None
+        self._extended = False
+
+    def bind(self, frame) -> "PlanNode":
+        self._frame_ref = weakref.ref(frame)
+        return self
+
+    def frame(self):
+        return self._frame_ref() if self._frame_ref is not None else None
+
+    def __repr__(self) -> str:
+        if self.kind == "map":
+            verb = "map_rows" if self.rows else "map_blocks"
+            return f"{verb}({', '.join(self.out_names)})"
+        if self.kind == "select":
+            return f"select({list(self.names)})"
+        if self.kind == "filter":
+            return f"filter(mask={self.mask_name!r})"
+        return "source"
+
+
+# ---------------------------------------------------------------------------
+# lowering re-entrancy guard: the lowering pass executes stages through
+# the ordinary verbs, which must not re-plan while it runs
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def lowering_active() -> bool:
+    return getattr(_TLS, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def lowering():
+    _TLS.depth = getattr(_TLS, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _TLS.depth -= 1
+
+
+def fusion_enabled() -> bool:
+    """True when verbs should record plan nodes: the ``plan_fusion``
+    knob is on (``TFTPU_FUSION=0`` is the escape hatch) and we are not
+    inside the lowering pass itself."""
+    from ..config import get_config
+
+    return bool(get_config().plan_fusion) and not lowering_active()
+
+
+def node_for_parent(frame) -> PlanNode:
+    """The plan node a new stage should chain onto: the parent's own
+    plan when it is still lazy and unbranched, else a fresh source
+    wrapping the frame. The branch rule bounds duplicate work on
+    DAG-shaped pipelines: the FIRST consumer extends the chain (and
+    will recompute the shared prefix in-register, fused); every LATER
+    consumer sources on the frame itself, so forcing it materializes
+    the shared prefix exactly once (cached on the frame) instead of
+    re-running it inside each branch's fused program."""
+    node = getattr(frame, "_plan", None)
+    if node is not None and not frame.is_materialized:
+        if not node._extended:
+            node._extended = True
+            return node
+    return PlanNode("source", source_frame=frame, schema=frame.schema)
+
+
+def resolve_chain(node: PlanNode) -> Tuple[object, List[PlanNode]]:
+    """Walk ``node``'s ancestry to the effective source: the first
+    source node, or the first intermediate frame that has already been
+    forced (its cached blocks are authoritative — recomputing upstream
+    stages would be wasted work). Returns ``(source_frame, nodes)`` with
+    ``nodes`` ordered source-most first, ending at ``node``."""
+    nodes: List[PlanNode] = []
+    cur = node
+    while True:
+        if cur.kind == "source":
+            return cur.source_frame, list(reversed(nodes))
+        f = cur.frame()
+        if f is not None and f.is_materialized and nodes:
+            return f, list(reversed(nodes))
+        nodes.append(cur)
+        cur = cur.parent
+
+
+# ---------------------------------------------------------------------------
+# barrier bookkeeping (read by the TFG107 analysis rule)
+# ---------------------------------------------------------------------------
+
+def parent_is_fusable(frame) -> bool:
+    """True when ``frame`` came out of a (fusable) map chain — the
+    'otherwise-fusable maps' half of the TFG107 condition."""
+    return (
+        getattr(frame, "_plan", None) is not None
+        or getattr(frame, "_produced_by_map", False)
+    )
+
+
+def mark_barrier(frame, reason: str, parent) -> None:
+    """Record that ``frame`` was produced by a fusion barrier so a later
+    ``lint_plan`` can name it (TFG107). No-op semantics otherwise."""
+    try:
+        frame._fusion_barrier = reason
+        frame._fusion_barrier_upstream = parent_is_fusable(parent)
+    except AttributeError:  # pragma: no cover - exotic frame-likes
+        pass
+
+
+def program_has_callback(program) -> bool:
+    """True when the program's jaxpr contains a host-callback primitive
+    (``pure_callback`` / ``io_callback`` / ``debug_callback`` …): such a
+    stage executes per-stage so callback batching semantics stay exactly
+    the single-verb ones. Cached on the Program; a trace failure is
+    treated as a callback (conservative: never fuse what we cannot
+    see)."""
+    cached = getattr(program, "_tftpu_has_callback", None)
+    if cached is not None:
+        return cached
+    try:
+        import jax
+
+        from ..program import _abstract_inputs
+
+        closed = jax.make_jaxpr(program.fn)(
+            _abstract_inputs(program.inputs, 3)
+        )
+        from ..analysis.rules import _iter_eqns
+
+        has = any(
+            "callback" in eqn.primitive.name for eqn in _iter_eqns(closed.jaxpr)
+        )
+    except Exception:
+        has = True
+    try:
+        program._tftpu_has_callback = has
+    except AttributeError:  # pragma: no cover
+        pass
+    return has
+
+
+def chain_barriers(frame):
+    """Inspect ``frame``'s plan chain for fusion barriers sitting
+    between otherwise-fusable maps — the TFG107 evidence. Returns
+    ``(n_map_stages, barriers)`` where each barrier is a dict with
+    ``reason``, ``upstream_maps``, ``downstream_maps``. Never forces a
+    lazy frame."""
+    node = getattr(frame, "_plan", None)
+    barriers: List[dict] = []
+    if node is None:
+        return 0, barriers
+    source, nodes = resolve_chain(node)
+    maps = [n for n in nodes if n.kind == "map"]
+    # host-callback stages inside the chain split the fused run as soon
+    # as they have a fusable neighbor on either side
+    for i, n in enumerate(maps):
+        if len(maps) >= 2 and program_has_callback(n.program):
+            barriers.append({
+                "reason": "host callback in "
+                          + ("map_rows" if n.rows else "map_blocks")
+                          + f" stage producing {list(n.out_names)}",
+                "upstream_maps": i,
+                "downstream_maps": len(maps) - i - 1,
+            })
+    # a source frame produced by a barrier op, with fusable maps both
+    # upstream (recorded on the source) and downstream (this chain)
+    reason = getattr(source, "_fusion_barrier", None)
+    if reason and getattr(source, "_fusion_barrier_upstream", False) and maps:
+        # the upstream chain's plan was dropped when the barrier forced
+        # it, so only "at least one fusable map" is knowable here
+        barriers.append({
+            "reason": reason,
+            "upstream_maps": 1,
+            "upstream_exact": False,
+            "downstream_maps": len(maps),
+        })
+    # ragged source columns feeding a fusable run execute per-stage
+    # (ragged regrouping); only checkable without forcing when the
+    # source is already materialized
+    if len(maps) >= 2 and getattr(source, "is_materialized", False):
+        try:
+            from ..ops.executor import block_is_ragged
+
+            src_names = set(source.schema.names)
+            ragged_ins = sorted({
+                i
+                for n in maps
+                for i in n.program.input_names
+                if i in src_names and any(
+                    block_is_ragged(b, [i]) for b in source.blocks()
+                )
+            })
+            if ragged_ins:
+                barriers.append({
+                    "reason": "ragged regrouping: column(s) "
+                              f"{ragged_ins} hold ragged cells",
+                    "upstream_maps": 1,
+                    "upstream_exact": False,
+                    "downstream_maps": len(maps) - 1,
+                })
+        except Exception:  # pragma: no cover - lint must never raise
+            pass
+    return len(maps), barriers
+
+
+def explain_plan(frame) -> str:
+    """Render a frame's logical plan, one node per line (source first).
+    Frames without a plan render as a single ``source`` line."""
+    node = getattr(frame, "_plan", None)
+    if node is None:
+        state = "materialized" if frame.is_materialized else "lazy"
+        return f"source ({state}, {len(frame.schema.names)} column(s))"
+    source, nodes = resolve_chain(node)
+    lines = [
+        "source ("
+        + ("materialized" if source.is_materialized else "lazy")
+        + f", columns={list(source.schema.names)})"
+    ]
+    for n in nodes:
+        lines.append(f"  -> {n!r}")
+    return "\n".join(lines)

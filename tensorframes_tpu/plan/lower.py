@@ -1,0 +1,430 @@
+"""Lowering: turn a plan chain into (ideally) ONE dispatch per block.
+
+``execute_plan`` is the pending computation of every plan-carrying
+frame. It resolves the chain to its effective source, splits it into
+segments at filters (:mod:`.rules`), and runs each segment either
+
+* **fused** — the segment's included map stages compose into a single
+  :class:`~tensorframes_tpu.program.Program` (map_rows stages enter in
+  their vmapped form) that dispatches through the ordinary
+  ``map_blocks`` machinery, so the jit cache, input donation, the
+  prefetch window, and the sharded paths all apply unchanged; or
+* **per-stage fallback** — the exact single-verb execution, taken when
+  a runtime barrier shows up (ragged source cells, a host-callback
+  stage, a trace failure) or when fusing would not help (a bare single
+  map keeps its specialized path, lead-dim bucketing included).
+
+Fused programs are cached by stage identity so steady-state serving
+loops (rebuild the chain each batch from the same pre-compiled
+Programs) reuse one XLA executable instead of re-tracing per force.
+
+Observability: ``tftpu_plan_*`` metrics are registered at import (the
+fused-stages counter, the intermediate-bytes-avoided counter, the
+plan-lowering-seconds histogram, and per-reason fallback counters) and
+``plan.lower`` / ``plan.execute`` spans land on the structured trace
+timeline when tracing is on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..observability import events as _events
+from ..observability.metrics import counter as _counter
+from ..observability.metrics import histogram as _histogram
+from ..utils import get_logger
+from . import ir
+from .rules import SegmentPlan, plan_segment, split_segments
+
+logger = get_logger(__name__)
+
+__all__ = ["execute_plan"]
+
+# Registered at import so expositions always carry the plan family
+# (a process that never fused reads 0 — the series does not vanish).
+_FUSED_STAGES = _counter(
+    "tftpu_plan_fused_stages_total",
+    "Map stages executed inside a fused (single-dispatch) plan segment",
+)
+_BYTES_AVOIDED = _counter(
+    "tftpu_plan_intermediate_bytes_avoided_total",
+    "Bytes of intermediate stage outputs never materialized because the "
+    "chain ran fused (consumed in-register or pruned by select pushdown)",
+)
+_LOWER_SECONDS = _histogram(
+    "tftpu_plan_lowering_seconds",
+    "Wall-clock of lowering one segment to its fused Program "
+    "(cache lookup + composition)",
+)
+_FALLBACKS = {
+    reason: _counter(
+        "tftpu_plan_fallback_total",
+        "Plan segments that fell back to per-stage execution, by reason",
+        labels={"reason": reason},
+    )
+    for reason in ("ragged", "host_callback", "trace_error", "single_stage")
+}
+
+# fused-Program cache: steady-state loops rebuild chains from the same
+# stage Programs every iteration; re-composing (and re-jitting) per
+# force would throw the executable away each time. Keyed by stage
+# identity + needed outputs + source input specs; values pin the stage
+# Programs so ids stay live, and hits verify identity against id reuse.
+_CACHE_LOCK = threading.Lock()
+_FUSED_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_FUSED_CACHE_MAX = 64
+
+
+def _input_specs(plan: SegmentPlan, schema):
+    """Block-level input specs for the fused program, demoted exactly as
+    ``_normalize_program`` would (gather_feeds casts at the boundary)."""
+    from .. import dtypes as dt
+    from ..program import TensorSpec
+
+    demote = dt.demotion_active()
+    specs = []
+    for name in plan.source_inputs:
+        col = schema[name]
+        dtype = dt.demote(col.dtype) if demote else col.dtype
+        specs.append(TensorSpec(name, dtype, col.block_shape))
+    return specs
+
+
+def _output_specs(plan: SegmentPlan):
+    """Output specs of the fused program: each computed name's spec from
+    its producing stage, lifted to block level (map_rows outputs gain
+    the leading batch dim their vmapped form produces)."""
+    from ..program import TensorSpec
+    from ..shape import Unknown
+
+    by_name = {}
+    for n in plan.included:
+        for o in (n.program.outputs or []):
+            shape = o.shape.prepend(Unknown) if n.rows else o.shape
+            by_name[o.name] = TensorSpec(o.name, o.dtype, shape)
+    return [by_name[name] for name in plan.computed_names]
+
+
+def _fused_program(plan: SegmentPlan, schema):
+    """Build (or fetch) the composed Program for one segment: stages
+    applied in order over a shared column environment, each map_rows
+    stage entering as ``jax.vmap`` of its cell function, outputs
+    restricted to what the segment's consumer needs."""
+    from .. import dtypes as dt
+    from ..program import Program
+
+    in_specs = _input_specs(plan, schema)
+    key = (
+        tuple(
+            (id(n.program), n.rows, n.out_names) for n in plan.included
+        ),
+        tuple(plan.computed_names),
+        tuple(
+            (s.name, s.dtype.name, tuple(s.shape.dims)) for s in in_specs
+        ),
+        bool(dt.demotion_active()),
+    )
+    with _CACHE_LOCK:
+        hit = _FUSED_CACHE.get(key)
+        if hit is not None:
+            fused, pinned = hit
+            if len(pinned) == len(plan.included) and all(
+                p is n.program for p, n in zip(pinned, plan.included)
+            ):
+                _FUSED_CACHE.move_to_end(key)
+                return fused
+
+    import jax
+
+    stages = [
+        (jax.vmap(n.program.fn) if n.rows else n.program.fn,
+         tuple(n.program.input_names), tuple(n.out_names))
+        for n in plan.included
+    ]
+    result_names = tuple(plan.computed_names)
+
+    def fn(feeds: Dict[str, object]) -> Dict[str, object]:
+        env = dict(feeds)
+        for stage_fn, in_names, out_names in stages:
+            outs = stage_fn({k: env[k] for k in in_names})
+            for k in out_names:
+                env[k] = outs[k]
+        return {name: env[name] for name in result_names}
+
+    fused = Program(fn, in_specs, _output_specs(plan),
+                    fetch_order=list(result_names))
+    with _CACHE_LOCK:
+        _FUSED_CACHE[key] = (fused, tuple(n.program for n in plan.included))
+        while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+            _FUSED_CACHE.popitem(last=False)
+    return fused
+
+
+def _pruned_source(frame, names: Sequence[str]):
+    """``frame`` restricted to ``names`` with its physical identity
+    (mesh, axis, process-local markers) preserved — the plain
+    ``select()`` intentionally drops sharding metadata, but the fused
+    dispatch must see the source exactly as the per-stage verbs would."""
+    from ..frame import TensorFrame
+
+    names = list(names)
+    if list(frame.schema.names) == names:
+        return frame
+    schema = frame.schema.select(names)
+    if frame.is_materialized:
+        out = TensorFrame(
+            [{n: b[n] for n in names} for b in frame.blocks()], schema
+        )
+    else:
+        out = TensorFrame(
+            None, schema,
+            pending=lambda: [
+                {n: b[n] for n in names} for b in frame.blocks()
+            ],
+        )
+    for attr in ("_mesh", "_axis", "_process_local_cols"):
+        if hasattr(frame, attr):
+            setattr(out, attr, getattr(frame, attr))
+    return out
+
+
+def _apply_mask(block: Dict[str, object], names: Sequence[str],
+                mask_name: str) -> Dict[str, object]:
+    """Row-subset one block by its (already computed) mask column — THE
+    single-process filter contract, shared by ``TensorFrame.filter``'s
+    legacy path and the fused plan path so they cannot diverge:
+    bool[rows] masks only, loud row-count mismatches, device columns
+    gathered in HBM (only the mask crosses to host)."""
+    from ..frame import _block_num_rows, _is_jax_array
+
+    m = np.asarray(block[mask_name])
+    if m.dtype != np.bool_ or m.ndim != 1:
+        raise ValueError(
+            f"filter predicate output {mask_name!r} must be bool[rows]; "
+            f"got {m.dtype} with shape {m.shape}"
+        )
+    rows = _block_num_rows({n: block[n] for n in names})
+    if m.shape[0] != rows:
+        # must fail LOUDLY: jax gather clamps out-of-bounds indices, so
+        # an oversized mask would silently duplicate the last row on
+        # device columns where numpy's boolean index raises
+        raise ValueError(
+            f"filter predicate output {mask_name!r} has {m.shape[0]} "
+            f"rows for a block of {rows}"
+        )
+    out: Dict[str, object] = {}
+    idx = None
+    for name in names:
+        v = block[name]
+        if isinstance(v, list):
+            out[name] = [x for x, keep in zip(v, m) if keep]
+        elif _is_jax_array(v):
+            if idx is None:
+                import jax.numpy as jnp
+
+                idx = jnp.asarray(np.flatnonzero(m))
+            out[name] = v[idx]
+        else:
+            out[name] = np.asarray(v)[m]
+    return out
+
+
+def _segment_ragged(source, input_names: Sequence[str]) -> bool:
+    """True when any fused input column holds ragged cells in any source
+    block — the fused (block-level) program cannot feed them; per-stage
+    map_rows has the grouped-dispatch path for exactly this."""
+    from ..ops.executor import block_is_ragged
+
+    src = set(source.schema.names)
+    names = [n for n in input_names if n in src]
+    return any(block_is_ragged(b, names) for b in source.blocks())
+
+
+def _avoided_bytes(plan: SegmentPlan, blocks) -> int:
+    """Bytes the fused run never materialized: per avoided output, total
+    rows x known cell extent x itemsize (Unknown inner dims skipped —
+    an estimate must never overclaim)."""
+    from ..frame import _block_num_rows
+    from ..shape import Unknown
+
+    rows = sum(_block_num_rows(b) for b in blocks)
+    total = 0
+    for _, spec in plan.avoided_outputs:
+        dims = list(spec.shape.dims)
+        if dims and dims[0] == Unknown:
+            dims = dims[1:]
+        if any(d == Unknown for d in dims):
+            continue
+        cell = 1
+        for d in dims:
+            cell *= int(d)
+        itemsize = np.dtype(spec.dtype.np_dtype).itemsize
+        total += rows * cell * itemsize
+    return total
+
+
+def _run_fused(source, plan: SegmentPlan):
+    """One dispatch per block: compose, hand to map_blocks (jit cache /
+    donation / prefetch / sharded paths unchanged), re-key to the
+    segment's result columns, apply the filter mask if present."""
+    from ..frame import TensorFrame
+    from ..ops.verbs import map_blocks
+
+    t0 = time.perf_counter()
+    src_cols = [
+        n for n in source.schema.names
+        if n in set(plan.source_inputs) | set(plan.pass_through)
+    ]
+    pruned = _pruned_source(source, src_cols)
+    fused = _fused_program(plan, pruned.schema)
+    lower_dt = time.perf_counter() - t0
+    _LOWER_SECONDS.observe(lower_dt)
+    if _events.TRACER.enabled:
+        _events.TRACER.emit_complete(
+            "plan.lower", t0, lower_dt,
+            args={"stages": len(plan.included)}, cat="plan",
+        )
+    t_f0 = time.perf_counter()
+    mapped = map_blocks(fused, pruned)
+    blocks = mapped.blocks()
+    keep = list(plan.final_names)
+    if plan.has_filter:
+        out_blocks = [
+            _apply_mask(b, keep, plan.mask_name) for b in blocks
+        ]
+        # same observability contract as the legacy filter: one span,
+        # INPUT-rows convention (mask compute + gather wall-clock)
+        from ..frame import _block_num_rows
+        from ..utils import profiling
+
+        profiling.record(
+            "filter", time.perf_counter() - t_f0,
+            sum(_block_num_rows(b) for b in blocks),
+        )
+    else:
+        out_blocks = [{n: b[n] for n in keep} for b in blocks]
+    _FUSED_STAGES.inc(len(plan.included))
+    _BYTES_AVOIDED.inc(_avoided_bytes(plan, blocks))
+    result = TensorFrame(
+        out_blocks, plan.nodes[-1].schema.select(keep)
+    )
+    if not plan.has_filter and mapped.is_sharded:
+        result._mesh = mapped.mesh
+        result._axis = getattr(mapped, "_axis", None)
+    return result
+
+
+def _run_per_stage(source, plan: SegmentPlan):
+    """Exact single-verb execution of the segment's nodes (the honest
+    fallback: barriers split the plan, they never change semantics)."""
+    from ..frame import TensorFrame
+    from ..ops.verbs import map_blocks, map_rows
+
+    cur = source
+    for n in plan.nodes:
+        if n.kind == "map":
+            cur = (map_rows if n.rows else map_blocks)(n.program, cur)
+        elif n.kind == "select":
+            cur = cur.select(list(n.names))
+        elif n.kind == "filter":
+            from ..frame import _block_num_rows
+            from ..utils import profiling
+
+            names = list(n.schema.names)
+            t_f0 = time.perf_counter()
+            in_blocks = cur.blocks()
+            out_blocks = [
+                _apply_mask(b, names, n.mask_name) for b in in_blocks
+            ]
+            profiling.record(
+                "filter", time.perf_counter() - t_f0,
+                sum(_block_num_rows(b) for b in in_blocks),
+            )
+            cur = TensorFrame(out_blocks, n.schema)
+    keep = list(plan.final_names)
+    if list(cur.schema.names) != keep:
+        cur = _pruned_source(cur, keep)
+    cur.blocks()
+    return cur
+
+
+def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
+    """Force a plan-carrying frame: lower its chain and return the final
+    blocks (the frame's ``pending`` contract)."""
+    source, nodes = ir.resolve_chain(node)
+    final_names = list(node.schema.names)
+    if not nodes:  # degenerate: the node chain collapsed to its source
+        return [
+            {n: b[n] for n in final_names} for b in source.blocks()
+        ]
+
+    segments = split_segments(nodes)
+    # backward pass: segment k must produce what segment k+1 reads off
+    # its source — k+1's fused inputs plus its pass-through columns
+    plans: List[Optional[SegmentPlan]] = [None] * len(segments)
+    need = final_names
+    for k in range(len(segments) - 1, -1, -1):
+        src_names = (
+            source.schema.names if k == 0
+            else list(segments[k - 1][-1].schema.names)
+        )
+        plans[k] = plan_segment(segments[k], need, src_names)
+        req = set(plans[k].source_inputs) | set(plans[k].pass_through)
+        need = [n for n in src_names if n in req]
+
+    from ..config import get_config
+
+    # the escape hatch is honored at FORCE time too: a chain recorded
+    # while fusion was on still executes per-stage when the user turns
+    # plan_fusion off before forcing (the knob exists to rule fusion
+    # out — it must rule it out for already-built frames as well)
+    fusion_on = bool(get_config().plan_fusion)
+    t_exec = time.perf_counter()
+    cur = source
+    with ir.lowering():
+        for plan in plans:
+            if not fusion_on:
+                cur = _run_per_stage(cur, plan)
+                continue
+            if not plan.included and not plan.has_filter:
+                # pushdown pruned every stage (or the segment was pure
+                # projection): no program to dispatch — just project
+                cur = _pruned_source(cur, plan.final_names)
+                continue
+            fused_ok = plan.fusable
+            reason = None
+            if fused_ok and any(
+                ir.program_has_callback(n.program) for n in plan.included
+            ):
+                fused_ok, reason = False, "host_callback"
+            if fused_ok and _segment_ragged(cur, plan.source_inputs):
+                fused_ok, reason = False, "ragged"
+            if fused_ok:
+                try:
+                    cur = _run_fused(cur, plan)
+                except Exception as e:
+                    from ..validation import ValidationError
+
+                    if isinstance(e, (ValidationError, ValueError)):
+                        raise  # genuine contract violations stay loud
+                    logger.debug("fused segment failed, replaying "
+                                 "per-stage: %s", e)
+                    _FALLBACKS["trace_error"].inc()
+                    cur = _run_per_stage(cur, plan)
+            else:
+                if reason is not None:
+                    _FALLBACKS[reason].inc()
+                elif len(plan.included) <= 1:
+                    _FALLBACKS["single_stage"].inc()
+                cur = _run_per_stage(cur, plan)
+    if _events.TRACER.enabled:
+        _events.TRACER.emit_complete(
+            "plan.execute", t_exec, time.perf_counter() - t_exec,
+            args={"segments": len(segments)}, cat="plan",
+        )
+    return [{n: b[n] for n in final_names} for b in cur.blocks()]

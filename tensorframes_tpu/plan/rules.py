@@ -1,0 +1,174 @@
+"""Fusion rules: which stages of a plan chain run as ONE program.
+
+Functions from node chains to :class:`SegmentPlan` descriptions — no
+execution, no compilation; the only tracing is the (cached) host-
+callback probe that keeps effectful stages out of pushdown pruning.
+Three rules:
+
+* **map∘map composition** — consecutive map stages compose into one
+  traced function: map_rows stages contribute their already-vmapped
+  form, so row-wise chains run under a single ``vmap`` and a row-wise
+  stage feeding a block-wise stage composes block-level.
+* **select pushdown** — a ``select`` restricts the needed-column set; a
+  backward pass over the chain prunes whole stages whose outputs nobody
+  consumes and drops dead pass-through columns, so pruned columns are
+  never computed, gathered, or transferred.
+* **filter fusion** — a device-evaluable predicate's mask program joins
+  the upstream fused run (one dispatch computes upstream outputs AND
+  the mask); the row subsetting itself is a fusion barrier (its output
+  row count is data-dependent), so the chain splits after it and
+  downstream stages start a new segment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Set, Tuple
+
+from .ir import PlanNode, program_has_callback
+
+__all__ = ["SegmentPlan", "split_segments", "plan_segment"]
+
+
+@dataclasses.dataclass
+class SegmentPlan:
+    """The lowering-ready description of one chain segment."""
+
+    nodes: List[PlanNode]            # the segment's nodes, in order
+    included: List[PlanNode]         # map stages that actually run
+    excluded: List[PlanNode]         # map stages pruned by pushdown
+    final_names: List[str]           # the segment result's column names
+    computed_names: List[str]        # final names produced by stages
+    pass_through: List[str]          # final names read straight off source
+    source_inputs: List[str]         # source columns the fused program feeds
+    mask_name: Optional[str]         # filter mask output (segment-final)
+    #: stage outputs computed but never materialized by the fused run —
+    #: either consumed by a later stage or pruned by a select; the
+    #: intermediate-bytes-avoided accounting reads this
+    avoided_outputs: List[Tuple[str, object]]
+
+    @property
+    def has_filter(self) -> bool:
+        return self.mask_name is not None
+
+    @property
+    def fusable(self) -> bool:
+        """Worth the fused dispatch: >= 2 composed stages, a filter
+        whose mask joins the upstream program, or a select that pruned
+        stages/outputs. A bare single map keeps the single-verb path
+        (identical behavior, including map_rows lead-dim bucketing)."""
+        if len(self.included) >= 2 or self.has_filter:
+            return True
+        if self.excluded or self.avoided_outputs:
+            return True
+        return False
+
+
+def split_segments(nodes: Sequence[PlanNode]) -> List[List[PlanNode]]:
+    """Split a chain at filter nodes: a filter's data-dependent output
+    row count bars fusing across it, so it ends its segment (its mask
+    program still fuses upstream)."""
+    segs: List[List[PlanNode]] = []
+    cur: List[PlanNode] = []
+    for n in nodes:
+        cur.append(n)
+        if n.kind == "filter":
+            segs.append(cur)
+            cur = []
+    if cur:
+        segs.append(cur)
+    return segs
+
+
+def plan_segment(
+    nodes: Sequence[PlanNode],
+    final_names: Sequence[str],
+    source_names: Sequence[str],
+) -> SegmentPlan:
+    """Backward needed-columns pass over one segment.
+
+    ``final_names`` is what the segment's consumer needs (the segment
+    schema for the last segment; the next segment's source requirements
+    otherwise). Stages none of whose outputs are needed are pruned —
+    with their exclusive source inputs, which therefore never gather.
+    """
+    needed: Set[str] = set(final_names)
+    mask_name: Optional[str] = None
+    included_rev: List[PlanNode] = []
+    excluded: List[PlanNode] = []
+    for n in reversed(nodes):
+        if n.kind == "filter":
+            # the mask column is consumed by the subsetting step; every
+            # final column passes through the filter unchanged
+            mask_name = n.mask_name
+            needed.add(n.mask_name)
+        elif n.kind == "select":
+            # downstream references are validated against the selected
+            # schema at verb time, so needed is already a subset of
+            # n.names; the node itself adds no requirement
+            continue
+        elif n.kind == "map":
+            outs = set(n.out_names)
+            if needed & outs or program_has_callback(n.program):
+                # a host-callback stage is kept even when its outputs
+                # are all dead: pruning it would elide the callback's
+                # side effect, diverging from TFTPU_FUSION=0 (which
+                # executes every recorded stage). Keeping it also makes
+                # the lowering's callback check see it and replay the
+                # segment per-stage — single-verb semantics exactly.
+                included_rev.append(n)
+                needed = (needed - outs) | set(n.program.input_names)
+            else:
+                excluded.append(n)
+    included = list(reversed(included_rev))
+
+    # forward pass: which included-stage inputs come from the source
+    # (vs an earlier included stage's output)
+    computed_before: Set[str] = set()
+    source_inputs: List[str] = []
+    for n in included:
+        for i in n.program.input_names:
+            if i not in computed_before and i not in source_inputs:
+                source_inputs.append(i)
+        computed_before |= set(n.out_names)
+
+    src = set(source_names)
+    missing = [c for c in source_inputs if c not in src]
+    if missing:  # defensive: verb-time validation should make this dead
+        raise ValueError(
+            f"plan_segment: stage input(s) {missing} are neither source "
+            f"columns ({sorted(src)}) nor upstream stage outputs"
+        )
+
+    computed = [n for n in final_names if n in computed_before]
+    if mask_name is not None and mask_name not in computed:
+        computed = computed + [mask_name]
+    pass_through = [n for n in final_names if n not in computed_before]
+    stray = [c for c in pass_through if c not in src]
+    if stray:  # defensive, as above
+        raise ValueError(
+            f"plan_segment: final column(s) {stray} are neither computed "
+            "by a stage nor present on the source"
+        )
+
+    fused_outputs = set(computed)
+    avoided: List[Tuple[str, object]] = []
+    for n in included:
+        for o in (n.program.outputs or []):
+            if o.name not in fused_outputs:
+                avoided.append((o.name, o))
+    for n in excluded:
+        for o in (n.program.outputs or []):
+            avoided.append((o.name, o))
+
+    return SegmentPlan(
+        nodes=list(nodes),
+        included=included,
+        excluded=excluded,
+        final_names=list(final_names),
+        computed_names=computed,
+        pass_through=pass_through,
+        source_inputs=source_inputs,
+        mask_name=mask_name,
+        avoided_outputs=avoided,
+    )
