@@ -714,6 +714,122 @@ def _bench_compile_fullscale():
     return out
 
 
+_COMPILECACHE_CHILD = r'''
+import json, os, sys, time
+sys.path.insert(0, os.environ["TFTPU_REPO"])
+import numpy as np
+import jax
+import tensorframes_tpu as tfs
+from tensorframes_tpu.observability.metrics import REGISTRY
+
+which = os.environ["TFTPU_CC_WHICH"]
+if which == "inception":
+    from tensorframes_tpu.models import inception as inc
+
+    cfg = inc.inception_v3(channel_scale=1.0)
+    prog = inc.scoring_program(cfg, inc.init_params(cfg, seed=0))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 299, 299, 3)).astype(np.float32)
+    frame = tfs.frame_from_arrays({"images": x}, num_blocks=1)
+    program = tfs.compile_program(lambda images: prog(images), frame)
+else:
+    from tensorframes_tpu.models import transformer as tr
+
+    cfg = tr.bert_base()
+    rowprog = tr.embed_row_program(cfg, tr.init_params(cfg, seed=0))
+    tok = np.ones((16, 128), np.int32)
+    frame = tfs.frame_from_arrays({"tokens": tok}, num_blocks=1)
+    program = tfs.compile_program(
+        lambda tokens: jax.vmap(rowprog)(tokens), frame
+    )
+t0 = time.perf_counter()
+tfs.map_blocks(program, frame).blocks()
+first_dispatch_s = time.perf_counter() - t0
+vals = {}
+for d in REGISTRY.snapshot():
+    if d["name"] in ("tftpu_compilecache_hits_total",
+                     "tftpu_compilecache_misses_total") and not d["labels"]:
+        vals[d["name"]] = d["value"]
+    if d["name"] == "tftpu_executor_compile_seconds":
+        vals["compile_count"] = d["count"]
+        vals["compile_s"] = d["sum"]
+    if d["name"] == "tftpu_compilecache_load_seconds":
+        vals["load_s"] = d["sum"]
+print(json.dumps({"first_dispatch_s": first_dispatch_s, **vals}))
+'''
+
+
+def _bench_compilecache():
+    """ISSUE 5 acceptance: cold-process compile vs warm-store first
+    dispatch for the Inception-299 and BERT-base compile configs. Each
+    model runs in a fresh subprocess twice against one temp store
+    (``TFTPU_COMPILE_CACHE``): run 1 compiles and publishes, run 2
+    deserializes — the speedup is the persistent cache's whole point.
+    Disable with TFTPU_BENCH_COMPILE=0 (same knob as the compile
+    bench)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    out = {}
+    repo = os.path.dirname(os.path.abspath(__file__))
+    for which, label in (("inception", "inception299"),
+                         ("bert", "bert_base")):
+        with tempfile.TemporaryDirectory(prefix="tftpu-cc-bench-") as store:
+            runs = []
+            for _ in range(2):
+                env = {
+                    **os.environ,
+                    "TFTPU_REPO": repo,
+                    "TFTPU_CC_WHICH": which,
+                    "TFTPU_COMPILE_CACHE": store,
+                }
+                r = subprocess.run(
+                    [sys.executable, "-c", _COMPILECACHE_CHILD],
+                    env=env, capture_output=True, text=True,
+                    timeout=_SUBBENCH_TIMEOUT_S,
+                )
+                if r.returncode != 0:
+                    raise RuntimeError(
+                        f"compilecache child ({which}) failed: "
+                        f"{r.stderr[-1000:]}"
+                    )
+                runs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+            cold, warm = runs
+            out[f"{label}_cold_first_dispatch_s"] = round(
+                cold["first_dispatch_s"], 3
+            )
+            out[f"{label}_warm_first_dispatch_s"] = round(
+                warm["first_dispatch_s"], 3
+            )
+            if warm["first_dispatch_s"] > 0:
+                out[f"{label}_first_dispatch_speedup"] = round(
+                    cold["first_dispatch_s"] / warm["first_dispatch_s"], 1
+                )
+            # what the store ELIMINATES is the compile phase: trace and
+            # the model run itself are cache-invariant (and on this CPU
+            # fallback the run is a visible fraction of the dispatch —
+            # on a real TPU the 20-40s compile dwarfs both, and the
+            # dispatch speedup converges to the compile/load ratio
+            # below, which is the ≥5x acceptance number)
+            out[f"{label}_cold_compile_s"] = round(
+                cold.get("compile_s", 0.0), 3
+            )
+            out[f"{label}_warm_load_s"] = round(warm.get("load_s", 0.0), 4)
+            if warm.get("load_s"):
+                out[f"{label}_compile_vs_load_speedup"] = round(
+                    cold.get("compile_s", 0.0) / warm["load_s"], 1
+                )
+            out[f"{label}_warm_disk_hits"] = int(
+                warm.get("tftpu_compilecache_hits_total", 0)
+            )
+            out[f"{label}_warm_compiles"] = int(
+                warm.get("compile_count", -1)
+            )
+    return out
+
+
 _SUBBENCH_TIMEOUT_S = 1200  # generous: sweep compiles run minutes, not hours
 
 
@@ -1256,6 +1372,11 @@ def main():
         ) or {}
         for k, v in compile_times.items():
             print(f"# compile | {k}={v}")
+        # persistent-store cold vs warm first dispatch (ISSUE 5): each
+        # model twice in fresh subprocesses sharing one temp store
+        cc_times = _try("compilecache", _bench_compilecache, {}) or {}
+        for k, v in cc_times.items():
+            print(f"# compilecache | {k}={v}")
 
     from tensorframes_tpu.utils import profiling
 

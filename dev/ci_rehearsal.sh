@@ -104,6 +104,24 @@ run_step "Test (8-device virtual CPU mesh)" \
 run_step "Fusion-off smoke (TFTPU_FUSION=0 fallback stays green)" \
   env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py -q
 
+# ci.yml's compile-cache smoke: a tier-1 slice twice against one shared
+# persistent store; the second run must report disk hits > 0 in its
+# metrics JSONL (docs/compilecache.md cross-process contract)
+# (pytest rc 1 — test failures — is tolerated: the Test step owns
+# pass/fail; this step's gate is the disk-hit assertion)
+run_step "Compile-cache round-trip smoke (second run hits the disk store)" bash -c "
+  export TFTPU_COMPILE_CACHE='$WORK/cc-store' &&
+  { env TFTPU_OBS_EXPORT='$WORK/cc-obs-1' python -m pytest tests/test_verbs.py -q || [ \$? -eq 1 ]; } &&
+  { env TFTPU_OBS_EXPORT='$WORK/cc-obs-2' python -m pytest tests/test_verbs.py -q || [ \$? -eq 1 ]; } &&
+  python -c \"
+import json
+hits = sum(d['value'] for d in map(json.loads, open('$WORK/cc-obs-2/tier1_metrics.jsonl'))
+           if d['name'] == 'tftpu_compilecache_hits_total')
+assert hits > 0, 'second run reported no persistent-store hits'
+print('compilecache smoke: disk hits =', int(hits))
+\"
+"
+
 # ci.yml's observability smoke: the telemetry example must produce all
 # three artifacts (Chrome trace, metrics JSONL, step log) and the tier-1
 # run above must have exported its own pair

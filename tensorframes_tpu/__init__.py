@@ -119,6 +119,8 @@ from .io import (  # noqa: E402,F401
 from .utils import profiling  # noqa: E402,F401
 from . import observability  # noqa: E402,F401
 from .observability import StepTelemetry  # noqa: E402,F401
+from . import compilecache  # noqa: E402,F401  (registers tftpu_compilecache_* metrics)
+from .compilecache import WarmupReport, warmup  # noqa: E402,F401
 
 __version__ = "0.3.0"
 

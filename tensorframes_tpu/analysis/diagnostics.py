@@ -49,6 +49,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "TFG105": ("nan-hazard", "warn"),
     "TFG106": ("hbm-budget", "warn"),
     "TFG107": ("fusion-barrier", "warn"),
+    "TFG108": ("cache-fingerprint-unstable", "warn"),
 }
 
 # Pre-register the full counter family at import: one series per code,

@@ -32,9 +32,15 @@ Every rule is grounded in a hazard this codebase has already paid for:
   Runs from :func:`~tensorframes_tpu.analysis.lint_plan` only — it
   needs a frame's plan chain, not a single program.
 
+* **TFG108 cache-fingerprint-unstable** — the persistent compile
+  cache's content hash differs across two identical rebuilds of the
+  program (non-deterministically serialized captures): every process
+  start misses the store and recompiles — a miss storm.
+
 Rules never execute or compile anything: they read specs, the traced
 jaxpr, and config. Tracing itself (``jax.make_jaxpr``) happens once in
-:mod:`.analyzer`.
+:mod:`.analyzer` (TFG108 adds two more traces to probe rebuild
+stability — still zero compiles).
 """
 
 from __future__ import annotations
@@ -587,6 +593,41 @@ def _rule_fusion_barrier(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG108 — cache-fingerprint-unstable (persistent-cache miss storm)
+# ---------------------------------------------------------------------------
+
+def _rule_fingerprint_unstable(ctx: RuleContext) -> List[Diagnostic]:
+    """The persistent compile cache (tensorframes_tpu/compilecache)
+    keys executables by a content hash of the traced program. A program
+    whose fingerprint differs across two *identical* rebuilds — e.g. a
+    captured constant produced by unseeded randomness at trace time, or
+    any capture that serializes non-deterministically — can never hit
+    the store: every process start recompiles everything it ships (a
+    miss storm). Two independent traces here; still zero compiles."""
+    if ctx.program is None or ctx.closed is None:
+        return []
+    from ..compilecache.fingerprint import program_fingerprint
+
+    a = program_fingerprint(ctx.program, probe=ctx.probe)
+    b = program_fingerprint(ctx.program, probe=ctx.probe)
+    if a is None or b is None or a == b:
+        return []
+    return [Diagnostic(
+        "TFG108", "warn",
+        "cache fingerprint differs across two identical rebuilds of "
+        "this program: a captured constant serializes "
+        "non-deterministically, so the persistent compile cache "
+        "(TFTPU_COMPILE_CACHE) misses on every process start — a "
+        "miss storm that recompiles from scratch each launch",
+        subject="program",
+        fix="make trace-time captures deterministic (seed the RNG that "
+            "builds captured arrays, avoid set/dict-order-dependent "
+            "constructions); closure values must be a pure function of "
+            "the program definition for the cache key to be stable",
+    )]
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -598,6 +639,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG105": _rule_nan_hazard,
     "TFG106": _rule_hbm_budget,
     "TFG107": _rule_fusion_barrier,
+    "TFG108": _rule_fingerprint_unstable,
 }
 
 

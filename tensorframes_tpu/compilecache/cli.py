@@ -1,0 +1,156 @@
+"""``python -m tensorframes_tpu.compilecache`` — ops surface for the
+persistent AOT executable store.
+
+Subcommands (see docs/compilecache.md for the runbook):
+
+* ``stats``  — entry count / bytes / per-entry metadata of a store;
+* ``warm``   — precompile serialized Program bundles (``save_program``
+  artifacts) at given row counts into the store;
+* ``prune``  — LRU-evict to a byte bound, or ``--clear`` everything;
+* ``verify`` — CRC + header check every entry, optionally deleting
+  defective ones.
+
+The store directory comes from ``--store`` or ``TFTPU_COMPILE_CACHE``
+(the same knob the runtime uses; the AOT entries live under
+``<dir>/aot``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _resolve_store(args, create: bool = False):
+    from ..config import get_config
+    from .store import store_for
+
+    root = args.store or get_config().compilation_cache_dir
+    if not root:
+        print("no store: pass --store DIR or set TFTPU_COMPILE_CACHE",
+              file=sys.stderr)
+        return None
+    aot = os.path.join(root, "aot")
+    if not create and not os.path.isdir(aot):
+        print(f"no store at {aot} (empty cache is a valid state: "
+              "stats would be all zeros)", file=sys.stderr)
+        return None
+    return store_for(aot)
+
+
+def _cmd_stats(args) -> int:
+    store = _resolve_store(args)
+    if store is None:
+        return 1
+    s = store.stats()
+    if args.json:
+        print(json.dumps(s, sort_keys=True))
+        return 0
+    print(f"store: {s['root']}")
+    print(f"entries: {s['entries']}  bytes: {s['bytes']:,}  "
+          f"bound: {s['max_bytes']:,}  manifest rows: {s['manifest_rows']}")
+    for e in s["entry_list"]:
+        if e.get("unreadable"):
+            print(f"  {e['fingerprint'][:16]}…  {e['bytes']:>10,}B  "
+                  "UNREADABLE (run verify)")
+            continue
+        ins = ",".join(
+            f"{n}:{'x'.join(str(d) for d in shp)}:{dt}"
+            for (n, shp, dt) in e.get("inputs", [])
+        )
+        print(f"  {e['fingerprint'][:16]}…  {e['bytes']:>10,}B  "
+              f"{e.get('kind', '?'):5} {e.get('form', '?'):7} "
+              f"{e.get('backend', '?'):4} {ins}")
+    return 0
+
+
+def _cmd_warm(args) -> int:
+    store = _resolve_store(args, create=True)
+    if store is None:
+        return 1
+    # route the runtime at this store for the duration of the warm
+    from ..config import configure
+
+    configure(compilation_cache_dir=args.store
+              or os.environ.get("TFTPU_COMPILE_CACHE", ""))
+    from ..program import load_program
+    from .warmup import WarmupReport, warm_program
+
+    rows = [int(r) for r in args.rows.split(",") if r.strip()]
+    report = WarmupReport()
+    for path in args.bundles:
+        program = load_program(path)
+        from ..program import analyze_program
+
+        program = analyze_program(program)
+        warm_program(program, rows, block=(args.mode == "block"),
+                     report=report)
+    print(report.pretty())
+    return 0 if not report.counts().get("failed") else 1
+
+
+def _cmd_prune(args) -> int:
+    store = _resolve_store(args)
+    if store is None:
+        return 1
+    max_bytes = None if args.max_mb is None else args.max_mb * (1 << 20)
+    out = store.prune(max_bytes=max_bytes, clear=args.clear)
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    store = _resolve_store(args)
+    if store is None:
+        return 1
+    out = store.verify(delete_bad=args.delete_bad)
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(f"good: {out['good']}  bad: {len(out['bad'])}  "
+              f"deleted: {out['deleted']}")
+        for b in out["bad"]:
+            print(f"  BAD {b['entry']}: {b['error']}")
+    return 0 if out["ok"] else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tensorframes_tpu.compilecache",
+        description="Inspect and manage the persistent AOT executable "
+                    "store (docs/compilecache.md)",
+    )
+    p.add_argument("--store", default="",
+                   help="cache root (default: $TFTPU_COMPILE_CACHE)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("stats", help="entry count/bytes/metadata")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=_cmd_stats)
+
+    wp = sub.add_parser(
+        "warm", help="precompile Program bundles into the store"
+    )
+    wp.add_argument("bundles", nargs="+",
+                    help="save_program() StableHLO bundle paths")
+    wp.add_argument("--rows", required=True,
+                    help="comma-separated lead-dim row counts, e.g. 64,65")
+    wp.add_argument("--mode", choices=("block", "rows"), default="block")
+    wp.set_defaults(fn=_cmd_warm)
+
+    pp = sub.add_parser("prune", help="LRU-evict to a byte bound")
+    pp.add_argument("--max-mb", type=int, default=None)
+    pp.add_argument("--clear", action="store_true",
+                    help="drop every entry and the manifest")
+    pp.set_defaults(fn=_cmd_prune)
+
+    vp = sub.add_parser("verify", help="CRC-check every entry")
+    vp.add_argument("--delete-bad", action="store_true")
+    vp.add_argument("--json", action="store_true")
+    vp.set_defaults(fn=_cmd_verify)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

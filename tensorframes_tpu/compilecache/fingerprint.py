@@ -1,0 +1,180 @@
+"""Stable content fingerprints for compiled-program cache keys.
+
+The persistent executable store (:mod:`.store`) keys entries by a hash
+that must survive process restarts, so nothing here may depend on
+Python ``hash()`` (randomized per process), object ids, or memory
+addresses. The fingerprint covers everything that changes the compiled
+artifact:
+
+* the program's **jaxpr** (pretty-printed, with ``0x…`` memory
+  addresses scrubbed — a closure that traces identically in two
+  processes must key identically);
+* the **constants** closed over by the trace: avals always, values
+  only in the *plain* (closure-capture) form where XLA bakes them into
+  the executable — the hoisted form passes weights as runtime
+  arguments, so different weights share one cached executable;
+* the **feed-shape bucket**: sorted (name, shape, dtype) of the
+  abstract inputs the executable was specialized to;
+* the **dtype policy** (x64 flag + demotion mode) and the fetch order;
+* the **environment**: backend, device kind, device/process count,
+  ``XLA_FLAGS``, jax version, entry kind (block/vmap), donation and
+  hoist flags, and the store format version.
+
+``TFG108`` (analysis/rules.py) calls :func:`program_fingerprint` twice
+with independent traces: a program whose fingerprint differs across
+identical rebuilds (non-deterministically serialized captures) would
+miss the persistent store on every process start — a miss storm.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Bumped whenever the entry layout or key composition changes: old
+#: entries simply miss (never mis-deserialize).
+FORMAT_VERSION = 1
+
+__all__ = [
+    "FORMAT_VERSION",
+    "fingerprint_from_closed",
+    "program_fingerprint",
+]
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def _scrub(text: str) -> str:
+    """Drop process-local memory addresses from jaxpr text (function
+    reprs inside callback/custom-primitive params embed them)."""
+    return _ADDR_RE.sub("0x", text)
+
+
+def _const_digest(h, const, include_values: bool,
+                  value_policy: str) -> None:
+    """Feed one traced constant into the running hash. ``value_policy``
+    'host_only' skips device-array values (the lint surface must not
+    trigger device→host transfers); 'all' hashes every value (the
+    compile path — a transfer is noise next to the XLA compile)."""
+    try:
+        import jax
+
+        is_device = isinstance(const, jax.Array)
+    except Exception:  # pragma: no cover - jax always importable here
+        is_device = False
+    try:
+        if include_values and (value_policy == "all" or not is_device):
+            arr = np.asarray(const)
+            h.update(str((arr.shape, str(arr.dtype))).encode())
+            h.update(arr.tobytes())
+        else:
+            shape = getattr(const, "shape", None)
+            dtype = getattr(const, "dtype", None)
+            h.update(str((tuple(shape) if shape is not None else None,
+                          str(dtype))).encode())
+    except (TypeError, ValueError):
+        # non-array capture: repr is the best available identity; if it
+        # embeds process-local state, TFG108 is the rule that says so
+        h.update(_scrub(repr(const)).encode())
+
+
+def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
+    import jax
+
+    from ..config import get_config
+
+    cfg = get_config()
+    dev = jax.devices()[0]
+    return {
+        "format": FORMAT_VERSION,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "n_devices": jax.device_count(),
+        "n_processes": jax.process_count(),
+        "x64": bool(jax.config.jax_enable_x64),
+        "demote_x64": str(cfg.demote_x64_on_tpu),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "kind": kind,
+        "donate": bool(donate),
+        "form": "hoisted" if hoisted else "plain",
+    }
+
+
+def fingerprint_from_closed(
+    closed,
+    avals: Iterable[Tuple[str, Tuple[int, ...], str]],
+    out_names: Sequence[str],
+    *,
+    kind: str = "block",
+    donate: bool = False,
+    hoisted: bool = False,
+    value_policy: str = "all",
+) -> str:
+    """Fingerprint an already-traced program.
+
+    ``closed`` is the ``ClosedJaxpr`` of the (possibly vmapped) entry
+    function; ``avals`` the sorted (name, shape, dtype-str) triples of
+    the feed the executable is specialized to; ``out_names`` the fetch
+    order. Hoisted form excludes const *values* from the key (they are
+    runtime arguments of the cached executable).
+    """
+    h = hashlib.sha256()
+    h.update(_scrub(str(closed.jaxpr)).encode())
+    h.update(b"|consts:%d|" % len(closed.consts))
+    for c in closed.consts:
+        _const_digest(h, c, include_values=not hoisted,
+                      value_policy=value_policy)
+    h.update(json.dumps({
+        "avals": [(n, list(s), d) for (n, s, d) in avals],
+        "outs": list(out_names),
+        "env": _env_parts(kind, donate, hoisted),
+    }, sort_keys=True).encode())
+    return h.hexdigest()[:40]
+
+
+def program_fingerprint(
+    program,
+    probe: int = 8,
+    *,
+    kind: str = "block",
+    donate: bool = False,
+    hoisted: bool = False,
+    value_policy: str = "host_only",
+) -> Optional[str]:
+    """Trace ``program`` fresh and fingerprint it (plain form by
+    default — const values in the key, exactly what the executor uses
+    when constant hoisting is off). Each call re-traces, so two calls
+    on one program probe rebuild stability (TFG108). Returns None when
+    the program cannot be traced."""
+    import jax
+
+    from ..program import _abstract_inputs
+
+    abstract = _abstract_inputs(program.inputs, probe)
+
+    def rebuilt(feeds):
+        # a fresh function object per call defeats jax's trace cache
+        # (keyed on fn identity + avals): each fingerprint really does
+        # re-run the user's capture logic, which is the whole point of
+        # the TFG108 stability probe
+        return program.fn(feeds)
+
+    try:
+        closed = jax.make_jaxpr(rebuilt)(abstract)
+    except Exception:
+        return None
+    avals = sorted(
+        (name, tuple(int(d) for d in np.shape(a)), str(a.dtype))
+        for name, a in abstract.items()
+    )
+    outs = list(program.fetch_order or [o.name for o in program.outputs])
+    return fingerprint_from_closed(
+        closed, avals, outs, kind=kind, donate=donate, hoisted=hoisted,
+        value_policy=value_policy,
+    )

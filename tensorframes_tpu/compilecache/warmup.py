@@ -1,0 +1,362 @@
+"""Warmup: precompile expected feed-shape buckets ahead of traffic.
+
+``tfs.warmup(frame_or_schema, programs_or_verbs, ...)`` builds (or
+disk-loads) the executor's per-shape executables for the shapes real
+dispatches will use, **without executing anything** — warmed keys are
+marked dispatched, so the first real dispatch at that shape is a
+jit-cache hit with zero compile. Combined with a persistent store
+(``TFTPU_COMPILE_CACHE``), a serving process can reach first-request
+latency equal to steady-state latency.
+
+Shape selection mirrors the dispatch paths exactly:
+
+* **block mode** (``map_blocks``): the frame partitioner yields at most
+  two block row counts (``n//k`` and ``n//k + 1``) — both are warmed;
+  a materialized frame's actual distinct block sizes win over the
+  estimate.
+* **rows mode** (``map_rows``): lead dims are rounded through the same
+  power-of-two bucket ladder the executor pads into
+  (:func:`~tensorframes_tpu.ops.executor.bucket_rows`).
+* an explicit ``rows=[...]`` overrides both; a recorded **shape
+  manifest** (``manifest=``, appended by the executor on every store
+  miss) replays yesterday's real traffic shapes.
+
+Pass :class:`~tensorframes_tpu.program.Program` objects (from
+``tfs.compile_program``) rather than bare functions when you want the
+warmed in-process executables to be reused by later verb calls — a
+bare function normalizes to a fresh Program per call, so its warmth
+lives only in the persistent store (still skipping XLA, not the trace).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "WarmupReport", "warmup", "warm_program", "partitioner_row_counts",
+]
+
+
+@dataclasses.dataclass
+class WarmupReport:
+    """What a warmup pass did: one row per (program, kind, shape)."""
+
+    entries: List[dict] = dataclasses.field(default_factory=list)
+
+    def add(self, subject: str, kind: str, rows: Optional[int],
+            status: str, detail: str = "") -> None:
+        self.entries.append({
+            "subject": subject, "kind": kind, "rows": rows,
+            "status": status, "detail": detail,
+        })
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.entries:
+            out[e["status"]] = out.get(e["status"], 0) + 1
+        return out
+
+    @property
+    def compiled(self) -> int:
+        return self.counts().get("compiled", 0)
+
+    @property
+    def disk_hits(self) -> int:
+        return self.counts().get("disk", 0)
+
+    def pretty(self) -> str:
+        c = self.counts()
+        head = "warmup: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(c.items())
+        ) if c else "warmup: nothing to do"
+        lines = [head]
+        for e in self.entries:
+            rows = "?" if e["rows"] is None else e["rows"]
+            extra = f" ({e['detail']})" if e["detail"] else ""
+            lines.append(
+                f"  {e['subject']} [{e['kind']} rows={rows}]: "
+                f"{e['status']}{extra}"
+            )
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:  # pragma: no cover - convenience
+        return self.pretty()
+
+
+def _as_program_list(fetches, schema, block: bool, feed_dict):
+    """Normalize the ``programs_or_verbs`` argument: a single fetches
+    item or a sequence of them, each becoming one Program. A list of
+    DSL nodes is ONE multi-output program (verb semantics)."""
+    from ..dsl.node import Node
+    from ..ops.verbs import _apply_feed_dict, _normalize_program
+    from ..program import Program
+
+    if isinstance(fetches, (list, tuple)) and fetches and not all(
+        isinstance(f, Node) for f in fetches
+    ):
+        items = list(fetches)
+    else:
+        items = [fetches]
+    out = []
+    for item in items:
+        if isinstance(item, Program) and item.outputs:
+            program = item
+        else:
+            if schema is None:
+                raise ValueError(
+                    "warmup() needs a frame or schema to normalize "
+                    "non-Program fetches (pass tfs.compile_program "
+                    "results to warm without one)"
+                )
+            program, _ = _normalize_program(
+                item, schema, block=block, feed_dict=feed_dict
+            )
+        program = _apply_feed_dict(program, feed_dict)
+        out.append(program)
+    return out
+
+
+def partitioner_row_counts(total: int, num_blocks: int) -> List[int]:
+    """The at-most-two block sizes the frame partitioner yields for
+    ``total`` rows in ``num_blocks`` blocks (``n//k`` and ``n//k+1``) —
+    the serving-side estimate when only expected traffic volume is
+    known: ``warmup(schema, prog, rows=partitioner_row_counts(n, k))``."""
+    num_blocks = max(1, int(num_blocks))
+    base = total // num_blocks
+    sizes = {base, base + 1} if total % num_blocks else {base}
+    return sorted(s for s in sizes if s > 0) or [total]
+
+
+def _target_row_counts(frame, rows, block: bool) -> List[int]:
+    if rows is not None:
+        counts = sorted({int(r) for r in rows if int(r) > 0})
+        if not counts:
+            raise ValueError("warmup rows= must contain positive ints")
+        return counts
+    if frame is None:
+        raise ValueError(
+            "warmup() needs rows=[...] when no frame is given"
+        )
+    if frame.is_materialized:
+        from ..frame import _block_num_rows
+
+        return sorted({_block_num_rows(b) for b in frame.blocks()})
+    # lazy frame: never force it — a pinned block lead dim in the
+    # schema IS the block row count; otherwise give up loudly
+    for col in frame.schema.columns:
+        d = col.block_shape.dims[0]
+        if isinstance(d, int):
+            return [int(d)]
+    raise ValueError(
+        "warmup() cannot infer block sizes from a lazy frame with "
+        "unknown row counts; pass rows=[...] (warmup never forces "
+        "a pending computation)"
+    )
+
+
+def _abstract_feeds(program, n: int, kind: str):
+    """ShapeDtypeStruct feeds at lead dim ``n``, exactly as the
+    executor will see them (map_rows buckets the vmapped lead dim;
+    dtypes follow the program's input specs, which gather_feeds casts
+    feeds to). Returns None when an input has unknown inner dims."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..shape import Unknown
+
+    feeds = {}
+    for spec in program.inputs:
+        dims = list(spec.shape.dims)
+        if kind == "block":
+            dims[0] = n
+            cell = dims[1:]
+        else:
+            cell = dims
+            dims = [n] + dims
+        if any(d == Unknown for d in cell):
+            return None
+        # the key must match runtime exactly: run paths jnp.asarray the
+        # gathered feeds, which can re-type under the x64 flag
+        dtype = jnp.asarray(np.zeros((), dtype=spec.dtype.np_dtype)).dtype
+        feeds[spec.name] = jax.ShapeDtypeStruct(
+            tuple(int(d) for d in dims), dtype
+        )
+    return feeds
+
+
+def _default_donate() -> bool:
+    """Match the verbs' choice for host-sourced feeds: donate when the
+    config asks for it and the backend implements it."""
+    from ..config import get_config
+    from ..ops.executor import donation_supported
+
+    return bool(get_config().donate_inputs) and donation_supported()
+
+
+def warm_program(program, rows: Sequence[int], block: bool = True,
+                 donate: Optional[bool] = None,
+                 report: Optional[WarmupReport] = None) -> WarmupReport:
+    """Warm one analyzed Program at explicit lead-dim row counts (the
+    CLI surface; :func:`warmup` is the frame-aware front door)."""
+    from ..ops.executor import bucket_rows
+
+    report = report if report is not None else WarmupReport()
+    donate = _default_donate() if donate is None else bool(donate)
+    kind = "block" if block else "vmap"
+    subject = f"Program(inputs={program.input_names})"
+    if block:
+        targets = sorted({int(r) for r in rows})
+    else:
+        # map_rows buckets adaptively: exact shapes while the frame
+        # presents few sizes (the partitioner's ≤2), power-of-two
+        # buckets once shapes proliferate — warm both regimes
+        targets = sorted(
+            {int(r) for r in rows} | {bucket_rows(int(r)) for r in rows}
+        )
+    for n in targets:
+        feeds = _abstract_feeds(program, n, kind)
+        if feeds is None:
+            report.add(subject, kind, n, "skipped",
+                       "unknown inner dims (ragged cells warm per group "
+                       "at dispatch)")
+            continue
+        status = program.compiled().warm(kind, feeds, donate=donate)
+        report.add(subject, kind, n, status)
+    return report
+
+
+def _manifest_row_matches(program, row) -> bool:
+    """A manifest row targets this program only when every recorded
+    input matches the program's spec by name, dtype, AND known cell
+    dims — the manifest is store-wide, and warming program A with
+    program B's shapes (they often share names like 'x' or 'images')
+    would burn spurious multi-second compiles on junk keys."""
+    import jax.numpy as jnp
+
+    from ..shape import Unknown
+
+    inputs = row.get("inputs", [])
+    if sorted(n for (n, _, _) in inputs) != sorted(program.input_names):
+        return False
+    kind = row.get("kind", "block")
+    for (name, shape, dtype) in inputs:
+        try:
+            spec = program.input(name)
+        except KeyError:
+            return False
+        want = jnp.asarray(np.zeros((), dtype=spec.dtype.np_dtype)).dtype
+        if str(want) != str(np.dtype(dtype)):
+            return False
+        # recorded shapes are block-level (post-gather): lead dim is the
+        # row count; the tail must fit the spec's cell dims
+        cell = list(spec.shape.dims[1:]) if kind == "block" \
+            else list(spec.shape.dims)
+        if len(shape) != len(cell) + 1:
+            return False
+        for got, want_d in zip(shape[1:], cell):
+            if want_d != Unknown and int(got) != int(want_d):
+                return False
+    return True
+
+
+def _warm_from_manifest(programs, manifest_rows, report: WarmupReport,
+                        donate: Optional[bool]) -> None:
+    import jax
+
+    for program in programs:
+        subject = f"Program(inputs={program.input_names})"
+        for row in manifest_rows:
+            if not _manifest_row_matches(program, row):
+                continue
+            try:
+                feeds = {
+                    n: jax.ShapeDtypeStruct(
+                        tuple(int(d) for d in s), np.dtype(t)
+                    )
+                    for (n, s, t) in row["inputs"]
+                }
+            except (TypeError, ValueError):
+                continue  # torn or stale manifest row
+            d = row.get("donate", False) if donate is None else donate
+            status = program.compiled().warm(
+                row.get("kind", "block"), feeds,
+                donate=bool(d),
+            )
+            lead = None
+            for v in feeds.values():
+                lead = int(v.shape[0]) if v.shape else None
+                break
+            report.add(subject, row.get("kind", "block"), lead, status,
+                       "manifest")
+
+
+def warmup(frame_or_schema, programs_or_verbs, *, rows=None,
+           block: bool = True, feed_dict=None, donate: Optional[bool] = None,
+           manifest=None) -> WarmupReport:
+    """Precompile the executables real traffic will need (ISSUE 5).
+
+    ``frame_or_schema`` — a TensorFrame (block sizes inferred from the
+    partitioner contract / the materialized blocks), a Schema (pass
+    ``rows=``), or None when every fetch is an analyzed Program.
+    ``programs_or_verbs`` — one fetches item or a sequence: Programs,
+    plain functions, or DSL nodes (a list of nodes is one program).
+    ``rows=[...]`` — explicit lead-dim row counts (map_rows targets are
+    rounded through the executor's power-of-two bucket ladder).
+    ``manifest=`` — True (the active store's recorded miss manifest) or
+    a path: replay previously-observed feed shapes instead of/in
+    addition to the partitioner estimate.
+
+    Returns a :class:`WarmupReport`; warm keys make the first real
+    dispatch a jit-cache hit with zero compile (and, with a persistent
+    store, zero XLA even in a fresh process).
+    """
+    schema = getattr(frame_or_schema, "schema", frame_or_schema)
+    frame = frame_or_schema if hasattr(frame_or_schema, "schema") else None
+    programs = _as_program_list(
+        programs_or_verbs, schema, block=block, feed_dict=feed_dict
+    )
+    report = WarmupReport()
+
+    manifest_rows = []
+    if manifest:
+        if manifest is True:
+            from .store import active_store
+
+            store = active_store()
+            if store is None:
+                raise ValueError(
+                    "warmup(manifest=True) needs an active persistent "
+                    "store — set TFTPU_COMPILE_CACHE or "
+                    "configure(compilation_cache_dir=...), or pass the "
+                    "manifest path explicitly"
+                )
+            manifest_rows = store.read_manifest()
+        else:
+            import os as _os
+
+            if not _os.path.exists(str(manifest)):
+                raise ValueError(
+                    f"warmup manifest {manifest!r} does not exist — a "
+                    "silently-empty warmup would leave the first "
+                    "request paying the full compile"
+                )
+            from .store import CompileCacheStore
+
+            probe = CompileCacheStore.__new__(CompileCacheStore)
+            probe.manifest_path = str(manifest)
+            manifest_rows = CompileCacheStore.read_manifest(probe)
+        _warm_from_manifest(programs, manifest_rows, report, donate)
+
+    if rows is not None or frame is not None or not manifest:
+        counts = _target_row_counts(frame, rows, block)
+        for program in programs:
+            warm_program(program, counts, block=block, donate=donate,
+                         report=report)
+    return report

@@ -66,11 +66,20 @@ class Config:
     # Per-chip peak FLOP/s for MFU accounting in profiling.report()
     # (0 = unknown; bench.py sets it from the detected device kind).
     peak_flops: float = float(os.environ.get("TFTPU_PEAK_FLOPS", 0) or 0)
-    # Persistent XLA compilation cache directory: first TPU compiles of
+    # Persistent executable cache directory: first TPU compiles of
     # the big model programs take 20-40s; with a cache dir set, later
     # processes deserialize the executable instead of recompiling
-    # (empty = disabled).
+    # (empty = disabled). Two layers share the knob: jax's builtin
+    # HLO-keyed cache (wired at import) writes the root, and the AOT
+    # executable store (tensorframes_tpu/compilecache — consulted
+    # BEFORE lowering, so a hit skips HLO generation and XLA entirely)
+    # lives under <dir>/aot.
     compilation_cache_dir: str = os.environ.get("TFTPU_COMPILE_CACHE", "")
+    # Byte bound of the AOT executable store (<cache dir>/aot): least-
+    # recently-used entries are evicted past it. 0 disables eviction.
+    compile_cache_max_bytes: int = _env_int(
+        "TFTPU_COMPILE_CACHE_MAX_MB", 2048
+    ) * (1 << 20)
     # Lift closure-captured program constants (frozen model weights) out
     # of the HLO and pass them as runtime arguments. Without this, XLA
     # constant-folds through embedded weights — un-doing int8 weight

@@ -20,6 +20,7 @@ accounting SURVEY.md §7 hard-part 1 calls for.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -41,21 +42,31 @@ logger = get_logger(__name__)
 # Registered at import so the exposition always carries the executor
 # family (a cold cache reads hits=0, it does not vanish). "Hit" means
 # this CompiledProgram has already dispatched this exact feed-shape key;
-# a miss's first dispatch wall-clock (trace + XLA compile + run) lands
-# in the compile-seconds histogram — the honest recompile accounting
-# SURVEY §7 hard-part 1 asks for, now exported instead of only
-# introspectable via cache_sizes().
+# A miss's cost is split honestly (ISSUE 5 satellite): trace + XLA
+# compile lands in compile-seconds (skipped entirely when the
+# persistent store serves the executable — compare against
+# tftpu_compilecache_load_seconds), the first execution in
+# first-run-seconds. Only the legacy jit fallback (AOT-ineligible
+# feeds) still lumps compile+run into compile-seconds. This is the
+# honest recompile accounting SURVEY §7 hard-part 1 asks for.
 _JIT_HITS = _counter(
     "tftpu_executor_jit_cache_hits_total",
     "Block/row dispatches whose feed-shape key was already compiled",
 )
 _JIT_MISSES = _counter(
     "tftpu_executor_jit_cache_misses_total",
-    "Block/row dispatches that triggered a fresh trace+compile",
+    "Block/row dispatches that required a fresh executable (compiled "
+    "or loaded from the persistent store)",
 )
 _COMPILE_SECONDS = _histogram(
     "tftpu_executor_compile_seconds",
-    "Wall-clock of first dispatch per feed-shape key (trace + compile + run)",
+    "Trace + XLA-compile wall-clock per feed-shape key (persistent-"
+    "store hits skip it; the legacy jit fallback includes the first run)",
+)
+_FIRST_RUN_SECONDS = _histogram(
+    "tftpu_executor_first_run_seconds",
+    "Wall-clock of the first execution per feed-shape key, compile "
+    "excluded (AOT dispatch path only)",
 )
 _PADDING_WASTE = _counter(
     "tftpu_executor_padding_waste_rows_total",
@@ -144,6 +155,37 @@ def _hoisted_for(fn, feeds: Dict[str, jnp.ndarray]):
     return HoistedProgram(fn, abstract)
 
 
+def _aot_globally_eligible() -> bool:
+    """Multi-process runs keep the jax.jit path everywhere: the AOT
+    lowering here does not encode cross-process shardings. warm()
+    checks this too, so it never builds (and never marks dispatched)
+    executables the real dispatch would bypass."""
+    try:
+        return jax.process_count() <= 1
+    except Exception:  # pragma: no cover - defensive: never block dispatch
+        return False
+
+
+def _aot_eligible(feeds: Dict[str, object]) -> bool:
+    """True when these raw (pre-``jnp.asarray``) feeds can dispatch
+    through a per-shape AOT executable: host arrays or single-device
+    arrays on the default device. Multi-device/sharded inputs and
+    multi-process runs keep the jax.jit path, which re-specializes on
+    argument shardings the AOT lowering here does not encode."""
+    if not _aot_globally_eligible():
+        return False
+    try:
+        default = jax.devices()[0]
+        for v in feeds.values():
+            if isinstance(v, jax.Array):
+                devs = v.sharding.device_set
+                if len(devs) != 1 or next(iter(devs)) != default:
+                    return False
+    except Exception:  # pragma: no cover - defensive: never block dispatch
+        return False
+    return True
+
+
 class CompiledProgram:
     """A Program plus its jitted entrypoints (block and per-row)."""
 
@@ -169,11 +211,27 @@ class CompiledProgram:
         # (mirrors what XLA's own cache will decide, without reaching
         # into jax internals on the hot path)
         self._dispatched: set = set()
+        # per-feed-shape AOT executables (the primary dispatch path):
+        # built by explicit lower().compile() — or deserialized from
+        # the persistent store (compilecache) — so compile time and
+        # run time are separately measurable, and a warm store can
+        # skip XLA entirely. Keys include the donate variant; a key in
+        # _aot_failed permanently uses the legacy jit path instead.
+        self._aot: Dict[Tuple, Callable] = {}
+        self._aot_failed: set = set()
+        # _aot_lock guards the maps only; builds serialize on a PER-KEY
+        # lock so two shapes of one program can compile concurrently
+        # (the jax.jit path never imposed program-wide serialization)
+        self._aot_lock = threading.Lock()
+        self._aot_key_locks: Dict[Tuple, threading.Lock] = {}
 
     @staticmethod
     def _feeds_key(kind: str, feeds) -> Tuple:
         return (kind,) + tuple(
-            sorted((k, np.shape(v), str(v.dtype)) for k, v in feeds.items())
+            sorted(
+                (k, tuple(int(d) for d in np.shape(v)), str(v.dtype))
+                for k, v in feeds.items()
+            )
         )
 
     def _note_dispatch(self, key: Tuple, donate: bool) -> bool:
@@ -203,44 +261,258 @@ class CompiledProgram:
             self._hoisted[key] = entry
         return entry
 
+    def _kind_fn(self, kind: str) -> Callable:
+        return self.program.fn if kind == "block" else jax.vmap(
+            self.program.fn
+        )
+
+    def _fingerprint(self, kind: str, abstract: Dict, donate: bool,
+                     entry) -> Optional[str]:
+        """Persistent-store key for this (program, feed-shape, variant).
+        None when the program cannot be fingerprinted (no store use)."""
+        from ..compilecache.fingerprint import fingerprint_from_closed
+
+        avals = sorted(
+            (k, tuple(int(d) for d in v.shape), str(v.dtype))
+            for k, v in abstract.items()
+        )
+        outs = list(
+            self.program.fetch_order
+            or [o.name for o in self.program.outputs]
+        )
+        try:
+            if entry:
+                closed = entry.closed
+                hoisted = True
+            else:
+                closed = jax.make_jaxpr(self._kind_fn(kind))(abstract)
+                hoisted = False
+            return fingerprint_from_closed(
+                closed, avals, outs, kind=kind, donate=donate,
+                hoisted=hoisted,
+            )
+        except Exception as e:
+            logger.debug("program not fingerprintable: %s", e)
+            return None
+
+    def _build_aot(self, kind: str, akey: Tuple, feeds: Dict,
+                   donate: bool) -> Optional[Tuple[Callable, str]]:
+        """Build the per-shape executable for ``akey``: trace (hoisted
+        when possible), consult the persistent store, else AOT
+        lower+compile (timed into compile-seconds) and publish to the
+        store. Returns (callable, 'disk'|'compiled'), or None when this
+        key must use the legacy jit path. ``feeds`` may be concrete
+        arrays or ShapeDtypeStructs (warmup compiles without data)."""
+        with self._aot_lock:
+            call = self._aot.get(akey)
+            if call is not None:
+                return call, "cached"
+            if akey in self._aot_failed:
+                return None
+            key_lock = self._aot_key_locks.setdefault(
+                akey, threading.Lock()
+            )
+        with key_lock:
+            with self._aot_lock:  # lost the race: another thread built it
+                call = self._aot.get(akey)
+                if call is not None:
+                    return call, "cached"
+                if akey in self._aot_failed:
+                    return None
+            try:
+                call, how = self._build_aot_impl(kind, akey, feeds, donate)
+            except Exception as e:
+                logger.debug("AOT path unavailable for %s (%s); using "
+                             "jit dispatch", akey[0], e)
+                with self._aot_lock:
+                    self._aot_failed.add(akey)
+                return None
+            with self._aot_lock:
+                self._aot[akey] = call
+            return call, how
+
+    def _build_aot_impl(self, kind, akey, feeds, donate):
+        from ..compilecache import store as cc_store
+
+        base = akey[:-1] if akey and akey[-1] == "donate" else akey
+        abstract = {
+            k: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+            for k, v in feeds.items()
+        }
+        t0 = time.perf_counter()
+        entry = (
+            self._entry(base, self._kind_fn(kind), feeds)
+            if self.hoist else None
+        )
+        trace_s = time.perf_counter() - t0
+
+        store = None
+        fp = None
+        from ..plan.ir import program_has_callback
+
+        if not program_has_callback(self.program):
+            # callback programs bind process-local host functions — an
+            # executable serialized from one process cannot call back
+            # into another's registry, so they never touch the store
+            # (in-process AOT still applies)
+            store = cc_store.active_store()
+        if store is not None:
+            fp = self._fingerprint(kind, abstract, donate, entry)
+        meta_inputs = sorted(
+            (k, list(v.shape), str(v.dtype)) for k, v in abstract.items()
+        )
+        if fp is not None:
+            loaded = store.get(fp)
+            if loaded is not None:
+                return self._wrap_executable(entry, loaded), "disk"
+            store.record_miss(
+                kind,
+                [(n, tuple(s), d) for (n, s, d) in meta_inputs],
+                donate,
+            )
+
+        t1 = time.perf_counter()
+        if entry:
+            jitted = (
+                jax.jit(entry._run, donate_argnums=(1,))
+                if donate else entry.jitted
+            )
+            compiled = jitted.lower(
+                entry.consts, entry._flat_abstract
+            ).compile()
+        else:
+            jitted = (
+                jax.jit(self._kind_fn(kind), donate_argnums=(0,))
+                if donate else jax.jit(self._kind_fn(kind))
+            )
+            compiled = jitted.lower(abstract).compile()
+        _COMPILE_SECONDS.observe(trace_s + (time.perf_counter() - t1))
+        if fp is not None:
+            store.put(fp, compiled, meta={
+                "kind": kind,
+                "form": "hoisted" if entry else "plain",
+                "donate": donate,
+                "backend": jax.default_backend(),
+                "device_kind": getattr(
+                    jax.devices()[0], "device_kind", "unknown"
+                ),
+                "jax": jax.__version__,
+                "inputs": meta_inputs,
+            })
+        return self._wrap_executable(entry, compiled), "compiled"
+
+    @staticmethod
+    def _wrap_executable(entry, executable) -> Callable:
+        """Close the executable over its call convention: hoisted form
+        takes (consts, flat_inputs), plain form the feeds dict."""
+        if entry:
+            in_tree = entry.in_tree
+            consts = entry.consts
+
+            def call(feeds):
+                flat, tree = jax.tree_util.tree_flatten(feeds)
+                if tree != in_tree:
+                    raise ValueError(
+                        "input structure changed since tracing"
+                    )
+                return executable(consts, flat)
+
+            return call
+        return lambda feeds: executable(feeds)
+
+    def warm(self, kind: str, abstract: Dict[str, object],
+             donate: bool = False) -> str:
+        """Precompile (or disk-load) the executable for one feed-shape
+        key WITHOUT executing it — ``abstract`` maps input names to
+        ShapeDtypeStructs. The key is marked dispatched, so the first
+        real dispatch at this shape counts as a jit-cache hit (no
+        compile happens there). Returns 'cached' | 'disk' | 'compiled'
+        | 'failed' | 'ineligible'."""
+        if not _aot_globally_eligible():
+            # the real dispatch would take the legacy jit path here —
+            # building (and marking dispatched) would waste a compile
+            # AND make the later legacy compile masquerade as a hit
+            return "ineligible"
+        donate = donate and donation_supported()
+        key = self._feeds_key(kind, abstract)
+        akey = key + ("donate",) if donate else key
+        built = self._build_aot(kind, akey, abstract, donate)
+        if built is None:
+            return "failed"
+        self._dispatched.add(akey)
+        return built[1]
+
+    def _run(self, kind: str, feeds, to_numpy: bool, donate: bool):
+        fault_point(f"executor.run_{'block' if kind == 'block' else 'rows'}")
+        donate = donate and donation_supported()
+        aot_ok = _aot_eligible(feeds)
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        key = self._feeds_key(kind, feeds)
+        # NOTE: the hoisted entry is keyed WITHOUT donate (one
+        # HoistedProgram serves both; donation is a call-time argument),
+        # while the hit/miss identity includes it (donate variants are
+        # separate executables)
+        akey = key + ("donate",) if donate else key
+        fresh = self._note_dispatch(key, donate)
+        call = None
+        if aot_ok:
+            call = self._aot.get(akey)
+            if call is None:
+                built = self._build_aot(kind, akey, feeds, donate)
+                if built is not None:
+                    call = built[0]
+        t0 = time.perf_counter()
+        if call is not None:
+            out = call(feeds)
+        else:
+            out = self._legacy_call(kind, key, feeds, donate)
+        dt = time.perf_counter() - t0
+        if fresh:
+            if call is not None:
+                _FIRST_RUN_SECONDS.observe(dt)
+            else:
+                _COMPILE_SECONDS.observe(dt)  # legacy lump: compile+run
+        if _events.TRACER.enabled:
+            _events.TRACER.emit_complete(
+                f"executor.run_{'block' if kind == 'block' else 'rows'}",
+                t0, dt, args={"compiled": fresh}, cat="executor",
+            )
+        if not to_numpy:
+            return out  # stay in HBM: sharded frames chain without transfers
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _legacy_call(self, kind: str, key: Tuple, feeds, donate: bool):
+        """The pre-AOT jit dispatch path: multi-device/sharded feeds,
+        and programs whose AOT build failed."""
+        entry = (
+            self._entry(key, self._kind_fn(kind), feeds)
+            if self.hoist else None
+        )
+        if entry:
+            return entry(feeds, donate=donate)
+        if kind == "block":
+            if donate:
+                if self._jit_block_donate is None:
+                    self._jit_block_donate = jax.jit(
+                        self.program.fn, donate_argnums=(0,)
+                    )
+                return self._jit_block_donate(feeds)
+            return self.jit_block(feeds)
+        if donate:
+            if self._jit_vmap_donate is None:
+                self._jit_vmap_donate = jax.jit(
+                    jax.vmap(self.program.fn), donate_argnums=(0,)
+                )
+            return self._jit_vmap_donate(feeds)
+        return self.jit_vmap(feeds)
+
     def run_block(
         self,
         feeds: Dict[str, np.ndarray],
         to_numpy: bool = True,
         donate: bool = False,
     ) -> Dict[str, np.ndarray]:
-        fault_point("executor.run_block")
-        donate = donate and donation_supported()
-        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-        key = self._feeds_key("block", feeds)
-        # NOTE: the hoisted entry is keyed WITHOUT donate (one
-        # HoistedProgram serves both; donation is a call-time argument),
-        # while the hit/miss identity includes it (plain-path donate
-        # variants are separate compiles)
-        fresh = self._note_dispatch(key, donate)
-        t0 = time.perf_counter()
-        entry = self._entry(key, self.program.fn, feeds) if self.hoist else None
-        if entry:
-            out = entry(feeds, donate=donate)
-        elif donate:
-            if self._jit_block_donate is None:
-                self._jit_block_donate = jax.jit(
-                    self.program.fn, donate_argnums=(0,)
-                )
-            out = self._jit_block_donate(feeds)
-        else:
-            out = self.jit_block(feeds)
-        dt = time.perf_counter() - t0
-        if fresh:
-            _COMPILE_SECONDS.observe(dt)
-        if _events.TRACER.enabled:
-            _events.TRACER.emit_complete(
-                "executor.run_block", t0, dt,
-                args={"compiled": fresh}, cat="executor",
-            )
-        if not to_numpy:
-            return out  # stay in HBM: sharded frames chain without transfers
-        return {k: np.asarray(v) for k, v in out.items()}
+        return self._run("block", feeds, to_numpy, donate)
 
     def run_rows(
         self,
@@ -248,59 +520,36 @@ class CompiledProgram:
         to_numpy: bool = True,
         donate: bool = False,
     ) -> Dict[str, np.ndarray]:
-        fault_point("executor.run_rows")
-        donate = donate and donation_supported()
-        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-        key = self._feeds_key("vmap", feeds)
-        fresh = self._note_dispatch(key, donate)
-        t0 = time.perf_counter()
-        entry = (
-            self._entry(key, jax.vmap(self.program.fn), feeds)
-            if self.hoist
-            else None
-        )
-        if entry:
-            out = entry(feeds, donate=donate)
-        elif donate:
-            if self._jit_vmap_donate is None:
-                self._jit_vmap_donate = jax.jit(
-                    jax.vmap(self.program.fn), donate_argnums=(0,)
-                )
-            out = self._jit_vmap_donate(feeds)
-        else:
-            out = self.jit_vmap(feeds)
-        dt = time.perf_counter() - t0
-        if fresh:
-            _COMPILE_SECONDS.observe(dt)
-        if _events.TRACER.enabled:
-            _events.TRACER.emit_complete(
-                "executor.run_rows", t0, dt,
-                args={"compiled": fresh}, cat="executor",
-            )
-        if not to_numpy:
-            return out
-        return {k: np.asarray(v) for k, v in out.items()}
+        return self._run("vmap", feeds, to_numpy, donate)
 
     def cache_sizes(self) -> Dict[str, int]:
         """Honest recompile accounting (SURVEY §7 hard-part 1): how many
-        distinct shapes each entrypoint has compiled for. Ragged map_rows
-        grows the vmap cache by one per distinct (cell shape, lead-dim
-        bucket) group."""
+        distinct shapes each entrypoint holds an executable for (AOT
+        entries — compiled or store-loaded — plus legacy jit/hoisted
+        compiles; donate variants of one shape count once, as before).
+        Ragged map_rows grows the vmap cache by one per distinct
+        (cell shape, lead-dim bucket) group."""
         def size(fn) -> int:
             try:
                 return int(fn._cache_size())
             except Exception:  # pragma: no cover - jax internals moved
                 return -1
 
-        hoisted_block = sum(
-            1 for k, v in self._hoisted.items() if v and k[0] == "block"
-        )
-        hoisted_vmap = sum(
-            1 for k, v in self._hoisted.items() if v and k[0] == "vmap"
-        )
+        aot_bases = {
+            (k[:-1] if k and k[-1] == "donate" else k) for k in self._aot
+        }
+
+        def count(kind: str) -> int:
+            aot = sum(1 for b in aot_bases if b[0] == kind)
+            hoisted = sum(
+                1 for k, v in self._hoisted.items()
+                if v and k[0] == kind and k not in aot_bases
+            )
+            return aot + hoisted
+
         return {
-            "block": size(self.jit_block) + hoisted_block,
-            "vmap": size(self.jit_vmap) + hoisted_vmap,
+            "block": size(self.jit_block) + count("block"),
+            "vmap": size(self.jit_vmap) + count("vmap"),
         }
 
 

@@ -62,7 +62,7 @@ class HoistedProgram:
 
     __slots__ = (
         "jitted", "consts", "in_tree", "_flat_abstract", "_run",
-        "_jitted_donate",
+        "_jitted_donate", "closed", "out_tree",
     )
 
     def __init__(self, fn: Callable, abstract_inputs):
@@ -76,6 +76,13 @@ class HoistedProgram:
             abstract_inputs
         )
         jaxpr = closed.jaxpr
+        # kept for the persistent compile cache: the fingerprint hashes
+        # the jaxpr text + const avals (values stay out of the key — in
+        # this hoisted form the executable is weight-independent), and
+        # the store's serialized entries reconstruct call treedefs from
+        # (n_consts, input count, out_tree)
+        self.closed = closed
+        self.out_tree = out_tree
         self.consts = jax.device_put(closed.consts)
 
         def run(consts, flat_ins):

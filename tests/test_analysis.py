@@ -330,6 +330,39 @@ def test_tfg106_uses_memoized_cost_analysis_without_compiling():
 
 
 # ---------------------------------------------------------------------------
+# TFG108 cache-fingerprint-unstable
+# ---------------------------------------------------------------------------
+
+def test_tfg108_fires_on_nondeterministic_capture():
+    # np.random without a seed runs at TRACE time: every rebuild bakes
+    # a different constant into the jaxpr → the persistent compile
+    # cache would miss on every process start
+    p = tfs.compile_program(lambda x: {"y": x + np.random.rand()}, _frame())
+    [d] = p.lint().by_code("TFG108")
+    assert d.severity == "warn"
+    assert "miss storm" in d.message
+    assert "seed" in d.explain()
+
+
+def test_tfg108_silent_on_deterministic_program():
+    w = np.arange(3.0)
+    p = tfs.compile_program(
+        lambda x: {"y": x[:, None] * w[None, :] + 2.0}, _frame()
+    )
+    assert not p.lint().by_code("TFG108")
+
+
+def test_tfg108_silent_on_seeded_random_capture():
+    # random captures built OUTSIDE the traced fn (or from a seeded
+    # RNG inside it) are a fixed constant on every rebuild: stable
+    c = np.random.default_rng(42).standard_normal(3)
+    p = tfs.compile_program(
+        lambda x: {"y": x[:, None] + c[None, :]}, _frame()
+    )
+    assert not p.lint().by_code("TFG108")
+
+
+# ---------------------------------------------------------------------------
 # purity: a lint performs zero XLA compiles and zero device transfers
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,482 @@
+"""Persistent AOT executable cache + warmup (ISSUE 5).
+
+Covers the acceptance contracts: cross-process round trip (a subprocess
+warms the store, the parent hits it), two-writer races on one store
+dir, corrupt/truncated entries falling back to a fresh compile with the
+fallback counter bumped, byte-bound eviction, fused plan Programs
+hitting the same store, bit-identical outputs cache-on vs cache-off,
+and the executor's split compile/first-run accounting.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.compilecache import (
+    active_store,
+    partitioner_row_counts,
+    program_fingerprint,
+    store_for,
+    warmup,
+)
+from tensorframes_tpu.observability.metrics import REGISTRY
+
+
+def _metric(name, labels=()):
+    for d in REGISTRY.snapshot():
+        if d["name"] == name and tuple(sorted(d["labels"].items())) == tuple(
+            sorted(labels)
+        ):
+            return d
+    return {"value": 0.0, "count": 0}
+
+
+def _counter_val(name, labels=()):
+    return _metric(name, labels)["value"]
+
+
+def _hist_count(name):
+    return _metric(name)["count"]
+
+
+@pytest.fixture
+def store_dir(tmp_path):
+    """Point the runtime at a fresh store for one test; always restore
+    the disabled default afterwards."""
+    d = str(tmp_path / "cc")
+    tfs.configure(compilation_cache_dir=d)
+    try:
+        yield d
+    finally:
+        tfs.configure(compilation_cache_dir="")
+
+
+def _entries(store_dir):
+    aot = os.path.join(store_dir, "aot")
+    if not os.path.isdir(aot):
+        return []
+    return sorted(f for f in os.listdir(aot) if f.endswith(".xc"))
+
+
+# ---------------------------------------------------------------------------
+# defaults + fallback guarantees
+# ---------------------------------------------------------------------------
+
+def test_disabled_by_default_no_store_no_metrics(tmp_path):
+    from tensorframes_tpu.config import get_config
+
+    # active_store honors the live config; with the field empty it is None
+    prev = get_config().compilation_cache_dir
+    tfs.configure(compilation_cache_dir="")
+    try:
+        assert active_store() is None
+        h0 = _counter_val("tftpu_compilecache_hits_total")
+        m0 = _counter_val("tftpu_compilecache_misses_total")
+        f = tfs.frame_from_arrays({"x": np.arange(8.0)})
+        tfs.map_blocks(lambda x: {"y": x * 3.0}, f).blocks()
+        assert _counter_val("tftpu_compilecache_hits_total") == h0
+        assert _counter_val("tftpu_compilecache_misses_total") == m0
+    finally:
+        tfs.configure(compilation_cache_dir=prev)
+
+
+def test_store_error_never_fails_dispatch(tmp_path):
+    """An unusable cache dir (a FILE where the store dir should be)
+    degrades to normal compiles — the dispatch still succeeds."""
+    bad = tmp_path / "not-a-dir"
+    bad.write_text("occupied")
+    tfs.configure(compilation_cache_dir=str(bad))
+    try:
+        f = tfs.frame_from_arrays({"x": np.arange(8.0)})
+        out = tfs.map_blocks(lambda x: {"y": x + 0.5}, f).blocks()
+        np.testing.assert_array_equal(
+            np.concatenate([b["y"] for b in out]), np.arange(8.0) + 0.5
+        )
+    finally:
+        tfs.configure(compilation_cache_dir="")
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+def test_in_process_roundtrip_bit_identical(store_dir):
+    """Second (fresh) Program of the same fn+shape deserializes from
+    disk: zero compiles, outputs bitwise equal to the cache-off run."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(64)
+    frame = tfs.frame_from_arrays({"x": x}, num_blocks=2)
+
+    def fn(x):
+        return {"y": np.float64(2.0) * x * x - x / np.float64(3.0)}
+
+    # reference run with the cache OFF
+    tfs.configure(compilation_cache_dir="")
+    ref = tfs.map_blocks(tfs.compile_program(fn, frame), frame).blocks()
+    tfs.configure(compilation_cache_dir=store_dir)
+
+    p1 = tfs.compile_program(fn, frame)
+    warm_out = tfs.map_blocks(p1, frame).blocks()
+    assert _entries(store_dir), "first run must publish store entries"
+
+    h0 = _counter_val("tftpu_compilecache_hits_total")
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    p2 = tfs.compile_program(fn, frame)
+    hit_out = tfs.map_blocks(p2, frame).blocks()
+    assert _counter_val("tftpu_compilecache_hits_total") > h0
+    assert _hist_count("tftpu_executor_compile_seconds") == c0
+    assert _hist_count("tftpu_compilecache_load_seconds") >= 1
+    for a, b, c in zip(ref, warm_out, hit_out):
+        assert np.array_equal(a["y"], b["y"])
+        assert np.array_equal(a["y"], c["y"])  # bit-identical, cache on/off
+
+
+def test_cross_process_roundtrip(store_dir, tmp_path):
+    """A subprocess warms the store; the parent's identical program
+    hits it — the fingerprint survives process restarts."""
+    script = tmp_path / "warm_child.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "import tensorframes_tpu as tfs\n"
+        "frame = tfs.frame_from_arrays({'v': np.arange(24.0)}, num_blocks=3)\n"
+        "p = tfs.compile_program(lambda v: {'w': v * 7.0 + 1.0}, frame)\n"
+        "tfs.map_blocks(p, frame).blocks()\n"
+        "from tensorframes_tpu.compilecache import active_store\n"
+        "print('entries=', len(active_store().stats()['entry_list']))\n"
+        % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = {**os.environ, "TFTPU_COMPILE_CACHE": store_dir,
+           "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert _entries(store_dir), "child must have published entries"
+
+    h0 = _counter_val("tftpu_compilecache_hits_total")
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    frame = tfs.frame_from_arrays({"v": np.arange(24.0)}, num_blocks=3)
+    p = tfs.compile_program(lambda v: {"w": v * 7.0 + 1.0}, frame)
+    out = tfs.map_blocks(p, frame).blocks()
+    np.testing.assert_array_equal(out[0]["w"], np.arange(8.0) * 7.0 + 1.0)
+    assert _counter_val("tftpu_compilecache_hits_total") > h0, \
+        "parent must hit the child's entries"
+    assert _hist_count("tftpu_executor_compile_seconds") == c0, \
+        "a disk hit must not compile"
+
+
+def test_fused_plan_programs_hit_store(store_dir):
+    """A fused lazy chain's composed Program goes through the same
+    store: an identical fresh chain deserializes instead of compiling."""
+    x = np.arange(48.0)
+
+    def build_and_force():
+        frame = tfs.frame_from_arrays({"x": x}, num_blocks=2)
+        f1 = tfs.map_blocks(lambda x: {"y": x * 2.0 + 1.0}, frame)
+        f2 = tfs.map_blocks(lambda y: {"z": y * 0.5 - 3.0}, f1)
+        return f2.select(["z"]).blocks()
+
+    first = build_and_force()
+    assert _entries(store_dir)
+    h0 = _counter_val("tftpu_compilecache_hits_total")
+    second = build_and_force()
+    assert _counter_val("tftpu_compilecache_hits_total") > h0
+    for a, b in zip(first, second):
+        assert np.array_equal(a["z"], b["z"])
+
+
+# ---------------------------------------------------------------------------
+# durability: corruption, races, eviction
+# ---------------------------------------------------------------------------
+
+def test_corrupt_entry_falls_back_to_compile(store_dir):
+    frame = tfs.frame_from_arrays({"x": np.arange(16.0)}, num_blocks=2)
+
+    def fn(x):
+        return {"y": x - 11.0}
+
+    tfs.map_blocks(tfs.compile_program(fn, frame), frame).blocks()
+    entries = _entries(store_dir)
+    assert entries
+    # truncate one entry and bit-flip another byte range via rewrite
+    path = os.path.join(store_dir, "aot", entries[0])
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: max(8, len(blob) // 2)])
+
+    fb0 = _counter_val("tftpu_compilecache_fallback_total",
+                       (("reason", "corrupt"),))
+    out = tfs.map_blocks(tfs.compile_program(fn, frame), frame).blocks()
+    np.testing.assert_array_equal(out[0]["y"], np.arange(8.0) - 11.0)
+    assert _counter_val("tftpu_compilecache_fallback_total",
+                        (("reason", "corrupt"),)) > fb0
+    # the defective entry was quarantined and re-published by the
+    # fallback compile: the store heals itself
+    assert _entries(store_dir)
+
+
+def test_two_writer_race_same_store(store_dir):
+    """Concurrent writers publishing the same and different entries
+    leave a consistent store (atomic replace; no torn entries)."""
+    store = store_for(os.path.join(store_dir, "aot"))
+    frame = tfs.frame_from_arrays({"x": np.arange(32.0)}, num_blocks=2)
+    programs = [
+        tfs.compile_program((lambda k: lambda x: {"y": x + float(k)})(k),
+                            frame)
+        for k in range(4)
+    ]
+
+    errs = []
+
+    def worker(p):
+        try:
+            for _ in range(3):
+                tfs.map_blocks(p, frame).blocks()
+        except Exception as e:  # pragma: no cover - the assertion target
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in programs for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    report = store.verify()
+    assert report["ok"], report
+
+
+def test_eviction_respects_byte_bound(store_dir):
+    store = store_for(os.path.join(store_dir, "aot"))
+    frame = tfs.frame_from_arrays({"x": np.arange(16.0)}, num_blocks=1)
+    for k in range(4):
+        p = tfs.compile_program(
+            (lambda kk: lambda x: {"y": x * float(kk + 2)})(k), frame
+        )
+        tfs.map_blocks(p, frame).blocks()
+    entries = [(os.path.join(store_dir, "aot", e),
+                os.path.getsize(os.path.join(store_dir, "aot", e)))
+               for e in _entries(store_dir)]
+    assert len(entries) == 4
+    # bound that fits roughly two entries
+    bound = sum(s for _, s in entries[:2]) + 1
+    ev0 = _counter_val("tftpu_compilecache_evictions_total")
+    store.max_bytes = bound
+    store._evict()
+    left = _entries(store_dir)
+    total = sum(
+        os.path.getsize(os.path.join(store_dir, "aot", e)) for e in left
+    )
+    assert total <= bound
+    assert len(left) < 4
+    assert _counter_val("tftpu_compilecache_evictions_total") > ev0
+
+
+# ---------------------------------------------------------------------------
+# warmup
+# ---------------------------------------------------------------------------
+
+def test_warmup_precompiles_partitioner_buckets(store_dir):
+    frame = tfs.frame_from_arrays({"x": np.arange(21.0)}, num_blocks=4)
+    program = tfs.compile_program(lambda x: {"y": x * x}, frame)
+    report = warmup(frame, program)
+    # 21 rows over 4 blocks → blocks of 5 and 6 rows: both warmed
+    assert {e["rows"] for e in report.entries} == {5, 6}
+    assert report.compiled == 2
+
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    m0 = _counter_val("tftpu_executor_jit_cache_misses_total")
+    h0 = _counter_val("tftpu_executor_jit_cache_hits_total")
+    out = tfs.map_blocks(program, frame).blocks()
+    assert _hist_count("tftpu_executor_compile_seconds") == c0, \
+        "warmed dispatch must not compile"
+    assert _counter_val("tftpu_executor_jit_cache_misses_total") == m0
+    assert _counter_val("tftpu_executor_jit_cache_hits_total") > h0
+    np.testing.assert_array_equal(
+        np.concatenate([b["y"] for b in out]), np.arange(21.0) ** 2
+    )
+
+
+def test_warmup_rows_mode_buckets(store_dir):
+    from tensorframes_tpu.ops.executor import bucket_rows
+
+    frame = tfs.frame_from_arrays({"x": np.arange(10.0)}, num_blocks=1)
+    program = tfs.compile_program(
+        lambda x: {"s": x * 2.0}, frame, block=False
+    )
+    report = warmup(frame, program, block=False)
+    # both regimes warmed: the exact size (adaptive pre-bucket phase)
+    # and its power-of-two bucket (shape-proliferation phase)
+    assert {e["rows"] for e in report.entries} == {10, bucket_rows(10)}
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    tfs.map_rows(program, frame).blocks()
+    assert _hist_count("tftpu_executor_compile_seconds") == c0
+
+
+def test_warmup_from_manifest(store_dir):
+    """The executor records miss shapes; warmup replays them for a
+    fresh program so a new process precompiles yesterday's traffic."""
+    frame = tfs.frame_from_arrays({"x": np.arange(12.0)}, num_blocks=2)
+
+    def fn(x):
+        return {"y": x + 100.0}
+
+    tfs.map_blocks(tfs.compile_program(fn, frame), frame).blocks()
+    manifest = os.path.join(store_dir, "aot", "manifest.jsonl")
+    rows = [json.loads(ln) for ln in open(manifest)]
+    assert rows and rows[0]["inputs"][0][0] == "x"
+
+    fresh = tfs.compile_program(fn, frame)
+    report = warmup(None, fresh, manifest=manifest)
+    assert report.entries, "manifest rows must map onto the program"
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    tfs.map_blocks(fresh, frame).blocks()
+    assert _hist_count("tftpu_executor_compile_seconds") == c0
+
+
+def test_warmup_manifest_requires_matching_dtype_and_cells(store_dir):
+    """The manifest is store-wide: rows recorded for one program must
+    not warm an unrelated program that happens to share input names."""
+    f64 = tfs.frame_from_arrays({"x": np.arange(12.0)}, num_blocks=2)
+    tfs.map_blocks(
+        tfs.compile_program(lambda x: {"y": x + 1.0}, f64), f64
+    ).blocks()
+    manifest = os.path.join(store_dir, "aot", "manifest.jsonl")
+    assert os.path.exists(manifest)
+
+    # same input name 'x', different dtype: the recorded f64 shapes
+    # must not be replayed into an i64 program
+    i64 = tfs.frame_from_arrays({"x": np.arange(12)}, num_blocks=2)
+    other = tfs.compile_program(lambda x: {"y": x * 2}, i64)
+    report = warmup(None, other, manifest=manifest)
+    assert not report.entries
+
+
+def test_warmup_manifest_true_without_store_raises():
+    tfs.configure(compilation_cache_dir="")
+    frame = tfs.frame_from_arrays({"x": np.arange(4.0)})
+    program = tfs.compile_program(lambda x: {"y": x}, frame)
+    with pytest.raises(ValueError, match="persistent store"):
+        warmup(None, program, manifest=True)
+    with pytest.raises(ValueError, match="does not exist"):
+        warmup(None, program, manifest="/nonexistent/manifest.jsonl")
+
+
+def test_warmup_without_frame_needs_rows():
+    frame = tfs.frame_from_arrays({"x": np.arange(4.0)})
+    program = tfs.compile_program(lambda x: {"y": x}, frame)
+    with pytest.raises(ValueError, match="rows"):
+        warmup(None, program)
+
+
+def test_partitioner_row_counts():
+    assert partitioner_row_counts(21, 4) == [5, 6]
+    assert partitioner_row_counts(20, 4) == [5]
+    assert partitioner_row_counts(3, 8) == [1]
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_across_rebuilds_and_distinct_by_shape():
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)})
+    p = tfs.compile_program(lambda x: {"y": x * 5.0}, frame)
+    a = program_fingerprint(p, probe=8)
+    b = program_fingerprint(p, probe=8)
+    assert a == b
+    assert program_fingerprint(p, probe=16) != a  # shape in the key
+    p2 = tfs.compile_program(lambda x: {"y": x * 6.0}, frame)
+    assert program_fingerprint(p2, probe=8) != a  # content in the key
+
+
+def test_fingerprint_donate_and_kind_in_key():
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)})
+    p = tfs.compile_program(lambda x: {"y": x * 5.0}, frame)
+    base = program_fingerprint(p, probe=8)
+    assert program_fingerprint(p, probe=8, donate=True) != base
+    assert program_fingerprint(p, probe=8, kind="vmap") != base
+
+
+# ---------------------------------------------------------------------------
+# accounting split (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+def test_compile_vs_first_run_split():
+    """With the cache off, a fresh shape observes compile-seconds AND
+    first-run-seconds exactly once each; a repeat dispatch observes
+    neither."""
+    tfs.configure(compilation_cache_dir="")
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)}, num_blocks=1)
+    program = tfs.compile_program(lambda x: {"y": x / 4.0}, frame)
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    r0 = _hist_count("tftpu_executor_first_run_seconds")
+    tfs.map_blocks(program, frame).blocks()
+    assert _hist_count("tftpu_executor_compile_seconds") == c0 + 1
+    assert _hist_count("tftpu_executor_first_run_seconds") == r0 + 1
+    tfs.map_blocks(program, frame).blocks()
+    assert _hist_count("tftpu_executor_compile_seconds") == c0 + 1
+    assert _hist_count("tftpu_executor_first_run_seconds") == r0 + 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_stats_verify_prune(store_dir, capsys):
+    from tensorframes_tpu.compilecache.cli import main
+
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)})
+    tfs.map_blocks(
+        tfs.compile_program(lambda x: {"y": x * 9.0}, frame), frame
+    ).blocks()
+    assert _entries(store_dir)
+
+    assert main(["--store", store_dir, "stats", "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["entries"] >= 1 and stats["bytes"] > 0
+
+    assert main(["--store", store_dir, "verify", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] and report["good"] >= 1
+
+    # corrupt → verify fails → verify --delete-bad heals
+    path = os.path.join(store_dir, "aot", _entries(store_dir)[0])
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    assert main(["--store", store_dir, "verify", "--json"]) == 1
+    capsys.readouterr()
+    assert main(["--store", store_dir, "verify", "--json",
+                 "--delete-bad"]) == 1
+    capsys.readouterr()
+    assert main(["--store", store_dir, "verify", "--json"]) == 0
+    capsys.readouterr()
+
+    assert main(["--store", store_dir, "prune", "--clear"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["entries"] == 0
+    assert not _entries(store_dir)
+
+
+def test_cli_warm_bundle(store_dir, tmp_path, capsys):
+    from tensorframes_tpu.compilecache.cli import main
+    from tensorframes_tpu.program import save_program
+
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)})
+    program = tfs.compile_program(lambda x: {"y": x + 2.5}, frame)
+    bundle = str(tmp_path / "prog.pb")
+    save_program(program, bundle)
+    assert main(["--store", store_dir, "warm", bundle, "--rows", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "compiled" in out or "disk" in out
+    assert _entries(store_dir), "CLI warm must populate the store"

@@ -312,18 +312,38 @@ def _snap():
 
 
 def test_executor_cache_hit_miss_counters():
+    s0 = _snap()
+    misses0 = s0[("tftpu_executor_jit_cache_misses_total", ())]["value"]
+    compiles0 = s0[("tftpu_executor_compile_seconds", ())]["count"]
+    runs0 = s0[("tftpu_executor_first_run_seconds", ())]["count"]
     df = tfs.frame_from_arrays({"x": np.arange(16.0)}, num_blocks=2)
-    tfs.map_blocks(lambda x: {"y": x + 1}, df).collect()
+    program = tfs.compile_program(lambda x: {"y": x + 1}, df)
+    tfs.map_blocks(program, df).collect()
     s1 = _snap()
-    misses1 = s1[("tftpu_executor_jit_cache_misses_total", ())]["value"]
-    compiles1 = s1[("tftpu_executor_compile_seconds", ())]["count"]
-    assert misses1 >= 1
-    assert compiles1 == misses1
-    tfs.map_blocks(lambda x: {"y": x + 1}, df).collect()
+    # deltas, not session cumulatives: with the persistent store or
+    # warmup in play elsewhere in the session, misses can be served
+    # without a compile (disk hit) and compiles can happen without a
+    # miss (warm) — but a fresh program with no store support must
+    # compile exactly once per miss, and its first run is timed
+    # separately from the compile (ISSUE 5 accounting split)
+    d_miss = s1[("tftpu_executor_jit_cache_misses_total", ())]["value"] - misses0
+    d_compile = s1[("tftpu_executor_compile_seconds", ())]["count"] - compiles0
+    d_first = s1[("tftpu_executor_first_run_seconds", ())]["count"] - runs0
+    assert d_miss >= 1
+    from tensorframes_tpu.compilecache import active_store
+
+    if active_store() is None:  # a live store may serve misses from disk
+        assert d_compile == d_miss
+        assert d_first == d_miss
+    hits1 = s1[("tftpu_executor_jit_cache_hits_total", ())]["value"]
+    tfs.map_blocks(program, df).collect()
     s2 = _snap()
-    # re-running the same frame+program adds hits, not misses
-    assert s2[("tftpu_executor_jit_cache_hits_total", ())]["value"] >= 1
-    assert s2[("tftpu_executor_jit_cache_misses_total", ())]["value"] >= misses1
+    # re-running the same frame+program adds hits, not misses/compiles
+    assert s2[("tftpu_executor_jit_cache_hits_total", ())]["value"] > hits1
+    assert (s2[("tftpu_executor_jit_cache_misses_total", ())]["value"]
+            - misses0 == d_miss)
+    assert (s2[("tftpu_executor_compile_seconds", ())]["count"]
+            - compiles0 == d_compile)
 
 
 def test_padding_waste_counter():
