@@ -1398,6 +1398,40 @@ def main():
     except Exception as e:  # never let telemetry kill the JSON line
         print(f"# obs | snapshot unavailable: {e}")
 
+    # per-verb dispatch latency quantiles (ISSUE 6): the p50/p95/p99
+    # rows `observability diff` gates on, printed in the same parseable
+    # shape as `# obs |` so committed BENCH rounds carry them
+    try:
+        from tensorframes_tpu.observability import latency as _lat
+
+        for ln in _lat.summary_lines():
+            print(f"# latency | {ln}")
+    except Exception as e:  # never let telemetry kill the JSON line
+        print(f"# latency | unavailable: {e}")
+
+    # structured snapshot (TFTPU_BENCH_SNAPSHOT=path): the machine-
+    # checkable form of this run — metrics dict + latency quantiles +
+    # run context — that `observability diff` compares against a
+    # committed BENCH_r*.json round or another snapshot
+    snap_path = os.environ.get("TFTPU_BENCH_SNAPSHOT")
+    if snap_path:
+        try:
+            from tensorframes_tpu.observability import snapshot as _snap
+
+            ok_metrics = {
+                k: v for k, v in metrics.items() if k not in _ERRORS
+            }
+            _snap.write_snapshot(snap_path, ok_metrics, meta={
+                "platform": jax.devices()[0].platform,
+                "device_kind": getattr(
+                    jax.devices()[0], "device_kind", "cpu"
+                ),
+                "chips": n_chips,
+            })
+            print(f"# snapshot | wrote {snap_path}")
+        except Exception as e:
+            print(f"# snapshot | failed: {e}")
+
     # static-analysis posture of a benched program (ISSUE 3): lint the
     # logreg scoring program (config 3's fixture — cheap to rebuild, and
     # the lint is tracing-only so it never compiles or dispatches) and

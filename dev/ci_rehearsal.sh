@@ -95,7 +95,7 @@ run_step "Install check (package metadata + import from install target)" \
   env PYTHONPATH="$SITE" python -c "import tensorframes_tpu, importlib.metadata as md; print('installed', md.version('tensorframes-tpu'))"
 
 run_step "Test (8-device virtual CPU mesh)" \
-  env TFTPU_OBS_EXPORT="$WORK/obs" python -m pytest tests/ -x -q
+  env TFTPU_OBS_EXPORT="$WORK/obs" TFTPU_FLIGHT_DIR="$WORK/obs/flight" python -m pytest tests/ -x -q
 
 # ci.yml's fusion-off smoke: TFTPU_FUSION=0 (the plan layer's escape
 # hatch) must keep the verb/frame/sweep suites green on the per-stage
@@ -143,6 +143,18 @@ run_step "Bench smoke (CPU fallback)" bash -c \
 
 run_step "Bench regression gate (factor 10, alien-runner allowance)" \
   python dev/bench_check.py bench_out.txt --factor 10
+
+# ci.yml's bench-diff step: per-metric trajectory vs the latest
+# committed BENCH_r*.json round via `observability diff` — warn-only,
+# like CI: a contended rehearsal machine is even noisier than a runner
+run_step "Bench diff vs committed round (observability diff, warn-only)" bash -c '
+  LATEST=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1)
+  if [ -n "$LATEST" ]; then
+    python -m tensorframes_tpu.observability diff "$LATEST" bench_out.txt --warn-only
+  else
+    echo "no committed BENCH_r*.json round; skipping diff"
+  fi
+'
 
 run_step "Multi-chip dryrun (8 virtual devices)" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

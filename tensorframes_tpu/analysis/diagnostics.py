@@ -188,14 +188,25 @@ class DiagnosticReport:
         contract on the verbs); returns self otherwise so calls chain."""
         errs = self.errors
         if errs:
+            from ..observability import flight as _flight
             from ..validation import StaticAnalysisError
 
-            raise StaticAnalysisError(
+            err = StaticAnalysisError(
                 "static analysis found "
                 f"{len(errs)} error-severity diagnostic(s):\n"
                 + "\n".join(d.explain() for d in errs),
                 diagnostics=errs,
             )
+            # strict-mode rejection is a flight-recorder dump trigger:
+            # the black box shows what dispatched before the program
+            # that failed the gate, even when the caller catches this
+            _flight.record(
+                "static_analysis.error", subject=self.subject,
+                codes=",".join(sorted({d.code for d in errs})),
+                count=len(errs),
+            )
+            _flight.dump(reason="static-analysis", exc=err)
+            raise err
         return self
 
 

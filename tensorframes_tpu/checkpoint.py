@@ -52,6 +52,7 @@ import jax
 import numpy as np
 
 from .observability import events as _events
+from .observability import flight as _flight
 from .observability.metrics import counter as _counter
 from .observability.metrics import histogram as _histogram
 from .resilience.faults import fault_point
@@ -328,6 +329,10 @@ class Checkpointer:
                 "checkpoint.save", t0, dt,
                 args={"step": step, "bytes": nbytes}, cat="checkpoint",
             )
+        _flight.record(
+            "checkpoint.save", step=step, seconds=round(dt, 6),
+            bytes=nbytes,
+        )
         self._gc()
         return final
 
@@ -421,6 +426,10 @@ class Checkpointer:
                     "checkpoint.restore", t0, dt,
                     args={"step": step, "ok": ok}, cat="checkpoint",
                 )
+            _flight.record(
+                "checkpoint.restore", step=step, seconds=round(dt, 6),
+                ok=ok,
+            )
 
     # -- integrity audit ----------------------------------------------------
 

@@ -34,9 +34,15 @@ idle counter reads 0 instead of vanishing.
 
 from __future__ import annotations
 
+from . import context  # noqa: F401
 from . import events  # noqa: F401
+from . import flight  # noqa: F401
+from . import latency  # noqa: F401
+from . import merge  # noqa: F401
 from . import metrics  # noqa: F401
 from .events import TRACER, Tracer  # noqa: F401
+from .flight import FlightRecorder, RECORDER  # noqa: F401
+from .merge import merge_traces  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -51,10 +57,17 @@ from .metrics import (  # noqa: F401
 from .steps import StepTelemetry  # noqa: F401
 
 __all__ = [
+    "context",
     "events",
+    "flight",
+    "latency",
+    "merge",
     "metrics",
     "Tracer",
     "TRACER",
+    "FlightRecorder",
+    "RECORDER",
+    "merge_traces",
     "Counter",
     "Gauge",
     "Histogram",

@@ -1,0 +1,7 @@
+"""Entry point: ``python -m tensorframes_tpu.observability``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,179 @@
+"""Trace context: the (run_id, process_index) identity of this process.
+
+Every ROADMAP direction after PR 2 is multi-process (sharded AOT,
+out-of-core shuffle, serving), and a fleet of per-process telemetry
+files is unmergeable unless each one says which *run* it belongs to and
+which *process* wrote it. This module owns that identity:
+
+* ``run_id()`` — one id per logical run, shared by every process of a
+  multi-process launch. ``TFTPU_RUN_ID`` wins (the launcher exports it
+  to the whole fleet); otherwise a random 12-hex id is minted once per
+  process. A parent forking workers calls :func:`child_env` to hand
+  them its id.
+* ``process_index()`` — this process's rank. Resolution order: an
+  explicit :func:`bind` (``parallel.distributed.init_distributed``
+  binds the JAX process id after the coordinator handshake) >
+  ``TFTPU_PROCESS_INDEX`` > ``JAX_PROCESS_ID`` > ``jax.process_index()``
+  when a backend is already live > 0. The env fallbacks matter for
+  plain ``fork``/``spawn`` fleets (the MULTICHIP dryrun shape) that
+  never touch ``jax.distributed``.
+
+The context is stamped onto every exported telemetry artifact: trace
+shards (``events.save``/``save_shard`` metadata), metrics JSONL rows,
+step-log lines, and flight-recorder records — which is what makes the
+``observability merge`` aggregator able to reassemble one timeline from
+a MULTICHIP-style run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import uuid
+from typing import Dict, Optional
+
+__all__ = [
+    "run_id",
+    "set_run_id",
+    "process_index",
+    "num_processes",
+    "bind",
+    "snapshot",
+    "child_env",
+]
+
+_lock = threading.Lock()
+_run_id: Optional[str] = None
+_process_index: Optional[int] = None
+_num_processes: Optional[int] = None
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def run_id() -> str:
+    """The logical run id (stable for the life of this process)."""
+    global _run_id
+    with _lock:
+        if _run_id is None:
+            _run_id = os.environ.get("TFTPU_RUN_ID") or uuid.uuid4().hex[:12]
+        return _run_id
+
+
+def set_run_id(rid: str) -> None:
+    """Pin the run id (launchers that mint their own ids)."""
+    global _run_id
+    if not rid:
+        raise ValueError("run_id must be non-empty")
+    with _lock:
+        _run_id = str(rid)
+
+
+def _jax_index_if_live() -> Optional[int]:
+    """jax's process index, ONLY if a backend is already initialized.
+    ``jax.process_index()`` would happily initialize the backend as a
+    side effect — a telemetry stamp written before the coordinator
+    handshake must never do that (it would pin the process to a
+    single-process rank-0 backend right before init_distributed tries
+    the real multi-process init). When the liveness probe is
+    unavailable, the answer is None, not a gamble."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return None
+    except Exception:
+        return None  # probe moved: never risk triggering init
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return None
+
+
+def process_index() -> int:
+    """This process's rank within the run (0 on single-process runs)."""
+    with _lock:
+        if _process_index is not None:
+            return _process_index
+    idx = _env_int("TFTPU_PROCESS_INDEX")
+    if idx is None:
+        idx = _env_int("JAX_PROCESS_ID")
+    if idx is None:
+        idx = _jax_index_if_live()
+    return idx if idx is not None else 0
+
+
+def num_processes() -> Optional[int]:
+    """Process count of the run, when known (None otherwise)."""
+    with _lock:
+        if _num_processes is not None:
+            return _num_processes
+    return _env_int("TFTPU_NUM_PROCESSES") or _env_int("JAX_NUM_PROCESSES")
+
+
+def bind(
+    process_index: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    run_id: Optional[str] = None,
+) -> None:
+    """Authoritatively set context fields (``init_distributed`` calls
+    this after the coordinator handshake; tests and custom launchers may
+    too). ``None`` fields are left as-is."""
+    global _process_index, _num_processes, _run_id
+    with _lock:
+        if process_index is not None:
+            _process_index = int(process_index)
+        if num_processes is not None:
+            _num_processes = int(num_processes)
+        if run_id is not None:
+            _run_id = str(run_id)
+
+
+def snapshot() -> Dict[str, object]:
+    """``{"run_id", "process_index"}`` — the stamp every telemetry
+    exporter attaches."""
+    return {"run_id": run_id(), "process_index": process_index()}
+
+
+def child_env(index: Optional[int] = None) -> Dict[str, str]:
+    """Env vars a launcher hands a forked/spawned worker so its shards
+    join this run: the shared ``TFTPU_RUN_ID`` plus (when ``index`` is
+    given) the worker's ``TFTPU_PROCESS_INDEX``."""
+    env = {"TFTPU_RUN_ID": run_id()}
+    if index is not None:
+        env["TFTPU_PROCESS_INDEX"] = str(int(index))
+    return env
+
+
+def _reset_for_tests() -> None:
+    """Forget bound/minted context (test hygiene only)."""
+    global _run_id, _process_index, _num_processes
+    with _lock:
+        _run_id = None
+        _process_index = None
+        _num_processes = None
+
+
+def _after_fork_in_child() -> None:
+    # a parent-bound rank is wrong in a forked worker: drop it so the
+    # child re-resolves from ITS env (fork launchers set
+    # TFTPU_PROCESS_INDEX per child); the minted run_id is kept — the
+    # fork family IS one run. No lock: the child is single-threaded at
+    # this instant, and the parent's lock state is unreliable here.
+    global _process_index
+    _process_index = None
+
+
+if hasattr(os, "register_at_fork"):  # pragma: no branch - posix
+    os.register_at_fork(after_in_child=_after_fork_in_child)

@@ -38,6 +38,8 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from ..utils import get_logger
+from . import context as _context
+from .metrics import counter as _counter
 
 logger = get_logger(__name__)
 
@@ -52,12 +54,27 @@ __all__ = [
     "instant",
     "to_chrome_trace",
     "save",
+    "save_shard",
 ]
 
 #: Monotonic epoch for this process: every timestamp is microseconds
 #: since this instant (Chrome traces need only a consistent monotonic
 #: base; perf_counter is the highest-resolution clock available).
 _EPOCH = time.perf_counter()
+#: Wall-clock captured at the same instant as ``_EPOCH``: the anchor
+#: that lets the cross-process merge aggregator place each process's
+#: monotonic timeline on one shared real-time axis.
+_EPOCH_UNIX_US = int(time.time() * 1e6)
+
+# Events dropped at the full ring, as a registry counter (pre-registered
+# so the family is always in the exposition): the in-object ``dropped``
+# count is invisible to a metrics scrape, and a silently-truncated trace
+# reads as "nothing else happened" — exactly the failure ISSUE 6's first
+# satellite names.
+_EVENTS_DROPPED = _counter(
+    "tftpu_trace_events_dropped_total",
+    "Trace events discarded because the tracer ring was full",
+)
 
 
 def _us(t_perf: float) -> float:
@@ -123,6 +140,7 @@ class Tracer:
             # from thread churn in a long run
             if len(self._events) >= self.max_events:
                 self.dropped += 1
+                _EVENTS_DROPPED.inc()
                 return
             if (
                 tid not in self._named_threads
@@ -202,7 +220,10 @@ class Tracer:
 
     def to_chrome_trace(self) -> Dict[str, Any]:
         """The JSON-object trace format: ``{"traceEvents": [...]}`` plus
-        metadata — accepted by Perfetto and chrome://tracing."""
+        metadata — accepted by Perfetto and chrome://tracing. The
+        ``otherData`` stamp (run_id, process_index, wall-clock epoch)
+        is the shard-correlation contract ``observability merge`` reads:
+        without it a multi-process run's traces are unjoinable."""
         with self._lock:
             events = list(self._events)
             dropped = self.dropped
@@ -212,6 +233,10 @@ class Tracer:
             "otherData": {
                 "producer": "tensorframes_tpu.observability.events",
                 "dropped_events": dropped,
+                "run_id": _context.run_id(),
+                "process_index": _context.process_index(),
+                "pid": os.getpid(),
+                "trace_epoch_unix_us": _EPOCH_UNIX_US,
             },
         }
 
@@ -229,10 +254,39 @@ class Tracer:
         )
         return path
 
+    def save_shard(self, directory: str) -> str:
+        """Write this process's trace as a per-process SHARD —
+        ``<dir>/trace_<run_id>_p<process_index>.json`` — the file layout
+        ``observability merge`` globs to rebuild a whole-run timeline.
+        Every process of a run calls this against one shared directory
+        (rank in the name keeps writers collision-free)."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(
+            directory,
+            f"trace_{_context.run_id()}_p{_context.process_index()}.json",
+        )
+        return self.save(path)
+
 
 #: Process-wide default tracer; the module-level helpers below and every
 #: instrumented layer use this instance.
 TRACER = Tracer()
+
+
+def _abandon_buffer_after_fork() -> None:
+    # forked worker: the parent's pre-fork events belong in the PARENT's
+    # shard — replayed into every child shard they would appear once per
+    # rank in the merged timeline. Enabled state is inherited (a tracing
+    # parent wants tracing children); the monotonic/wall epoch pair stays
+    # valid across fork, so child timestamps still anchor correctly.
+    # No lock: the child is single-threaded at this instant.
+    TRACER._events = []
+    TRACER._named_threads = set()
+    TRACER.dropped = 0
+
+
+if hasattr(os, "register_at_fork"):  # pragma: no branch - posix
+    os.register_at_fork(after_in_child=_abandon_buffer_after_fork)
 
 
 def enable() -> None:
@@ -268,3 +322,8 @@ def to_chrome_trace() -> Dict[str, Any]:
 
 def save(path: str) -> str:
     return TRACER.save(path)
+
+
+def save_shard(directory: str) -> str:
+    """Write the default tracer's per-process shard into ``directory``."""
+    return TRACER.save_shard(directory)

@@ -1,0 +1,152 @@
+"""Dispatch-latency histograms and derived quantiles (p50/p95/p99).
+
+The serving direction (ROADMAP #1) needs per-dispatch latency quantiles
+before admission control can exist; the bench trajectory needs them so
+a latency regression is machine-checkable (``observability diff``).
+Two pre-registered histogram families:
+
+* ``tftpu_verb_latency_seconds{verb=...}`` — wall-clock of one verb
+  invocation (all blocks), observed by ``utils/profiling.record``/
+  ``span`` at the exact instrumentation points the five verbs already
+  hit. ``map_blocks.dispatch`` is the sharded async-dispatch span
+  (device-resident outputs return before the TPU finishes) — kept as
+  its own series for honesty, same as ``profiling.report``.
+* ``tftpu_dispatch_latency_seconds{entry=block|vmap}`` — wall-clock of
+  one executor dispatch (one block through one executable), observed in
+  ``ops/executor.CompiledProgram._run``. This is the per-request cost a
+  serving layer will quote.
+
+Buckets are latency-flavored (10µs … 30s) — finer at the bottom than
+``metrics.DEFAULT_BUCKETS`` because a warm CPU dispatch is tens of µs
+and p50 must resolve there. Quantiles are derived from bucket counts by
+:meth:`metrics.Histogram.quantile` (linear interpolation within the
+bucket — the standard Prometheus ``histogram_quantile`` estimate).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import REGISTRY, Histogram, histogram
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "VERBS",
+    "observe_verb",
+    "verb_histogram",
+    "dispatch_histogram",
+    "series_key",
+    "quantile_summary",
+    "summary_lines",
+]
+
+#: Latency-flavored bucket ladder: 10µs through 30s.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+#: The span names that count as verb dispatches (profiling hook filter).
+VERBS: Tuple[str, ...] = (
+    "map_blocks",
+    "map_blocks.dispatch",
+    "map_rows",
+    "reduce_rows",
+    "reduce_blocks",
+    "aggregate",
+)
+
+_VERB_HISTS: Dict[str, Histogram] = {
+    v: histogram(
+        "tftpu_verb_latency_seconds",
+        "Wall-clock of one verb invocation, by verb "
+        "(map_blocks.dispatch = sharded async dispatch only)",
+        labels={"verb": v},
+        buckets=LATENCY_BUCKETS,
+    )
+    for v in VERBS
+}
+
+_DISPATCH_HISTS: Dict[str, Histogram] = {
+    entry: histogram(
+        "tftpu_dispatch_latency_seconds",
+        "Wall-clock of one executor dispatch (one block through one "
+        "executable), by entry kind",
+        labels={"entry": entry},
+        buckets=LATENCY_BUCKETS,
+    )
+    for entry in ("block", "vmap")
+}
+
+
+def observe_verb(name: str, seconds: float) -> None:
+    """Record one verb invocation's wall-clock — called by
+    ``utils/profiling`` for every span/record whose name is a verb;
+    non-verb span names are ignored (one dict lookup)."""
+    h = _VERB_HISTS.get(name)
+    if h is not None:
+        h.observe(seconds)
+
+
+def verb_histogram(verb: str) -> Optional[Histogram]:
+    return _VERB_HISTS.get(verb)
+
+
+def dispatch_histogram(entry: str) -> Histogram:
+    return _DISPATCH_HISTS[entry]
+
+
+def series_key(labels: Dict[str, str]) -> str:
+    """Canonical ``family:label`` key for one latency series — e.g.
+    ``verb:map_blocks`` / ``dispatch:block``. The ONE naming used by
+    bench's ``# latency |`` rows, snapshot latency dicts, and therefore
+    the ``latency.<series>.<q>`` metric names ``diff`` compares; any
+    new latency family must flow through here or old and new artifacts
+    stop sharing metric names."""
+    fam = "verb" if "verb" in labels else "dispatch"
+    label = "/".join(v for _, v in sorted(labels.items())) or "-"
+    return f"{fam}:{label}"
+
+
+def quantile_summary(
+    registry=None, quantiles: Tuple[float, ...] = (0.5, 0.95, 0.99)
+) -> List[dict]:
+    """Per-series latency quantiles for every latency-family histogram
+    with observations: ``[{"name", "labels", "count", "mean", "p50",
+    ...}, ...]`` — the structured form bench snapshots embed and the
+    ``report`` CLI prints."""
+    reg = registry if registry is not None else REGISTRY
+    out = []
+    for m in reg.collect():
+        if not isinstance(m, Histogram):
+            continue
+        if not m.name.endswith("_latency_seconds"):
+            continue
+        if m.count == 0:
+            continue
+        row = {
+            "name": m.name,
+            "labels": dict(m.labels),
+            "count": m.count,
+            "mean": m.sum / m.count,
+        }
+        for q in quantiles:
+            row[f"p{int(q * 100)}"] = m.quantile(q)
+        out.append(row)
+    return sorted(
+        out, key=lambda r: (r["name"], sorted(r["labels"].items()))
+    )
+
+
+def summary_lines(registry=None) -> List[str]:
+    """Compact per-verb quantile lines — what bench.py prints as
+    ``# latency |`` rows next to ``# obs |`` / ``# mfu |``."""
+    lines = []
+    for row in quantile_summary(registry):
+        lines.append(
+            f"{series_key(row['labels'])} count={row['count']} "
+            f"p50={row['p50']:.6f}s p95={row['p95']:.6f}s "
+            f"p99={row['p99']:.6f}s mean={row['mean']:.6f}s"
+        )
+    return lines

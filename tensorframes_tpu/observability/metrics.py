@@ -40,6 +40,7 @@ __all__ = [
     "MetricsRegistry",
     "REGISTRY",
     "DEFAULT_BUCKETS",
+    "quantile_from_cumulative",
     "counter",
     "gauge",
     "histogram",
@@ -217,6 +218,23 @@ class Histogram(_Metric):
             out.append((float("inf"), self._count))
             return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the ``q``-quantile (0 < q < 1) from bucket counts by
+        linear interpolation within the target bucket — the same
+        estimate Prometheus's ``histogram_quantile`` makes. Values in
+        the +Inf bucket clamp to the largest finite bound (the honest
+        answer a bounded histogram can give). None when empty."""
+        if not (0.0 < q < 1.0):
+            raise ValueError(f"quantile q must be in (0, 1), got {q}")
+        cum = self.cumulative()
+        return quantile_from_cumulative(cum, cum[-1][1], q)
+
+    def quantiles(
+        self, qs: Sequence[float] = (0.5, 0.95, 0.99)
+    ) -> Dict[str, Optional[float]]:
+        """``{"p50": ..., "p95": ..., "p99": ...}`` via :meth:`quantile`."""
+        return {f"p{int(q * 100)}": self.quantile(q) for q in qs}
+
     def _zero(self) -> None:
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -247,6 +265,33 @@ class Histogram(_Metric):
             "sum": self.sum,
             "count": self.count,
         }
+
+
+def quantile_from_cumulative(
+    cum: Sequence[Tuple[float, int]], count: int, q: float
+) -> Optional[float]:
+    """The one bucket-interpolation quantile estimate: ``cum`` is
+    ``[(upper_bound, cumulative_count), ...]`` sorted ascending (a
+    trailing +Inf entry is allowed and ignored — overflow clamps to the
+    largest finite bound). Shared by :meth:`Histogram.quantile` and the
+    offline registry-JSONL reader (observability/snapshot.py), so the
+    live and exported estimates can never diverge."""
+    if count <= 0:
+        return None
+    finite = [(b, c) for b, c in cum if b != float("inf")]
+    if not finite:
+        return None
+    rank = q * count
+    prev_bound, prev_cum = 0.0, 0
+    for bound, c in finite:
+        if c >= rank:
+            in_bucket = c - prev_cum
+            if in_bucket <= 0:  # pragma: no cover - defensive
+                return bound
+            frac = (rank - prev_cum) / in_bucket
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, c
+    return finite[-1][0]
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -333,9 +378,15 @@ class MetricsRegistry:
         return sorted(out, key=lambda d: (d["name"], sorted(d["labels"].items())))
 
     def to_jsonl(self) -> str:
+        # rows carry the run/process identity (additive fields), so a
+        # fleet's per-process metrics files are joinable offline the
+        # same way trace shards are
+        from . import context as _context
+
         ts = time.time()
+        stamp = _context.snapshot()
         return "\n".join(
-            json.dumps({**d, "ts": round(ts, 3)}, sort_keys=True)
+            json.dumps({**d, **stamp, "ts": round(ts, 3)}, sort_keys=True)
             for d in self.snapshot()
         ) + ("\n" if self._metrics else "")
 

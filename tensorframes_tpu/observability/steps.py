@@ -15,8 +15,10 @@ Each call it:
   ``tftpu_train_step_seconds``, ``tftpu_train_loss``,
   ``tftpu_train_rows_per_sec``;
 * appends one JSON line to ``jsonl_path`` (when given) —
-  ``{"step", "ts", "step_seconds", "loss", "rows_per_sec"}`` — flushed
-  per line so a preempted run's log is complete up to the kill; and
+  ``{"step", "ts", "step_seconds", "loss", "rows_per_sec"}`` plus the
+  additive ``run_id``/``process_index`` context stamp (multi-process
+  step logs join on them) — flushed per line so a preempted run's log
+  is complete up to the kill; and
 * lands a ``train.step`` complete event on the trace timeline when
   tracing is enabled.
 
@@ -32,6 +34,7 @@ from typing import Any, IO, Optional
 
 import numpy as np
 
+from . import context as _context
 from . import events
 from .metrics import REGISTRY, counter, gauge, histogram
 
@@ -141,6 +144,9 @@ class StepTelemetry:
             )
         f = self._sink()
         if f is not None:
+            # run_id/process_index make multi-process step logs joinable
+            # (ISSUE 6 satellite) — ADDITIVE fields only: readers keyed
+            # on the original five keys keep working unchanged
             f.write(json.dumps({
                 "step": int(step),
                 "ts": round(time.time(), 6),
@@ -149,6 +155,7 @@ class StepTelemetry:
                 "rows_per_sec": (
                     round(rows_per_sec, 3) if rows_per_sec is not None else None
                 ),
+                **_context.snapshot(),
             }) + "\n")
             f.flush()
 

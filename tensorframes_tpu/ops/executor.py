@@ -31,6 +31,8 @@ import numpy as np
 from .. import dtypes as dt
 from ..config import get_config
 from ..observability import events as _events
+from ..observability import flight as _flight
+from ..observability import latency as _latency
 from ..observability.metrics import counter as _counter
 from ..observability.metrics import histogram as _histogram
 from ..program import Program
@@ -443,30 +445,62 @@ class CompiledProgram:
         return built[1]
 
     def _run(self, kind: str, feeds, to_numpy: bool, donate: bool):
-        fault_point(f"executor.run_{'block' if kind == 'block' else 'rows'}")
-        donate = donate and donation_supported()
-        aot_ok = _aot_eligible(feeds)
-        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-        key = self._feeds_key(kind, feeds)
-        # NOTE: the hoisted entry is keyed WITHOUT donate (one
-        # HoistedProgram serves both; donation is a call-time argument),
-        # while the hit/miss identity includes it (donate variants are
-        # separate executables)
-        akey = key + ("donate",) if donate else key
-        fresh = self._note_dispatch(key, donate)
-        call = None
-        if aot_ok:
-            call = self._aot.get(akey)
-            if call is None:
-                built = self._build_aot(kind, akey, feeds, donate)
-                if built is not None:
-                    call = built[0]
-        t0 = time.perf_counter()
-        if call is not None:
-            out = call(feeds)
-        else:
-            out = self._legacy_call(kind, key, feeds, donate)
-        dt = time.perf_counter() - t0
+        # flight-record identity of this dispatch BEFORE anything can
+        # fail (fault injection fires at the fault_point below): a crash
+        # postmortem must carry the dispatch that was in flight
+        def _shape_of(v):
+            s = getattr(v, "shape", None)
+            if s is not None:
+                return list(s)
+            try:
+                return [len(v)]  # ragged list feed: lead dim only
+            except TypeError:
+                return []
+
+        summary = {
+            "entry": kind,
+            "outputs": ",".join(self.program.fetch_order[:6]),
+            "shapes": {
+                k: _shape_of(v) for k, v in list(feeds.items())[:6]
+            },
+        }
+        try:
+            fault_point(
+                f"executor.run_{'block' if kind == 'block' else 'rows'}"
+            )
+            donate = donate and donation_supported()
+            aot_ok = _aot_eligible(feeds)
+            feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+            key = self._feeds_key(kind, feeds)
+            # NOTE: the hoisted entry is keyed WITHOUT donate (one
+            # HoistedProgram serves both; donation is a call-time
+            # argument), while the hit/miss identity includes it
+            # (donate variants are separate executables)
+            akey = key + ("donate",) if donate else key
+            fresh = self._note_dispatch(key, donate)
+            call = None
+            if aot_ok:
+                call = self._aot.get(akey)
+                if call is None:
+                    built = self._build_aot(kind, akey, feeds, donate)
+                    if built is not None:
+                        call = built[0]
+            t0 = time.perf_counter()
+            if call is not None:
+                out = call(feeds)
+            else:
+                out = self._legacy_call(kind, key, feeds, donate)
+            dt = time.perf_counter() - t0
+        except BaseException as e:
+            _flight.record(
+                "dispatch.error", error=type(e).__name__,
+                message=str(e), **summary,
+            )
+            raise
+        _latency.dispatch_histogram(kind).observe(dt)
+        _flight.record(
+            "dispatch", seconds=round(dt, 6), compiled=fresh, **summary
+        )
         if fresh:
             if call is not None:
                 _FIRST_RUN_SECONDS.observe(dt)

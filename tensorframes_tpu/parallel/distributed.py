@@ -18,6 +18,7 @@ from typing import Optional
 
 import jax
 
+from ..observability import context as obs_context
 from ..resilience.faults import fault_point
 from ..resilience.retry import RetryPolicy, retry_call
 from ..utils import get_logger
@@ -70,6 +71,13 @@ def init_distributed(
 
     retry_call(connect, policy=retry, describe="distributed.init")
     _initialized = True
+    # stamp this process's telemetry identity: every trace shard,
+    # metrics row, step-log line and flight record written after this
+    # point carries the rank, which is what makes the fleet's artifacts
+    # mergeable (observability/context.py)
+    obs_context.bind(
+        process_index=jax.process_index(), num_processes=num_processes
+    )
     logger.info(
         "init_distributed: process %d/%d via %s",
         process_id,

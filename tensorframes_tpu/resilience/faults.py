@@ -41,6 +41,7 @@ import threading
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Tuple, Union
 
+from ..observability import flight as _flight
 from ..observability.metrics import counter as _counter
 from ..utils import get_logger
 
@@ -150,6 +151,10 @@ def fault_point(site: str) -> None:
                 break
     if err is not None:
         _INJECTIONS_FIRED.inc()
+        _flight.record(
+            "fault.injected", site=site, error=type(err).__name__,
+            message=str(err),
+        )
         logger.debug("fault_point(%s): raising injected %r", site, err)
         raise err
 

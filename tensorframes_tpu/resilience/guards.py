@@ -22,6 +22,7 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from ..observability import flight as _flight
 from ..observability.metrics import counter as _counter
 from ..utils import get_logger
 
@@ -184,11 +185,21 @@ class StepGuard:
 
         self._bad_streak += 1
         _TRIP_COUNTERS[self.policy].inc()
+        _flight.record(
+            "guard.trip", policy=self.policy, step=step,
+            streak=self._bad_streak, max_consecutive=self.max_consecutive,
+        )
         if self.policy == "raise" or self._bad_streak >= self.max_consecutive:
-            raise NonFiniteError(
+            err = NonFiniteError(
                 f"non-finite loss/state at step {step} "
                 f"({self._bad_streak} consecutive; policy={self.policy!r})"
             )
+            # guard-raise is one of the flight recorder's dump triggers:
+            # the black box written here carries the dispatches/steps
+            # that led into divergence, even if the caller catches the
+            # error and the process never "crashes"
+            _flight.dump(reason="guard-raise", exc=err)
+            raise err
         if self.policy == "skip":
             self.skipped += 1
             logger.warning(

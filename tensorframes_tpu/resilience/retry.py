@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from functools import wraps
 from typing import Callable, Optional, Tuple, Type
 
+from ..observability import flight as _flight
 from ..observability.metrics import counter as _counter
 from ..utils import get_logger
 
@@ -165,6 +166,12 @@ def retry_call(
             delay = policy.delay(attempt, rng)
             _RETRY_ATTEMPTS.inc()
             _RETRY_BACKOFF_SECONDS.inc(delay)
+            _flight.record(
+                "retry", site=name, attempt=attempt,
+                max_attempts=policy.max_attempts,
+                error=type(e).__name__, message=str(e),
+                backoff_s=round(delay, 4),
+            )
             logger.warning(
                 "retry %s: attempt %d/%d failed (%s: %s); retrying in %.3fs",
                 name, attempt, policy.max_attempts, type(e).__name__, e, delay,
@@ -174,6 +181,11 @@ def retry_call(
             if delay > 0:
                 time.sleep(delay)
     _RETRY_EXHAUSTIONS.inc()
+    _flight.record(
+        "retry.exhausted", site=name, max_attempts=policy.max_attempts,
+        error=type(last).__name__ if last else None,
+        message=str(last) if last else None,
+    )
     raise RetryError(
         f"{name}: all {policy.max_attempts} attempts failed"
     ) from last

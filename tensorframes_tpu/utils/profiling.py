@@ -31,6 +31,7 @@ logger = get_logger(__name__)
 
 
 _events_mod = None
+_latency_mod = None
 
 
 def _trace_events():
@@ -45,6 +46,18 @@ def _trace_events():
 
         _events_mod = events
     return _events_mod
+
+
+def _latency(name: str, seconds: float) -> None:
+    """Feed verb-named spans into the latency-quantile histograms
+    (observability/latency.py) — same lazy-import shape as the tracer
+    hook; non-verb names are ignored there with one dict lookup."""
+    global _latency_mod
+    if _latency_mod is None:
+        from ..observability import latency
+
+        _latency_mod = latency
+    _latency_mod.observe_verb(name, seconds)
 
 
 @dataclasses.dataclass
@@ -87,6 +100,7 @@ def span(name: str, rows: int = 0) -> Iterator[None]:
             s.calls += 1
             s.seconds += dt
             s.rows += rows
+        _latency(name, dt)
         ev = _trace_events()
         if ev.TRACER.enabled:
             ev.TRACER.emit_complete(
@@ -137,6 +151,7 @@ def record(
         s.rows += rows
         s.flops += flops
         s.bytes += bytes_accessed
+    _latency(name, seconds)
     ev = _trace_events()
     if ev.TRACER.enabled:
         # callers record immediately after timing (the verbs do

@@ -1,0 +1,321 @@
+"""Flight-recorder tests: ring semantics, redaction, dump triggers
+(guard-raise, StaticAnalysisError, unhandled crash), and the kill -9
+black box — the spooled records a SIGKILLed process leaves behind must
+reconstruct what it was dispatching (the end-to-end acceptance of
+ISSUE 6's recorder: fault-injection/crash tests produce a recoverable
+black box, following the tests/test_crash_resume.py subprocess
+pattern)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.observability import flight
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Give each test an empty ring with no spool, restoring the
+    process recorder afterwards (the CI session may have armed
+    TFTPU_FLIGHT_DIR for the whole suite)."""
+    saved_dir = flight.RECORDER.spool_dir
+    saved_ring = flight.RECORDER.records()
+    flight.RECORDER.set_spool_dir(None)
+    flight.RECORDER.clear()
+    yield
+    flight.RECORDER.set_spool_dir(saved_dir)
+    flight.RECORDER.clear()
+    for rec in saved_ring:
+        flight.RECORDER._ring.append(rec)
+
+
+# ---------------------------------------------------------------------------
+# ring + redaction semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_is_bounded_and_ordered():
+    rec = flight.FlightRecorder(capacity=5)
+    for i in range(12):
+        rec.record("tick", i=i)
+    got = rec.records()
+    assert len(got) == 5
+    assert [r["i"] for r in got] == [7, 8, 9, 10, 11]  # oldest dropped
+    assert rec.total_records == 12
+    seqs = [r["seq"] for r in got]
+    assert seqs == sorted(seqs)
+
+
+def test_redaction_blanks_secrets_and_array_contents():
+    fields = flight.redact_fields({
+        "api_key": "sk-123456",
+        "auth_token": "abc",
+        "weights": np.arange(1000.0),
+        "note": "x" * 500,
+        "n": 7,
+        "frac": 0.5,
+        "bad": float("nan"),
+    })
+    assert fields["api_key"] == "[redacted]"
+    assert fields["auth_token"] == "[redacted]"
+    assert fields["weights"].startswith("<array shape=(1000,)")
+    assert "1.0" not in fields["weights"]  # never values
+    assert len(fields["note"]) < 250
+    assert fields["n"] == 7 and fields["frac"] == 0.5
+    assert fields["bad"] == "nan"  # strict-JSON-safe
+    json.dumps(fields)  # the whole record must serialize strictly
+
+
+def test_dispatches_are_recorded_with_shapes():
+    df = tfs.frame_from_arrays({"x": np.arange(16.0)}, num_blocks=2)
+    program = tfs.compile_program(lambda x: {"y": x + 1.0}, df)
+    tfs.map_blocks(program, df).collect()
+    dispatches = [
+        r for r in flight.RECORDER.records() if r["kind"] == "dispatch"
+    ]
+    assert len(dispatches) >= 2  # one per block
+    d = dispatches[-1]
+    assert d["entry"] == "block"
+    assert "y" in d["outputs"]
+    assert d["shapes"]["x"] == [8]
+    assert d["seconds"] >= 0
+
+
+def test_failing_dispatch_recorded_before_error_propagates():
+    from tensorframes_tpu.resilience import faults
+
+    df = tfs.frame_from_arrays({"x": np.arange(8.0)}, num_blocks=1)
+    program = tfs.compile_program(lambda x: {"y": x * 2.0}, df)
+    with faults.inject("executor.run_block", RuntimeError("chip fell off")):
+        with pytest.raises(RuntimeError):
+            tfs.map_blocks(program, df).collect()
+    kinds = [r["kind"] for r in flight.RECORDER.records()]
+    assert "fault.injected" in kinds
+    errs = [
+        r for r in flight.RECORDER.records() if r["kind"] == "dispatch.error"
+    ]
+    assert errs and errs[-1]["error"] == "RuntimeError"
+    assert "chip fell off" in errs[-1]["message"]
+    assert errs[-1]["shapes"]["x"] == [8]
+
+
+def test_retry_and_guard_records():
+    from tensorframes_tpu.resilience import (
+        RetryError, RetryPolicy, StepGuard, retry_call,
+    )
+
+    def flaky():
+        raise OSError("wobble")
+
+    with pytest.raises(RetryError):
+        retry_call(flaky, policy=RetryPolicy(max_attempts=2, backoff=0.0,
+                                             seed=0))
+    kinds = [r["kind"] for r in flight.RECORDER.records()]
+    assert "retry" in kinds and "retry.exhausted" in kinds
+
+    g = StepGuard(policy="skip", check="metrics")
+    g.admit(1, {"w": 1.0}, {"loss": float("nan")}, prev_state={"w": 0.0})
+    trips = [
+        r for r in flight.RECORDER.records() if r["kind"] == "guard.trip"
+    ]
+    assert trips and trips[-1]["policy"] == "skip"
+
+
+# ---------------------------------------------------------------------------
+# dump triggers
+# ---------------------------------------------------------------------------
+
+def test_manual_dump_writes_header_then_ring(tmp_path):
+    flight.record("tick", i=1)
+    flight.record("tick", i=2)
+    path = str(tmp_path / "pm.jsonl")
+    out = flight.dump(path, reason="test", exc=ValueError("boom"))
+    assert out == path
+    rows = [json.loads(ln) for ln in open(path)]
+    assert rows[0]["kind"] == "postmortem"
+    assert rows[0]["reason"] == "test"
+    assert rows[0]["error"] == "ValueError"
+    assert "run_id" in rows[0] and "process_index" in rows[0]
+    assert [r["i"] for r in rows[1:] if r["kind"] == "tick"] == [1, 2]
+
+
+def test_dump_without_spool_dir_is_a_noop():
+    flight.record("tick")
+    assert flight.dump(reason="nowhere-to-write") is None
+
+
+def test_repeated_dumps_never_overwrite(tmp_path):
+    """A guard-raise black box must survive a later crash dump: the
+    per-process dump counter keeps default-path filenames unique."""
+    flight.set_spool_dir(str(tmp_path))
+    flight.record("tick", i=1)
+    p1 = flight.dump(reason="guard-raise")
+    flight.record("tick", i=2)
+    p2 = flight.dump(reason="crash")
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+    first = [json.loads(ln) for ln in open(p1)]
+    assert first[0]["reason"] == "guard-raise"
+    assert [r.get("i") for r in first[1:]] == [1]
+
+
+def test_guard_raise_dumps_postmortem(tmp_path):
+    from tensorframes_tpu.resilience import NonFiniteError, StepGuard
+
+    flight.set_spool_dir(str(tmp_path))
+    g = StepGuard(policy="raise", check="metrics")
+    with pytest.raises(NonFiniteError):
+        g.admit(3, {"w": 1.0}, {"loss": float("inf")},
+                prev_state={"w": 0.0})
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("postmortem_")]
+    assert len(dumps) == 1
+    rows = [json.loads(ln) for ln in open(tmp_path / dumps[0])]
+    assert rows[0]["reason"] == "guard-raise"
+    assert rows[0]["error"] == "NonFiniteError"
+    assert any(r["kind"] == "guard.trip" for r in rows[1:])
+
+
+def test_static_analysis_error_dumps_postmortem(tmp_path):
+    from tensorframes_tpu.analysis.diagnostics import (
+        Diagnostic, DiagnosticReport,
+    )
+    from tensorframes_tpu.validation import StaticAnalysisError
+
+    flight.set_spool_dir(str(tmp_path))
+    report = DiagnosticReport(
+        [Diagnostic("TFG104", "error", "donated input aliased")],
+        subject="prog",
+    )
+    with pytest.raises(StaticAnalysisError):
+        report.raise_on_errors()
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("postmortem_")]
+    assert len(dumps) == 1
+    rows = [json.loads(ln) for ln in open(tmp_path / dumps[0])]
+    assert rows[0]["reason"] == "static-analysis"
+    sa = [r for r in rows[1:] if r["kind"] == "static_analysis.error"]
+    assert sa and sa[0]["codes"] == "TFG104"
+
+
+# ---------------------------------------------------------------------------
+# crash black box (subprocess)
+# ---------------------------------------------------------------------------
+
+_CRASHER = """
+import os, sys, time
+import numpy as np
+import tensorframes_tpu as tfs
+from tensorframes_tpu.resilience import faults
+
+mode = sys.argv[1]  # "uncaught" | "spin"
+df = tfs.frame_from_arrays({"x": np.arange(16.0)}, num_blocks=2)
+program = tfs.compile_program(lambda x: {"y": x * 3.0}, df)
+tfs.map_blocks(program, df).collect()   # healthy dispatches first
+print("READY", flush=True)
+if mode == "uncaught":
+    # a fault-injected dispatch failure that nobody catches: the
+    # excepthook must leave a postmortem naming the failing dispatch
+    with faults.inject("executor.run_block", RuntimeError("injected loss")):
+        tfs.map_blocks(program, df).collect()
+else:
+    while True:  # spin dispatching until SIGKILL lands
+        tfs.map_blocks(program, df).collect()
+        time.sleep(0.01)
+"""
+
+
+def _spawn_crasher(flight_dir: str, mode: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["TFTPU_FLIGHT_DIR"] = flight_dir
+    env["TFTPU_RUN_ID"] = "flighttest"
+    return subprocess.Popen(
+        [sys.executable, "-c", _CRASHER, mode],
+        env=env, cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def test_uncaught_fault_injection_leaves_postmortem_with_dispatch(tmp_path):
+    """ISSUE 6 acceptance: a fault-injected crash leaves a flight
+    recorder dump containing the failing dispatch."""
+    fdir = str(tmp_path / "flight")
+    proc = _spawn_crasher(fdir, "uncaught")
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode != 0, f"crasher should have died\n{out}\n{err}"
+    assert "READY" in out
+    dumps = [f for f in os.listdir(fdir) if f.startswith("postmortem_")]
+    assert len(dumps) == 1, (os.listdir(fdir), err)
+    rows = [json.loads(ln) for ln in open(os.path.join(fdir, dumps[0]))]
+    assert rows[0]["reason"] == "crash"
+    assert rows[0]["run_id"] == "flighttest"
+    assert rows[0]["error"] == "RuntimeError"
+    kinds = [r["kind"] for r in rows[1:]]
+    assert "dispatch" in kinds            # the healthy history
+    assert "fault.injected" in kinds
+    errs = [r for r in rows[1:] if r["kind"] == "dispatch.error"]
+    assert errs, "the failing dispatch must be in the black box"
+    assert "injected loss" in errs[-1]["message"]
+
+
+def test_kill9_leaves_recoverable_blackbox(tmp_path):
+    """No Python runs at SIGKILL — the line-flushed spool must still
+    hold the recent dispatches, and read_blackbox must tolerate a torn
+    final line."""
+    fdir = str(tmp_path / "flight")
+    proc = _spawn_crasher(fdir, "spin")
+    try:
+        deadline = time.time() + 180
+        spooled = []
+        while time.time() < deadline:
+            if os.path.isdir(fdir):
+                spooled = [
+                    f for f in os.listdir(fdir) if f.startswith("flight_")
+                ]
+                if spooled and any(
+                    os.path.getsize(os.path.join(fdir, f)) > 500
+                    for f in spooled
+                ):
+                    break
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                raise AssertionError(
+                    f"crasher exited early (rc={proc.returncode})\n"
+                    f"stdout: {out}\nstderr: {err}"
+                )
+            time.sleep(0.02)
+        assert spooled, "spool never materialized"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:  # pragma: no cover - cleanup on test bugs
+            proc.kill()
+    records = flight.read_blackbox(fdir)
+    assert records, "black box came back empty"
+    dispatches = [r for r in records if r["kind"] == "dispatch"]
+    assert dispatches
+    assert dispatches[-1]["entry"] == "block"
+    assert dispatches[-1]["shapes"]["x"] == [8]
+    # seq ordering survives reassembly
+    seqs = [r["seq"] for r in records]
+    assert seqs == sorted(seqs)
+
+
+def test_spool_rotation_bounds_disk(tmp_path):
+    rec = flight.FlightRecorder(capacity=10, spool_dir=str(tmp_path))
+    for i in range(55):
+        rec.record("tick", i=i)
+    files = [f for f in os.listdir(tmp_path) if f.startswith("flight_")]
+    assert len(files) == 2  # live segment + one rotated ".1"
+    total_lines = sum(
+        len(open(tmp_path / f).read().splitlines()) for f in files
+    )
+    assert total_lines <= 20  # 2 * capacity

@@ -5,11 +5,14 @@ valid trace, an exposition carrying executor + retry/guard counters,
 and a JSONL step log with per-step loss and rows/s."""
 
 import json
+import os
 import threading
 import urllib.request
 
 import numpy as np
 import pytest
+
+_REPO_DIR = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 import tensorframes_tpu as tfs
 from tensorframes_tpu.observability import (
@@ -426,6 +429,210 @@ def test_checkpoint_metrics_and_crc_failures(tmp_path):
     step, _ = ck.restore_latest(like=state)
     assert step == 1
     assert _snap()[("tftpu_checkpoint_crc_failures_total", ())]["value"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# latency quantiles + snapshot/diff (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_interpolates():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_q", buckets=(0.1, 0.2, 0.4, 1.0))
+    assert h.quantile(0.5) is None  # empty
+    for v in (0.05,) * 50 + (0.15,) * 30 + (0.3,) * 15 + (0.8,) * 5:
+        h.observe(v)
+    # 100 observations: p50 sits inside the first bucket (50 of 100)
+    assert h.quantile(0.5) == pytest.approx(0.1)
+    # p80 at the 0.2 bound (cum 80), p95 at 0.4 (cum 95)
+    assert h.quantile(0.8) == pytest.approx(0.2)
+    assert h.quantile(0.95) == pytest.approx(0.4)
+    # interpolation inside a bucket: rank 90 is 2/3 through (0.2, 0.4]
+    assert h.quantile(0.9) == pytest.approx(0.2 + 0.2 * (10 / 15))
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    # +Inf overflow clamps to the largest finite bound
+    h2 = reg.histogram("t_lat_q2", buckets=(0.1,))
+    h2.observe(5.0)
+    assert h2.quantile(0.99) == 0.1
+    qs = h.quantiles()
+    assert set(qs) == {"p50", "p95", "p99"}
+
+
+def test_verb_and_dispatch_latency_histograms_populate():
+    from tensorframes_tpu.observability import latency
+
+    df = tfs.frame_from_arrays({"x": np.arange(32.0)}, num_blocks=2)
+    program = tfs.compile_program(lambda x: {"y": x + 1.0}, df)
+    tfs.map_blocks(program, df).collect()
+    vh = latency.verb_histogram("map_blocks")
+    assert vh is not None and vh.count >= 1
+    dh = latency.dispatch_histogram("block")
+    assert dh.count >= 2  # one per block
+    rows = latency.quantile_summary()
+    series = {
+        (r["name"], tuple(sorted(r["labels"].items()))) for r in rows
+    }
+    assert ("tftpu_dispatch_latency_seconds",
+            (("entry", "block"),)) in series
+    for r in rows:
+        assert r["p50"] is not None and r["p99"] >= r["p50"]
+    lines = latency.summary_lines()
+    assert any(ln.startswith("verb:map_blocks ") for ln in lines)
+    assert any(ln.startswith("dispatch:block ") for ln in lines)
+
+
+def test_trace_events_dropped_counter():
+    t = events.Tracer(max_events=2)
+    t.enable()
+    before = _snap()[("tftpu_trace_events_dropped_total", ())]["value"]
+    for i in range(6):
+        t.instant(f"e{i}")
+    after = _snap()[("tftpu_trace_events_dropped_total", ())]["value"]
+    assert after - before == t.dropped > 0
+    assert t.to_chrome_trace()["otherData"]["dropped_events"] == t.dropped
+
+
+def test_jsonl_rows_carry_run_context():
+    from tensorframes_tpu.observability import context
+
+    reg = MetricsRegistry()
+    reg.counter("t_stamped_total").inc()
+    row = json.loads(reg.to_jsonl().splitlines()[0])
+    assert row["run_id"] == context.run_id()
+    assert row["process_index"] == context.process_index()
+
+
+def test_step_log_lines_carry_run_context(tmp_path):
+    from tensorframes_tpu.observability import context
+
+    path = tmp_path / "steps.jsonl"
+    with StepTelemetry(jsonl_path=str(path), rows_per_step=8) as t:
+        t(1, {"loss": 0.5})
+        t(2, {"loss": 0.25})
+    rows = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [r["step"] for r in rows] == [1, 2]
+    for r in rows:
+        # additive fields: the original five keys stay intact
+        assert {"step", "ts", "step_seconds", "loss",
+                "rows_per_sec"} <= set(r)
+        assert r["run_id"] == context.run_id()
+        assert r["process_index"] == context.process_index()
+
+
+def _write_snap(path, metrics, latency=None):
+    from tensorframes_tpu.observability import snapshot
+
+    obj = {"schema": snapshot.SCHEMA, "metrics": metrics,
+           "latency": latency or {}}
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+def test_diff_identical_snapshots_is_clean(tmp_path):
+    from tensorframes_tpu.observability import cli
+
+    m = {"add3_rows_per_sec": 1e6, "chain3_wall_s": 0.02}
+    a = _write_snap(tmp_path / "a.json", m)
+    b = _write_snap(tmp_path / "b.json", dict(m))
+    assert cli.main(["diff", a, b]) == 0
+
+
+def test_diff_flags_2x_latency_regression(tmp_path):
+    from tensorframes_tpu.observability import cli, snapshot
+
+    a = _write_snap(tmp_path / "a.json", {"chain3_wall_s": 0.02},
+                    {"verb:map_blocks": {"count": 5, "mean": 0.01,
+                                         "p50": 0.01, "p95": 0.02,
+                                         "p99": 0.03}})
+    b = _write_snap(tmp_path / "b.json", {"chain3_wall_s": 0.04},
+                    {"verb:map_blocks": {"count": 5, "mean": 0.02,
+                                         "p50": 0.02, "p95": 0.04,
+                                         "p99": 0.06}})
+    assert cli.main(["diff", a, b]) == 1
+    assert cli.main(["diff", a, b, "--warn-only"]) == 0
+    # direction-aware: the reverse diff is an improvement, not a gate
+    assert cli.main(["diff", b, a]) == 0
+    # machinery check: the latency series flattened and compared
+    old, _ = snapshot.load_metrics(a)
+    new, _ = snapshot.load_metrics(b)
+    res = snapshot.diff_metrics(old, new)
+    names = {r["metric"] for r in res["regressions"]}
+    assert "chain3_wall_s" in names
+    assert "latency.verb:map_blocks.p99" in names
+    assert not any(".count" in n for n in names)  # counts never gate
+
+
+def test_diff_no_common_metrics_fails_except_warn_only(tmp_path):
+    from tensorframes_tpu.observability import cli
+
+    a = _write_snap(tmp_path / "a.json", {"left_only_wall_s": 1.0})
+    b = _write_snap(tmp_path / "b.json", {"right_only_wall_s": 1.0})
+    # zero overlap is a usage error (broken bench run / name drift) …
+    assert cli.main(["diff", a, b]) == 2
+    # … but the warn-only contract is "never block the build"
+    assert cli.main(["diff", a, b, "--warn-only"]) == 0
+
+
+def test_diff_throughput_drop_and_per_metric_threshold(tmp_path):
+    from tensorframes_tpu.observability import cli
+
+    a = _write_snap(tmp_path / "a.json", {"x_rows_per_sec": 1000.0})
+    b = _write_snap(tmp_path / "b.json", {"x_rows_per_sec": 800.0})
+    # -20% is inside the default ±50% band …
+    assert cli.main(["diff", a, b]) == 0
+    # … but trips a tightened per-metric threshold
+    assert cli.main(["diff", a, b, "--metric", "x_rows_per_sec=0.1"]) == 1
+
+
+def test_diff_reads_committed_bench_round_and_bench_stdout(tmp_path):
+    from tensorframes_tpu.observability import cli, snapshot
+
+    round_path = os.path.join(_REPO_DIR, "BENCH_r05.json")
+    metrics, meta = snapshot.load_metrics(round_path)
+    assert meta["source"] == "bench-round"
+    assert metrics["gpt_tiny_decode_tokens_per_sec"] == 31166.0
+    # a fresh "bench stdout" with one metric at a third (a -67% drop,
+    # well past the default ±50% band): the diff sees it
+    text = "\n".join(
+        f"# {k}={v if k != 'gpt_tiny_decode_tokens_per_sec' else v / 3}"
+        for k, v in metrics.items() if not k.startswith("headline.")
+    )
+    out = tmp_path / "bench_out.txt"
+    out.write_text(text + "\n")
+    assert cli.main(["diff", round_path, str(out)]) == 1
+    assert cli.main(["diff", round_path, str(out), "--warn-only"]) == 0
+
+
+def test_parse_bench_latency_lines():
+    from tensorframes_tpu.observability import snapshot
+
+    text = (
+        "# add3_rows_per_sec=123456\n"
+        "# latency | verb:map_blocks count=12 p50=0.000120s "
+        "p95=0.000500s p99=0.000900s mean=0.000200s\n"
+        '{"metric": "headline", "value": 75.5}\n'
+    )
+    m = snapshot.parse_bench_text(text)
+    assert m["add3_rows_per_sec"] == 123456.0
+    assert m["latency.verb:map_blocks.p50"] == pytest.approx(0.00012)
+    assert m["latency.verb:map_blocks.count"] == 12
+    assert m["headline.value"] == 75.5
+
+
+def test_report_cli_on_metrics_jsonl(tmp_path, capsys):
+    from tensorframes_tpu.observability import cli
+
+    reg = MetricsRegistry()
+    reg.counter("t_report_total").inc(3)
+    h = reg.histogram("t_report_latency_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    path = tmp_path / "metrics.jsonl"
+    reg.write_jsonl(str(path))
+    assert cli.main(["report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "t_report_total" in out
+    assert "t_report_latency_seconds.p50" in out
 
 
 # ---------------------------------------------------------------------------

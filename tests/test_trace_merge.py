@@ -1,0 +1,208 @@
+"""Distributed trace correlation: N subprocess "processes" of one run
+each write a per-process shard (``events.save_shard``), and
+``observability merge`` must reassemble one JSON-valid Chrome trace
+with a distinct track per process (ISSUE 6 tentpole acceptance;
+subprocess pattern follows tests/test_crash_resume.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tensorframes_tpu.observability import cli, context, events, merge
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# one "process" of the run: real verb dispatches land executor spans on
+# the timeline, then the shard is written into the shared directory
+_WORKER = """
+import os, sys
+shard_dir = sys.argv[1]
+import numpy as np
+import tensorframes_tpu as tfs
+from tensorframes_tpu.observability import events
+events.enable()
+df = tfs.frame_from_arrays({"x": np.arange(64.0)}, num_blocks=2)
+program = tfs.compile_program(lambda x: {"y": x * 2.0 + 1.0}, df)
+tfs.map_blocks(program, df).collect()
+events.instant("worker.done",
+               rank=int(os.environ["TFTPU_PROCESS_INDEX"]))
+path = events.save_shard(shard_dir)
+print("SHARD", path, flush=True)
+"""
+
+
+def _run_fleet(shard_dir: str, n: int, run_id: str = "mergetest"):
+    """Spawn n concurrent worker processes sharing one run id."""
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["TFTPU_RUN_ID"] = run_id
+        env["TFTPU_PROCESS_INDEX"] = str(i)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER, shard_dir],
+            env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, (
+            f"worker {i} failed (rc={p.returncode})\n"
+            f"stdout: {out}\nstderr: {err}"
+        )
+        assert "SHARD" in out
+
+
+def _check_merged(merged: dict, n: int, run_id: str = "mergetest"):
+    # strict-JSON valid (what Perfetto/chrome://tracing require)
+    merged = json.loads(json.dumps(merged))
+    evs = merged["traceEvents"]
+    # every process contributed a track, pids are the ranks
+    pids = {e["pid"] for e in evs}
+    assert pids == set(range(n))
+    # per-process tracks are labeled and ordered
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in evs if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert set(names) == set(range(n))
+    for rank, label in names.items():
+        assert label.startswith(f"process {rank}")
+    # the real dispatch spans came through on every track
+    for rank in range(n):
+        rank_names = {e["name"] for e in evs if e["pid"] == rank}
+        assert "executor.run_block" in rank_names
+        assert "worker.done" in rank_names
+    # timestamps were re-anchored: all non-metadata events non-negative
+    assert all(e["ts"] >= 0 for e in evs if "ts" in e)
+    other = merged["otherData"]
+    assert other["run_id"] == run_id
+    assert other["num_shards"] == n
+    assert len(other["processes"]) == n
+
+
+def test_two_process_run_merges_into_one_timeline(tmp_path):
+    """ISSUE 6 satellite: subprocess-spawned 2-process run → shards →
+    ``merge`` → JSON-valid Chrome trace keeping both process tracks."""
+    shard_dir = str(tmp_path / "shards")
+    _run_fleet(shard_dir, 2)
+    shards = merge.find_shards(shard_dir, run_id="mergetest")
+    assert len(shards) == 2
+    assert [os.path.basename(p) for p in shards] == [
+        "trace_mergetest_p0.json", "trace_mergetest_p1.json",
+    ]
+    # per-shard context stamps are intact
+    for i, p in enumerate(shards):
+        other = json.load(open(p))["otherData"]
+        assert other["run_id"] == "mergetest"
+        assert other["process_index"] == i
+        assert other["trace_epoch_unix_us"] > 0
+
+    # the CLI face: merge via the subcommand, validating the written file
+    out_path = str(tmp_path / "merged.json")
+    rc = cli.main(["merge", "--dir", shard_dir, "--run-id", "mergetest",
+                   "-o", out_path])
+    assert rc == 0
+    _check_merged(json.load(open(out_path)), 2)
+
+
+@pytest.mark.slow
+def test_eight_process_dryrun_merges(tmp_path):
+    """The 8-process acceptance dryrun (JAX_PLATFORMS=cpu forked):
+    8 shards merge into one timeline with 8 distinct tracks."""
+    shard_dir = str(tmp_path / "shards")
+    _run_fleet(shard_dir, 8)
+    shards = merge.find_shards(shard_dir, run_id="mergetest")
+    assert len(shards) == 8
+    _check_merged(merge.merge_traces(shards), 8)
+
+
+# ---------------------------------------------------------------------------
+# merge semantics (no subprocesses: shards built in-memory)
+# ---------------------------------------------------------------------------
+
+def _fake_shard(tmp_path, run_id, rank, epoch_us, name="ev"):
+    shard = {
+        "traceEvents": [
+            {"ph": "X", "name": name, "cat": "t", "ts": 10.0, "dur": 5.0,
+             "pid": 9999 + rank, "tid": 1},
+        ],
+        "otherData": {
+            "run_id": run_id, "process_index": rank, "pid": 9999 + rank,
+            "trace_epoch_unix_us": epoch_us, "dropped_events": rank,
+        },
+    }
+    path = tmp_path / f"trace_{run_id}_p{rank}.json"
+    path.write_text(json.dumps(shard))
+    return str(path)
+
+
+def test_merge_realigns_clocks_and_sums_drops(tmp_path):
+    a = _fake_shard(tmp_path, "r1", 0, epoch_us=1_000_000)
+    b = _fake_shard(tmp_path, "r1", 1, epoch_us=1_250_000)
+    merged = merge.merge_traces([a, b])
+    xs = {e["pid"]: e for e in merged["traceEvents"] if e["ph"] == "X"}
+    # shard 1 started 0.25s later: its events shift by +250000µs
+    assert xs[0]["ts"] == 10.0
+    assert xs[1]["ts"] == 250_010.0
+    assert merged["otherData"]["dropped_events"] == 1  # 0 + 1
+
+
+def test_merge_refuses_mixed_runs_unless_forced(tmp_path):
+    a = _fake_shard(tmp_path, "runA", 0, 1_000_000)
+    b = _fake_shard(tmp_path, "runB", 1, 1_000_000)
+    with pytest.raises(ValueError, match="different runs"):
+        merge.merge_traces([a, b])
+    merged = merge.merge_traces([a, b], force=True)
+    assert merged["otherData"]["run_id"] == ["runA", "runB"]
+
+
+def test_merge_refuses_duplicate_ranks_unless_forced(tmp_path):
+    a = _fake_shard(tmp_path, "r1", 0, 1_000_000)
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    b = _fake_shard(sub, "r1", 0, 2_000_000)
+    with pytest.raises(ValueError, match="duplicate process_index"):
+        merge.merge_traces([a, b])
+    merge.merge_traces([a, b], force=True)  # forced keeps both
+
+
+def test_context_env_binding(monkeypatch):
+    monkeypatch.setenv("TFTPU_PROCESS_INDEX", "5")
+    saved = (context._run_id, context._process_index, context._num_processes)
+    context._reset_for_tests()
+    try:
+        assert context.process_index() == 5
+        context.bind(process_index=2, num_processes=4)
+        assert context.process_index() == 2  # explicit bind beats env
+        assert context.num_processes() == 4
+        env = context.child_env(3)
+        assert env["TFTPU_PROCESS_INDEX"] == "3"
+        assert env["TFTPU_RUN_ID"] == context.run_id()
+    finally:
+        context._reset_for_tests()
+        context.bind(run_id=saved[0], process_index=saved[1],
+                     num_processes=saved[2])
+
+
+def test_shard_metadata_rides_save(tmp_path):
+    was_enabled = events.TRACER.enabled
+    events.enable()
+    try:
+        with events.span("meta-probe"):
+            pass
+        path = events.save_shard(str(tmp_path))
+        other = json.load(open(path))["otherData"]
+        assert other["run_id"] == context.run_id()
+        assert other["process_index"] == context.process_index()
+        assert other["trace_epoch_unix_us"] > 0
+        assert os.path.basename(path) == (
+            f"trace_{context.run_id()}_p{context.process_index()}.json"
+        )
+    finally:
+        if not was_enabled:
+            events.disable()
