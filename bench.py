@@ -185,6 +185,113 @@ def _bench_chain3(n_rows: int = 1_000_000, iters: int = 8,
     return fused_s, unfused_s
 
 
+def _bench_chain3_join(n_rows: int = 1_000_000, iters: int = 6,
+                       num_blocks: int = 4, n_groups: int = 512):
+    """3-stage map→join→aggregate pipeline (ISSUE 7): the probe-side
+    map chain fuses into the probe dispatch, build-side pushdown prunes
+    dead columns through the join on BOTH sides, and the aggregate's
+    segment-reduce epilogue runs inside the same plan force — the
+    mapped/joined intermediates the per-stage replay materializes never
+    exist. TFTPU_FUSION=0 re-runs the identical pipeline per-stage.
+    Data is chosen so every group sum is exactly representable in f32:
+    fused and unfused outputs must be BIT-IDENTICAL (asserted here).
+    Returns (fused_wall_s, unfused_wall_s, steady_state_compiles)."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.config import get_config
+    from tensorframes_tpu.ops.executor import _JIT_MISSES
+
+    rng = np.random.default_rng(0)
+    frame = tfs.frame_from_arrays(
+        {
+            "k": rng.integers(0, n_groups, n_rows).astype(np.int32),
+            "x": (np.arange(n_rows) % 16).astype(np.float32),
+            # dead probe-side columns — incl. an embedding-style wide
+            # one: pushdown must keep them out of the map dispatches
+            # and the join's match expansion entirely (the Flare
+            # motivation: real pipelines carry far more columns than a
+            # query touches)
+            "a": np.arange(n_rows, dtype=np.float32),
+            "b": np.ones(n_rows, np.float32),
+            "e": np.ones((n_rows, 8), np.float32),
+        },
+        num_blocks=num_blocks,
+    )
+    dim = tfs.frame_from_arrays(
+        {
+            "k": np.arange(n_groups, dtype=np.int32),
+            "w": np.arange(n_groups, dtype=np.float32),
+            "tag": np.ones(n_groups, np.float32),  # dead build column
+        },
+        num_blocks=1,
+    )
+    p1 = tfs.compile_program(lambda x: {"y": x * 2.0 + 1.0}, frame)
+    p2 = tfs.compile_program(
+        lambda y: {"z": y * y}, tfs.map_blocks(p1, frame)
+    )
+    # the aggregate program compiles ONCE against the join schema (the
+    # steady-state serving shape, like chain3's pre-compiled stages)
+    j0 = tfs.map_blocks(p2, tfs.map_blocks(p1, frame)).join(dim, on="k")
+    j0.blocks()
+    with tfs.with_graph():
+        z_in = tfs.block(j0, "z", tf_name="z_input")
+        w_in = tfs.block(j0, "w", tf_name="w_input")
+        fz = tfs.reduce_sum(z_in, axis=0, name="z")
+        fw = tfs.reduce_sum(w_in, axis=0, name="w")
+        agg_program = tfs.compile_program(
+            [fz, fw], j0, reduce_mode="blocks"
+        )
+
+    def run_once():
+        f2 = tfs.map_blocks(p2, tfs.map_blocks(p1, frame))
+        out = tfs.aggregate(
+            agg_program, f2.join(dim, on="k").group_by("k")
+        )
+        return out.blocks()
+
+    def wall(iters_):
+        run_once()  # warm the jit caches out of the timed region
+        t0 = time.perf_counter()
+        for _ in range(iters_):
+            run_once()
+        return (time.perf_counter() - t0) / iters_
+
+    was = get_config().plan_fusion
+    try:
+        tfs.configure(plan_fusion=True)
+        run_once()  # warm
+        m0 = _JIT_MISSES.value
+        fused_s = wall(iters)
+        steady_compiles = int(_JIT_MISSES.value - m0)
+        fused_rows = run_once()
+        tfs.configure(plan_fusion=False)
+        unfused_s = wall(iters)
+        unfused_rows = run_once()
+    finally:
+        tfs.configure(plan_fusion=was)
+    if len(fused_rows) != len(unfused_rows):
+        raise AssertionError(
+            f"chain3_join: fused produced {len(fused_rows)} block(s), "
+            f"unfused {len(unfused_rows)} — the bit-identical contract "
+            "is broken"
+        )
+    for fb, ub in zip(fused_rows, unfused_rows):
+        if set(fb) != set(ub):
+            raise AssertionError(
+                f"chain3_join: fused columns {sorted(fb)} != unfused "
+                f"{sorted(ub)} — the bit-identical contract is broken"
+            )
+        for name in fb:
+            if not np.array_equal(
+                np.asarray(fb[name]), np.asarray(ub[name])
+            ):
+                raise AssertionError(
+                    f"chain3_join: fused and unfused outputs differ in "
+                    f"column {name!r} — the bit-identical contract is "
+                    "broken"
+                )
+    return fused_s, unfused_s, steady_compiles
+
+
 def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0,
                      int8: bool = False, sweep: Sequence[int] = (),
                      side: int = 299, compute_dtype: str = "bfloat16",
@@ -547,13 +654,49 @@ def _bench_aggregate_device(n_rows: int = 1_000_000, n_groups: int = 512):
 
 
 def _bench_aggregate_strings(n_rows: int = 1_000_000, n_groups: int = 512):
-    """Keyed aggregate with STRING keys: one host dictionary pass over
-    the key column (ops/keys.py), values reduce through the same segment
-    fast path — the config Catalyst always paid a shuffle for."""
+    """Keyed aggregate with STRING keys: the host dictionary pass over
+    the key column (ops/keys.py) now caches its encode ON THE FRAME
+    (frame_group_ids), so steady-state repeated aggregates skip the 1M-
+    object hash pass that made string keys 6-10x slower than numeric.
+    The headline metric is the steady-state (dictionary-cached) wall;
+    the ``# plan |`` line records the before/after — ``re-encode`` is
+    the pre-cache behavior, measured by dropping the cache each call."""
+    import tensorframes_tpu as tfs
+
     rng = np.random.default_rng(0)
     ids = rng.integers(0, n_groups, n_rows)
     labels = np.array([f"key{i:04d}" for i in range(n_groups)], object)[ids]
-    return _bench_aggregate_keyed(labels, n_rows)
+    frame = tfs.frame_from_arrays(
+        {"k": labels, "v": rng.standard_normal(n_rows).astype(np.float32)},
+        num_blocks=1,
+    )
+    with tfs.with_graph():
+        v_input = tfs.block(frame, "v", tf_name="v_input")
+        fetch = tfs.reduce_sum(v_input, axis=0, name="v")
+        program = tfs.compile_program(fetch, frame, reduce_mode="blocks")
+
+    def run_once():
+        tfs.aggregate(program, frame.group_by("k")).blocks()
+
+    def timed():
+        t0 = time.perf_counter()
+        run_once()
+        return time.perf_counter() - t0
+
+    run_once()  # warmup/compile (also populates the key dictionary)
+    warm_s = float(np.median([timed() for _ in range(3)]))
+    cold_times = []
+    for _ in range(3):
+        frame._group_ids_cache = {}  # the pre-cache per-call encode
+        cold_times.append(timed())
+    cold_s = float(np.median(cold_times))
+    print(
+        "# plan | agg_strkey dict-cache warm={:.4f}s re-encode={:.4f}s "
+        "speedup={:.1f}x".format(
+            warm_s, cold_s, cold_s / max(warm_s, 1e-9)
+        )
+    )
+    return warm_s
 
 
 def _bench_map_rows_ragged(n_rows: int = 20_000, iters: int = 3):
@@ -995,6 +1138,28 @@ def main():
                 chain3_unfused_s / chain3_fused_s,
             )
         )
+    (
+        chain3_join_fused_s, chain3_join_unfused_s, chain3_join_compiles,
+    ) = _try(
+        "chain3_join", _bench_chain3_join,
+        (float("nan"), float("nan"), -1),
+        metric_keys=(
+            "chain3_join_fused_1M_wall_s", "chain3_join_unfused_1M_wall_s",
+        ),
+    )
+    if (
+        chain3_join_fused_s == chain3_join_fused_s
+        and chain3_join_unfused_s == chain3_join_unfused_s
+    ):
+        print(
+            "# plan | chain3_join fused={:.4f}s unfused={:.4f}s "
+            "ratio={:.2f}x steady_state_compiles={} bit_identical=True "
+            "(acceptance: >= 2x, 0 compiles)".format(
+                chain3_join_fused_s, chain3_join_unfused_s,
+                chain3_join_unfused_s / chain3_join_fused_s,
+                chain3_join_compiles,
+            )
+        )
     try:
         from tensorframes_tpu.observability.metrics import (
             REGISTRY as _plan_reg,
@@ -1306,6 +1471,8 @@ def main():
         "add3_host_map_blocks_rows_per_sec": round(add3_host_rps),
         "chain3_fused_1M_wall_s": round(chain3_fused_s, 6),
         "chain3_unfused_1M_wall_s": round(chain3_unfused_s, 6),
+        "chain3_join_fused_1M_wall_s": round(chain3_join_fused_s, 6),
+        "chain3_join_unfused_1M_wall_s": round(chain3_join_unfused_s, 6),
         "logreg_host_map_blocks_rows_per_sec": round(logreg_host_rps),
         "reduce_blocks_1M_wall_s": round(reduce_s, 6),
         "reduce_blocks_host_1M_wall_s": round(reduce_host_s, 6),

@@ -98,11 +98,12 @@ run_step "Test (8-device virtual CPU mesh)" \
   env TFTPU_OBS_EXPORT="$WORK/obs" TFTPU_FLIGHT_DIR="$WORK/obs/flight" python -m pytest tests/ -x -q
 
 # ci.yml's fusion-off smoke: TFTPU_FUSION=0 (the plan layer's escape
-# hatch) must keep the verb/frame/sweep suites green on the per-stage
-# executor path (test_plan omitted: its fixture forces fusion ON; its
-# equivalence sweep runs the fallback internally)
+# hatch) must keep the verb/frame/sweep suites — and the whole-pipeline
+# map→join→aggregate suite, which honors the ambient knob by design —
+# green on the per-stage executor path (test_plan omitted: its fixture
+# forces fusion ON; its equivalence sweep runs the fallback internally)
 run_step "Fusion-off smoke (TFTPU_FUSION=0 fallback stays green)" \
-  env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py -q
+  env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py tests/test_relational_pipeline.py -q
 
 # ci.yml's compile-cache smoke: a tier-1 slice twice against one shared
 # persistent store; the second run must report disk hits > 0 in its

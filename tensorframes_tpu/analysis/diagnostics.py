@@ -50,6 +50,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "TFG106": ("hbm-budget", "warn"),
     "TFG107": ("fusion-barrier", "warn"),
     "TFG108": ("cache-fingerprint-unstable", "warn"),
+    "TFG109": ("unfused-aggregate", "warn"),
 }
 
 # Pre-register the full counter family at import: one series per code,

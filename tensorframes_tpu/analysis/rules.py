@@ -79,6 +79,10 @@ class RuleContext:
     #: Fusion barriers found on a frame's plan chain (lint_plan only):
     #: dicts with ``reason``, ``upstream_maps``, ``downstream_maps``.
     plan_barriers: Optional[Sequence[dict]] = None
+    #: Aggregate/join epilogues that stayed a barrier for a FUSABLE
+    #: reason (lint_plan only): dicts with ``verb``, ``reason`` —
+    #: recorded by plan.ir.mark_unfused, read by TFG109.
+    unfused_epilogues: Optional[Sequence[dict]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -593,6 +597,34 @@ def _rule_fusion_barrier(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG109 — unfused-aggregate (plan-chain rule: lint_plan only)
+# ---------------------------------------------------------------------------
+
+def _rule_unfused_aggregate(ctx: RuleContext) -> List[Diagnostic]:
+    """An ``aggregate``/``join`` consuming an otherwise-fusable lazy
+    chain stayed a fusion barrier for a reason the USER can fix (the
+    plan layer records only fixable causes — mandatory fallbacks like
+    sharded/multi-process feeds are honest and never flagged): the
+    chain materialized its mapped columns and the epilogue dispatched
+    separately instead of composing into the per-block program."""
+    if not ctx.unfused_epilogues:
+        return []
+    out: List[Diagnostic] = []
+    for e in ctx.unfused_epilogues:
+        out.append(Diagnostic(
+            "TFG109", "warn",
+            f"{e['verb']} stayed a fusion barrier on an otherwise-"
+            f"fusable chain — {e['reason']} — so the upstream mapped "
+            "columns materialized and the epilogue dispatched as a "
+            "separate program instead of fusing into one dispatch per "
+            "block",
+            subject=str(e["verb"]),
+            fix=str(e["reason"]),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # TFG108 — cache-fingerprint-unstable (persistent-cache miss storm)
 # ---------------------------------------------------------------------------
 
@@ -640,6 +672,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG106": _rule_hbm_budget,
     "TFG107": _rule_fusion_barrier,
     "TFG108": _rule_fingerprint_unstable,
+    "TFG109": _rule_unfused_aggregate,
 }
 
 

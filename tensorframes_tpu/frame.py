@@ -33,6 +33,7 @@ Shape discovery parity:
 
 from __future__ import annotations
 
+import dataclasses as _dataclasses
 import functools as _functools
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -210,6 +211,233 @@ def _leaf_value(x):
             x = x[0]
         return x
     return x
+
+
+# ---------------------------------------------------------------------------
+# hash-join core: module-level so the plan lowering (plan/lower.py) runs
+# EXACTLY the join the eager path runs — the two cannot diverge
+# ---------------------------------------------------------------------------
+
+@_dataclasses.dataclass(frozen=True)
+class _JoinSpec:
+    """Normalized description of one hash join, detached from the frames.
+
+    ``lname``/``rname`` map each side's non-key columns to their output
+    names (clash suffixes already applied); pair order is output order.
+    :func:`_hash_join_cols` joins whatever subset of those columns is
+    present in its inputs — the plan's needed-columns pass prunes
+    THROUGH the join by simply not materializing dead columns."""
+
+    keys: Tuple[str, ...]
+    how: str  # 'inner' | 'left' | 'outer' ('right' mirrors to 'left')
+    lname: Tuple[Tuple[str, str], ...]  # (original, output) left pairs
+    rname: Tuple[Tuple[str, str], ...]
+    fill_value: object = None
+
+    def fill_for(self, col_name):
+        if isinstance(self.fill_value, dict):
+            if col_name not in self.fill_value:
+                raise ValueError(
+                    f"how={self.how!r}: fill_value has no entry for "
+                    f"column {col_name!r}"
+                )
+            return self.fill_value[col_name]
+        return self.fill_value
+
+    def checked_fill(self, col_name, np_dtype):
+        """The fill cast must be EXACT — a lossy fill (e.g. -1.5 into an
+        int column) would corrupt silently, the very failure mode
+        mandatory fills exist to prevent."""
+        fv = self.fill_for(col_name)
+        try:
+            cast = np.asarray(fv, np_dtype)
+        except (ValueError, TypeError, OverflowError):
+            # e.g. NaN fill into an int column: numpy raises its own
+            # 'cannot convert float NaN to integer' before the
+            # representability check below can phrase it usefully
+            raise ValueError(
+                f"how={self.how!r}: fill_value {fv!r} is not exactly "
+                f"representable in column {col_name!r}'s dtype "
+                f"{np_dtype}"
+            ) from None
+        same = (
+            cast != cast and fv != fv  # NaN fill into a float col
+        ) or cast == np.asarray(fv)
+        if not bool(same):
+            raise ValueError(
+                f"how={self.how!r}: fill_value {fv!r} is not exactly "
+                f"representable in column {col_name!r}'s dtype "
+                f"{np_dtype}"
+            )
+        return cast
+
+
+def _hash_join_cols(
+    lcols: Dict[str, object], rcols: Dict[str, object], spec: _JoinSpec
+) -> Block:
+    """Join two gathered column dicts per ``spec``. Key encoding rides
+    the aggregate machinery (``ops/keys.py``); the match expansion is
+    fully vectorized. Result ordering is pandas-like: left-row order,
+    ties in the right frame's stable order; ``outer`` appends unmatched
+    right rows in right order. Only the non-key columns PRESENT in
+    ``lcols``/``rcols`` are joined (plan pushdown prunes the rest)."""
+    from .ops.keys import group_ids
+
+    keys, how = list(spec.keys), spec.how
+    lname = {c: o for c, o in spec.lname if c in lcols}
+    rname = {c: o for c, o in spec.rname if c in rcols}
+    left_only = list(lname)
+    right_only = list(rname)
+    nl = _block_num_rows({k: lcols[k] for k in keys})
+    nr = _block_num_rows({k: rcols[k] for k in keys})
+    if (nl == 0 and how != "outer") or (
+        nr == 0 and how == "inner"
+    ) or (nl == 0 and nr == 0):
+        # group_ids cannot encode zero rows; an empty side means an
+        # empty inner join (left/outer joins keep the populated side's
+        # rows via the branches below)
+        out0: Block = {}
+        for k in keys:
+            v = lcols[k]
+            out0[k] = [] if isinstance(v, list) else v[:0]
+        for c in left_only:
+            v = lcols[c]
+            out0[lname[c]] = [] if isinstance(v, list) else v[:0]
+        for c in right_only:
+            v = rcols[c]
+            out0[rname[c]] = [] if isinstance(v, list) else v[:0]
+        return out0
+    if nl == 0:  # outer join, only right rows: left cols filled
+        out0 = {}
+        for k in keys:
+            out0[k] = rcols[k]
+        for c in left_only:
+            v = lcols[c]
+            if isinstance(v, list):
+                out0[lname[c]] = [spec.fill_for(c)] * nr
+            else:
+                out0[lname[c]] = np.full(
+                    (nr,) + v.shape[1:],
+                    spec.checked_fill(c, v.dtype),
+                    v.dtype,
+                )
+        for c in right_only:
+            out0[rname[c]] = rcols[c]
+        return out0
+    if nr == 0:
+        # left join against an empty right side: all left rows, right
+        # columns fully filled
+        out0 = {}
+        for k in keys:
+            out0[k] = lcols[k]
+        for c in left_only:
+            out0[lname[c]] = lcols[c]
+        for c in right_only:
+            v = rcols[c]
+            if isinstance(v, list):
+                out0[rname[c]] = [spec.fill_for(c)] * nl
+            else:
+                out0[rname[c]] = np.full(
+                    (nl,) + v.shape[1:], spec.checked_fill(c, v.dtype),
+                    v.dtype,
+                )
+        return out0
+    key_union = []
+    for k in keys:
+        lv, rv = lcols[k], rcols[k]
+        if isinstance(lv, list) or isinstance(rv, list):
+            u = np.empty(len(lv) + len(rv), dtype=object)
+            u[: len(lv)] = list(lv)
+            u[len(lv):] = list(rv)
+        else:
+            u = np.concatenate([lv, rv])
+        key_union.append(u)
+    codes, _, num_codes = group_ids(key_union)
+    l_codes, r_codes = codes[:nl], codes[nl:]
+
+    order_r = np.argsort(r_codes, kind="stable")
+    counts = np.bincount(r_codes, minlength=num_codes)
+    starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    cnt_l = counts[l_codes]
+    if how in ("left", "outer"):
+        # unmatched left rows still emit ONE output row, marked ri = -1
+        # so right columns take the fill
+        cnt_eff = np.maximum(cnt_l, 1)
+    else:
+        cnt_eff = cnt_l
+    li = np.repeat(np.arange(nl), cnt_eff)
+    total = int(cnt_eff.sum())
+    offs = np.arange(total) - np.repeat(
+        np.cumsum(cnt_eff) - cnt_eff, cnt_eff
+    )
+    base = np.repeat(starts[l_codes], cnt_eff) + offs
+    if how in ("left", "outer"):
+        matched = np.repeat(cnt_l > 0, cnt_eff)
+        safe = np.where(
+            matched, np.clip(base, 0, max(nr - 1, 0)), 0
+        )
+        ri = np.where(matched, order_r[safe], -1)
+    else:
+        ri = order_r[base]  # inner: every expansion matched
+
+    def gather(col, idx):
+        if isinstance(col, list):
+            return [col[i] for i in idx]
+        return col[idx]
+
+    def gather_right(col, col_name):
+        if how not in ("left", "outer"):
+            return gather(col, ri)
+        fv = spec.fill_for(col_name)
+        if isinstance(col, list):
+            return [col[i] if i >= 0 else fv for i in ri]
+        safe_i = np.clip(ri, 0, None)
+        # condition broadcasts across the cell dims of multi-dim
+        # columns (embeddings etc.)
+        cond = (ri >= 0).reshape((-1,) + (1,) * (col.ndim - 1))
+        return np.where(
+            cond, col[safe_i], spec.checked_fill(col_name, col.dtype)
+        )
+
+    out: Block = {}
+    for k in keys:
+        out[k] = gather(lcols[k], li)
+    for c in left_only:
+        out[lname[c]] = gather(lcols[c], li)
+    for c in right_only:
+        out[rname[c]] = gather_right(rcols[c], c)
+    if how == "outer":
+        # append the right rows NO left row matched (pandas sort=False
+        # outer: they follow the left-ordered part, in right order),
+        # left columns filled
+        matched_r = np.zeros(nr, bool)
+        matched_r[ri[ri >= 0]] = True
+        extra = np.flatnonzero(~matched_r)
+        if len(extra):
+            def cat(a, b):
+                if isinstance(a, list) or isinstance(b, list):
+                    return list(a) + list(b)
+                return np.concatenate([a, b])
+
+            for k in keys:
+                out[k] = cat(out[k], gather(rcols[k], extra))
+            ne = len(extra)
+            for c in left_only:
+                v = lcols[c]
+                if isinstance(v, list):
+                    fills = [spec.fill_for(c)] * ne
+                else:
+                    fills = np.full(
+                        (ne,) + v.shape[1:],
+                        spec.checked_fill(c, v.dtype),
+                        v.dtype,
+                    )
+                out[lname[c]] = cat(out[lname[c]], fills)
+            for c in right_only:
+                out[rname[c]] = cat(
+                    out[rname[c]], gather(rcols[c], extra)
+                )
+    return out
 
 
 class TensorFrame:
@@ -902,42 +1130,6 @@ class TensorFrame:
                 "integer columns"
             )
 
-        def fill_for(col_name):
-            if isinstance(fill_value, dict):
-                if col_name not in fill_value:
-                    raise ValueError(
-                        f"how={how!r}: fill_value has no entry for "
-                        f"column {col_name!r}"
-                    )
-                return fill_value[col_name]
-            return fill_value
-
-        def checked_fill(col_name, np_dtype):
-            """The fill cast must be EXACT — a lossy fill (e.g. -1.5
-            into an int column) would corrupt silently, the very failure
-            mode mandatory fills exist to prevent."""
-            fv = fill_for(col_name)
-            try:
-                cast = np.asarray(fv, np_dtype)
-            except (ValueError, TypeError, OverflowError):
-                # e.g. NaN fill into an int column: numpy raises its own
-                # 'cannot convert float NaN to integer' before the
-                # representability check below can phrase it usefully
-                raise ValueError(
-                    f"how={how!r}: fill_value {fv!r} is not exactly "
-                    f"representable in column {col_name!r}'s dtype "
-                    f"{np_dtype}"
-                ) from None
-            same = (
-                cast != cast and fv != fv  # NaN fill into a float col
-            ) or cast == np.asarray(fv)
-            if not bool(same):
-                raise ValueError(
-                    f"how={how!r}: fill_value {fv!r} is not exactly "
-                    f"representable in column {col_name!r}'s dtype "
-                    f"{np_dtype}"
-                )
-            return cast
         keys = [on] if isinstance(on, str) else list(on)
         for k in keys:
             self.schema[k]
@@ -968,160 +1160,60 @@ class TensorFrame:
         )
         schema = Schema(cols)
         left, right = self, other
-
-        def join_cols(lcols: Dict[str, object], rcols: Dict[str, object]) -> Block:
-            from .ops.keys import group_ids
-
-            nl = _block_num_rows(lcols)
-            nr = _block_num_rows(rcols)
-            if (nl == 0 and how != "outer") or (
-                nr == 0 and how == "inner"
-            ) or (nl == 0 and nr == 0):
-                # group_ids cannot encode zero rows; an empty side means
-                # an empty inner join (left/outer joins keep the
-                # populated side's rows via the branches below)
-                out0: Block = {}
-                for k in keys:
-                    v = lcols[k]
-                    out0[k] = [] if isinstance(v, list) else v[:0]
-                for c in left_only:
-                    v = lcols[c]
-                    out0[lname[c]] = [] if isinstance(v, list) else v[:0]
-                for c in right_only:
-                    v = rcols[c]
-                    out0[rname[c]] = [] if isinstance(v, list) else v[:0]
-                return out0
-            if nl == 0:  # outer join, only right rows: left cols filled
-                out0 = {}
-                for k in keys:
-                    out0[k] = rcols[k]
-                for c in left_only:
-                    v = lcols[c]
-                    if isinstance(v, list):
-                        out0[lname[c]] = [fill_for(c)] * nr
-                    else:
-                        out0[lname[c]] = np.full(
-                            (nr,) + v.shape[1:],
-                            checked_fill(c, v.dtype),
-                            v.dtype,
-                        )
-                for c in right_only:
-                    out0[rname[c]] = rcols[c]
-                return out0
-            if nr == 0:
-                # left join against an empty right side: all left rows,
-                # right columns fully filled
-                out0 = {}
-                for k in keys:
-                    out0[k] = lcols[k]
-                for c in left_only:
-                    out0[lname[c]] = lcols[c]
-                for c in right_only:
-                    v = rcols[c]
-                    if isinstance(v, list):
-                        out0[rname[c]] = [fill_for(c)] * nl
-                    else:
-                        out0[rname[c]] = np.full(
-                            (nl,) + v.shape[1:], checked_fill(c, v.dtype),
-                            v.dtype,
-                        )
-                return out0
-            key_union = []
-            for k in keys:
-                lv, rv = lcols[k], rcols[k]
-                if isinstance(lv, list) or isinstance(rv, list):
-                    u = np.empty(len(lv) + len(rv), dtype=object)
-                    u[: len(lv)] = list(lv)
-                    u[len(lv):] = list(rv)
-                else:
-                    u = np.concatenate([lv, rv])
-                key_union.append(u)
-            codes, _, num_codes = group_ids(key_union)
-            l_codes, r_codes = codes[:nl], codes[nl:]
-
-            order_r = np.argsort(r_codes, kind="stable")
-            counts = np.bincount(r_codes, minlength=num_codes)
-            starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
-            cnt_l = counts[l_codes]
-            if how in ("left", "outer"):
-                # unmatched left rows still emit ONE output row, marked
-                # ri = -1 so right columns take the fill
-                cnt_eff = np.maximum(cnt_l, 1)
-            else:
-                cnt_eff = cnt_l
-            li = np.repeat(np.arange(nl), cnt_eff)
-            total = int(cnt_eff.sum())
-            offs = np.arange(total) - np.repeat(
-                np.cumsum(cnt_eff) - cnt_eff, cnt_eff
-            )
-            base = np.repeat(starts[l_codes], cnt_eff) + offs
-            if how in ("left", "outer"):
-                matched = np.repeat(cnt_l > 0, cnt_eff)
-                safe = np.where(
-                    matched, np.clip(base, 0, max(nr - 1, 0)), 0
-                )
-                ri = np.where(matched, order_r[safe], -1)
-            else:
-                ri = order_r[base]  # inner: every expansion matched
-
-            def gather(col, idx):
-                if isinstance(col, list):
-                    return [col[i] for i in idx]
-                return col[idx]
-
-            def gather_right(col, col_name):
-                if how not in ("left", "outer"):
-                    return gather(col, ri)
-                fv = fill_for(col_name)
-                if isinstance(col, list):
-                    return [col[i] if i >= 0 else fv for i in ri]
-                safe_i = np.clip(ri, 0, None)
-                # condition broadcasts across the cell dims of
-                # multi-dim columns (embeddings etc.)
-                cond = (ri >= 0).reshape((-1,) + (1,) * (col.ndim - 1))
-                return np.where(
-                    cond, col[safe_i], checked_fill(col_name, col.dtype)
-                )
-
-            out: Block = {}
-            for k in keys:
-                out[k] = gather(lcols[k], li)
-            for c in left_only:
-                out[lname[c]] = gather(lcols[c], li)
-            for c in right_only:
-                out[rname[c]] = gather_right(rcols[c], c)
+        spec = _JoinSpec(
+            keys=tuple(keys),
+            how=how,
+            lname=tuple((c, lname[c]) for c in left_only),
+            rname=tuple((c, rname[c]) for c in right_only),
+            fill_value=fill_value,
+        )
+        if how in ("left", "outer"):
+            # fill representability is validated EAGERLY for every
+            # fillable device column — the plan's pushdown may prune a
+            # column before the join core's per-column check would see
+            # it, and a lossy fill must fail identically whether or not
+            # the column survives pruning (fused == TFTPU_FUSION=0)
+            need_fill = [(c, other.schema[c]) for c in right_only]
             if how == "outer":
-                # append the right rows NO left row matched (pandas
-                # sort=False outer: they follow the left-ordered part,
-                # in right order), left columns filled
-                matched_r = np.zeros(nr, bool)
-                matched_r[ri[ri >= 0]] = True
-                extra = np.flatnonzero(~matched_r)
-                if len(extra):
-                    def cat(a, b):
-                        if isinstance(a, list) or isinstance(b, list):
-                            return list(a) + list(b)
-                        return np.concatenate([a, b])
+                need_fill += [(c, self.schema[c]) for c in left_only]
+            for c, info in need_fill:
+                if info.is_device and info.dtype.np_dtype is not None:
+                    spec.checked_fill(c, np.dtype(info.dtype.np_dtype))
 
-                    for k in keys:
-                        out[k] = cat(out[k], gather(rcols[k], extra))
-                    ne = len(extra)
-                    for c in left_only:
-                        v = lcols[c]
-                        if isinstance(v, list):
-                            fills = [fill_for(c)] * ne
-                        else:
-                            fills = np.full(
-                                (ne,) + v.shape[1:],
-                                checked_fill(c, v.dtype),
-                                v.dtype,
-                            )
-                        out[lname[c]] = cat(out[lname[c]], fills)
-                    for c in right_only:
-                        out[rname[c]] = cat(
-                            out[rname[c]], gather(rcols[c], extra)
-                        )
-            return out
+        from .plan import ir as _plan_ir
+
+        if (
+            _plan_ir.fusion_enabled()
+            and not left.is_sharded
+            and not right.is_sharded
+        ):
+            import jax as _jax
+
+            if _jax.process_count() == 1:
+                # single-process hash join ENTERS the plan: upstream
+                # probe-side maps fuse into the probe dispatch, and the
+                # needed-columns pass prunes through the join on both
+                # sides (a downstream select/aggregate that never reads
+                # a column keeps it from being computed, gathered, or
+                # match-expanded). Multi-process and sharded frames
+                # keep the explicit broadcast/exchange paths below.
+                node = _plan_ir.PlanNode(
+                    "join",
+                    parent=_plan_ir.node_for_parent(self),
+                    right=other,
+                    spec=spec,
+                    schema=schema,
+                )
+
+                def plan_pending():
+                    from .plan.lower import execute_plan
+
+                    return execute_plan(node)
+
+                out = TensorFrame(None, schema, pending=plan_pending)
+                node.bind(out)
+                out._plan = node
+                return out
 
         def compute() -> List[Block]:
             import jax
@@ -1214,13 +1306,13 @@ class TensorFrame:
                         time.perf_counter() - t_x,
                         _block_num_rows(lrecv) + _block_num_rows(rrecv),
                     )
-                    out = join_cols(lrecv, rrecv)
+                    out = _hash_join_cols(lrecv, rrecv, spec)
                 else:
                     union, _ = _allgather_dicts(
                         [r_local[n] for n in r_names]
                     )
                     rcols = dict(zip(r_names, union))
-                    out = join_cols(lcols, rcols)
+                    out = _hash_join_cols(lcols, rcols, spec)
                 for name in list(out):
                     v = out[name]
                     if isinstance(v, np.ndarray) and v.dtype == object:
@@ -1230,7 +1322,7 @@ class TensorFrame:
             rcols = _merged_global_columns(
                 right, right.schema.names, "join"
             )
-            return [join_cols(lcols, rcols)]
+            return [_hash_join_cols(lcols, rcols, spec)]
 
         return TensorFrame(
             None, schema,

@@ -464,24 +464,41 @@ def try_aggregate_device(
                 assemble_key_cols(frame, keys, group_key_cols, sel),
                 out_cols,
             )
-    key_host: List[np.ndarray] = []
-    for k in keys:
-        v = main[k]
-        if isinstance(v, list):
-            arr = np.asarray(v, dtype=object)
-        else:
-            arr = np.asarray(jax.device_get(v))
-        if tail is not None and len(tail[k]):
-            tv = tail[k]
-            tarr = (
-                np.asarray(tv, dtype=object)
-                if isinstance(tv, list)
-                else np.asarray(tv)
-            )
-            arr = np.concatenate([arr, tarr])
-        key_host.append(arr)
-    # shared encoder (ops/keys.py): dense group ids, lexicographic order
-    ids_all, group_key_cols, K = group_ids(key_host)
+    # host-list (e.g. STRING) keys have no stable array identity for
+    # the id memo above, but the FRAME is immutable once materialized:
+    # cache their dictionary encode on it (the same convention as
+    # keys.frame_group_ids), so repeated string-keyed aggregates skip
+    # the full hash pass over every key cell
+    from .keys import frame_cache_get, frame_cache_put
+
+    frame_ck = ("__device_dict__",) + tuple(keys)
+    hit = None
+    if memo_key is None and tail is None:
+        hit = frame_cache_get(frame, frame_ck)
+    if hit is not None:
+        ids_all, group_key_cols, K = hit
+    else:
+        key_host: List[np.ndarray] = []
+        for k in keys:
+            v = main[k]
+            if isinstance(v, list):
+                arr = np.asarray(v, dtype=object)
+            else:
+                arr = np.asarray(jax.device_get(v))
+            if tail is not None and len(tail[k]):
+                tv = tail[k]
+                tarr = (
+                    np.asarray(tv, dtype=object)
+                    if isinstance(tv, list)
+                    else np.asarray(tv)
+                )
+                arr = np.concatenate([arr, tarr])
+            key_host.append(arr)
+        # shared encoder (ops/keys.py): dense group ids, lexicographic
+        # order
+        ids_all, group_key_cols, K = group_ids(key_host)
+        if memo_key is None and tail is None:
+            frame_cache_put(frame, frame_ck, (ids_all, group_key_cols, K))
     if K * feat > _TABLE_ELEM_LIMIT:
         logger.debug(
             "device aggregate: %d groups ×%d feat exceeds the table limit; "

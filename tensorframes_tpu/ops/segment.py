@@ -133,6 +133,16 @@ def disable_pallas(reason: str = "") -> None:
             "disabling pallas segment kernel (falling back to XLA "
             "segment_sum)%s", f": {reason}" if reason else ""
         )
+        try:
+            # fused plan epilogues traced with pallas enabled are stale
+            # the moment the kill-switch trips — drop them so the next
+            # force re-traces onto the XLA scatter instead of replaying
+            # the failing kernel from the cache forever
+            from ..plan.lower import clear_fused_cache
+
+            clear_fused_cache()
+        except Exception:  # pragma: no cover - never block the switch
+            pass
     _pallas_disabled = True
 
 
@@ -148,6 +158,58 @@ def _pallas_eligible(values: jnp.ndarray, num_segments: int) -> bool:
         and 0 < num_segments <= _MAX_PALLAS_SEGMENTS
         and jax.default_backend() == "tpu"
     )
+
+
+def host_segment_eligible(ops_key, val_cols) -> bool:
+    """True when the keyed reduction should run as HOST ``np.bincount``
+    instead of the jitted segment program: CPU backend only (XLA:CPU
+    lowers ``segment_sum`` to a serialized scatter — measured ~45ms per
+    1M-row f32 column vs ~4ms for bincount's weighted histogram), and
+    only for 1-D float sum/mean (int sums must not ride bincount's
+    float64 weights — >2^53 would silently lose bits; min/max have no
+    bincount form). Works on numpy AND jax-array values so the fused
+    plan epilogue and the eager path take the SAME branch — that
+    sameness is what keeps fused and unfused outputs bit-identical."""
+    if jax.default_backend() != "cpu":
+        return False
+    for x, op in ops_key:
+        v = val_cols[x]
+        if op not in ("reduce_sum", "reduce_mean"):
+            return False
+        if getattr(v, "ndim", None) != 1:
+            return False
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            return False
+    return True
+
+
+def segment_reduce_host(ops_key, num_segments, val_cols, seg_ids):
+    """CPU segment sums/means via ``np.bincount``: one fused weighted-
+    histogram pass per column, accumulating in float64 (a strictly
+    tighter error bound than the f32 sequential scatter) and cast back
+    to the value dtype — the fetch-dtype contract the jitted path
+    keeps. Both the plan's fused epilogue and the ``TFTPU_FUSION=0``
+    path dispatch through THIS function on CPU, so the bit-identical
+    contract holds by construction."""
+    import numpy as np
+
+    seg_ids = np.asarray(seg_ids)
+    out = {}
+    counts = None
+    for x, op in ops_key:
+        v = np.asarray(val_cols[x])  # syncs a device value in one copy
+        s = np.bincount(seg_ids, weights=v, minlength=num_segments)
+        if op == "reduce_mean":
+            if counts is None:
+                counts = np.bincount(seg_ids, minlength=num_segments)
+            # segment-count bucketing pads num_segments past the real
+            # group count; the padded slots divide 0/0 and are sliced
+            # away by the caller — suppress numpy's warning so a
+            # warnings-as-errors consumer sees no fused-only noise
+            with np.errstate(invalid="ignore", divide="ignore"):
+                s = s / counts
+        out[x] = s.astype(v.dtype)
+    return out
 
 
 def segment_sum(

@@ -847,8 +847,17 @@ def reduce_rows(
     fold = make_pair_fold(program, out_names)
     t0 = time.perf_counter()
 
-    partials: List[Dict[str, np.ndarray]] = []
-    blocks = frame.blocks()
+    # whole-pipeline route: a lazy plan-carrying frame fuses its map
+    # chain WITH the pairwise-fold epilogue into one program per block
+    # (plan/lower.lower_reduce) — the mapped columns never materialize;
+    # the per-block partials below then combine exactly as always.
+    from ..plan.lower import lower_reduce
+
+    planned = lower_reduce(frame, program, out_names, "rows")
+    partials: List[Dict[str, np.ndarray]] = (
+        list(planned[0]) if planned is not None else []
+    )
+    blocks = [] if planned is not None else frame.blocks()
     if frame.is_sharded and blocks:
         main = blocks[0]
         axis = getattr(frame, "_axis", None) or get_config().batch_axis
@@ -916,7 +925,10 @@ def reduce_rows(
         }
         res = fold(stacked)
         finals = {x: np.asarray(res[x]) for x in out_names}
-    profiling.record("reduce_rows", time.perf_counter() - t0, frame.num_rows)
+    profiling.record(
+        "reduce_rows", time.perf_counter() - t0,
+        planned[1] if planned is not None else frame.num_rows,
+    )
     return _unpack_results(program, finals)
 
 
@@ -945,8 +957,16 @@ def reduce_blocks(
     compiled = program.compiled()
     t0 = time.perf_counter()
 
-    partials: List[Dict[str, np.ndarray]] = []
-    for b in frame.blocks():
+    # whole-pipeline route: fuse the recorded map chain with the reduce
+    # program into one dispatch per block (plan/lower.lower_reduce) —
+    # the mapped columns never materialize; partials combine as always.
+    from ..plan.lower import lower_reduce
+
+    planned = lower_reduce(frame, program, out_names, "blocks")
+    partials: List[Dict[str, np.ndarray]] = (
+        list(planned[0]) if planned is not None else []
+    )
+    for b in ([] if planned is not None else frame.blocks()):
         if _block_num_rows(b) == 0:
             continue
         feeds = {}
@@ -974,7 +994,10 @@ def reduce_blocks(
             f"{x}_input": np.stack([p[x] for p in partials]) for x in out_names
         }
         finals = compiled.run_block(feeds)
-    profiling.record("reduce_blocks", time.perf_counter() - t0, frame.num_rows)
+    profiling.record(
+        "reduce_blocks", time.perf_counter() - t0,
+        planned[1] if planned is not None else frame.num_rows,
+    )
     return _unpack_results(program, finals)
 
 
@@ -987,19 +1010,112 @@ from functools import lru_cache
 from .segment import segment_sum as _segment_sum
 
 
-def _host_group_ids(key_cols, keys):
-    """Dense group ids (lexicographic group order) for the host aggregate
-    path, touching ONLY the key columns — value columns are never
-    reordered because segment scatters take unsorted ids (this replaces
-    the old full-row lexsort ≙ Catalyst's shuffle, DebugRowOps.scala:583).
-    Encoding strategies live in :mod:`.keys` (shared with the sharded
-    device plans). Returns ``(seg_ids, out_key_cols, num_groups)``."""
-    from .keys import group_ids
+def _agg_schema_infos(schema, keys, program) -> List[ColumnInfo]:
+    """Result schema of a keyed aggregate: key columns (Unknown lead)
+    then the program outputs sorted by name — shared by the eager
+    assemble and the plan route's lazy result frame."""
+    infos: List[ColumnInfo] = []
+    for k in keys:
+        infos.append(schema[k].with_block_shape(
+            schema[k].cell_shape.prepend(Unknown)
+        ))
+    for o in sorted(program.outputs, key=lambda s: s.name):
+        infos.append(ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown)))
+    return infos
 
-    seg_ids, group_key_cols, num_groups = group_ids(
-        [key_cols[k] for k in keys]
-    )
-    return seg_ids, dict(zip(keys, group_key_cols)), num_groups
+
+def _empty_agg_blocks(schema) -> List[Block]:
+    """The zero-row aggregate result for ``schema`` — ONE definition
+    shared by the eager empty-frame branch and the plan lowering, so
+    the fused and unfused empty-aggregate schemas cannot drift."""
+    empty: Block = {}
+    for i in schema:
+        dims = tuple(0 if d == Unknown else d for d in i.cell_shape.dims)
+        if i.is_device:
+            empty[i.name] = np.empty((0,) + dims, dtype=i.dtype.np_dtype)
+        else:
+            empty[i.name] = []
+    return [empty]
+
+
+def _segment_reduce_best(ops_key, num_groups, val_cols, seg_ids):
+    """Keyed-reduction backend dispatch, recorded as a cost-model
+    decision: host ``np.bincount`` on the CPU backend for 1-D float
+    sums/means (XLA:CPU's serialized scatter is ~20x slower), the
+    jitted segment program otherwise. Values may be numpy or jax
+    arrays; returns numpy columns. EVERY host-frame keyed reduction —
+    the eager fast path and the plan's fused epilogues — dispatches
+    here, so fused and unfused outputs stay bit-identical whichever
+    backend wins."""
+    from . import segment as _segment
+
+    if _segment.host_segment_eligible(ops_key, val_cols):
+        from ..plan.lower import _note_decision
+        from ..plan.rules import Decision
+
+        _note_decision(Decision(
+            "host_segment_reduce",
+            "CPU backend: bincount's weighted histogram beats XLA's "
+            "serialized segment scatter for float sums",
+            {"num_groups": int(num_groups)},
+        ))
+        return _segment.segment_reduce_host(
+            ops_key, num_groups, val_cols, seg_ids
+        )
+    seg_vals = {x: jnp.asarray(val_cols[x]) for x, _ in ops_key}
+    # int32 ids: halves the host→HBM id-column transfer (the hot cost
+    # on relay-attached chips); group counts can't exceed int32 — the
+    # id space is bounded by row count long before 2^31
+    sids = jnp.asarray(np.asarray(seg_ids).astype(np.int32))
+    res = run_segment_fast(ops_key, num_groups, seg_vals, sids)
+    return {x: np.asarray(res[x]) for x, _ in ops_key}
+
+
+def run_segment_fast(ops_key, num_groups, seg_vals, sids):
+    """One jitted segment-reduce dispatch with the pallas kill-switch:
+    a Mosaic kernel-compile failure disables the pallas path process-
+    wide and retries on XLA's scatter — shared by the eager aggregate
+    and the plan lowering's fused epilogues so retry semantics cannot
+    diverge. ``_seg_fast_for`` is looked up by name so tests may
+    monkeypatch it."""
+    try:
+        return _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
+    except Exception as e:
+        from . import segment as _segment
+
+        # only a pallas kernel-compile failure (Mosaic) justifies the
+        # process-wide fallback; transient TPU errors (OOM etc.) and
+        # genuine program bugs re-raise untouched
+        if not _segment.pallas_enabled() or "Mosaic" not in str(e):
+            raise
+        _segment.disable_pallas(f"{type(e).__name__} in aggregate")
+        _seg_fast_for.cache_clear()  # drop executables traced w/ pallas
+        return _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
+
+
+def _host_fast_aggregate(program, frame, keys, seg_info, out_names):
+    """The host segment fast path over a (forced) frame: gather value
+    columns, encode group keys through the per-frame dictionary cache
+    (:func:`tensorframes_tpu.ops.keys.frame_group_ids` — string keys
+    encode once, not per aggregate), one vectorized segment reduction
+    (:func:`_segment_reduce_best` picks the backend). Returns
+    ``(out_key_cols, out_cols, n_rows)``. Shared by the eager
+    aggregate and the plan lowering's fallback path."""
+    from .keys import frame_group_ids
+
+    val_cols = {}
+    for x in out_names:
+        vals = frame.column_values(x)
+        if vals.dtype == object:
+            raise ValueError(
+                f"Column {x!r} is ragged; aggregate requires uniform "
+                "cells (run analyze() first)."
+            )
+        val_cols[x] = _demote_cast(vals, program.input(f"{x}_input"))
+    seg_ids, group_key_cols, num_groups = frame_group_ids(frame, keys)
+    ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
+    out_cols = _segment_reduce_best(ops_key, num_groups, val_cols, seg_ids)
+    return dict(zip(keys, group_key_cols)), out_cols, len(seg_ids)
 
 
 @lru_cache(maxsize=32)
@@ -1280,21 +1396,67 @@ def aggregate(
     if strict:
         _strict_lint(program, frame, block_mode=True)
     out_names = [o.name for o in program.outputs]
+    unfused_reason: Optional[str] = None
 
     def _assemble(out_key_cols, out_cols, n_rows):
-        infos: List[ColumnInfo] = []
-        for k in keys:
-            infos.append(frame.schema[k].with_block_shape(
-                frame.schema[k].cell_shape.prepend(Unknown)
-            ))
-        for o in sorted(program.outputs, key=lambda s: s.name):
-            infos.append(ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown)))
+        infos = _agg_schema_infos(frame.schema, keys, program)
         block: Block = {}
         block.update(out_key_cols)
         for o in program.outputs:
             block[o.name] = out_cols[o.name]
         profiling.record("aggregate", time.perf_counter() - t0, n_rows)
-        return TensorFrame([block], Schema(infos))
+        tf = TensorFrame([block], Schema(infos))
+        if unfused_reason is not None:
+            from ..plan import ir as plan_ir
+
+            plan_ir.mark_unfused(tf, "aggregate", unfused_reason)
+        return tf
+
+    # -- whole-pipeline route: a lazy plan-carrying frame records an
+    # `aggregate` node instead of forcing its chain — the lowering
+    # composes the fused upstream maps with a segment-reduce epilogue
+    # into ONE program per block (plan/lower.execute_aggregate), so
+    # the mapped value columns never materialize. Sharded and
+    # multi-process frames keep their explicit device/collective plans
+    # below; non-algebraic fetches keep the UDAF path (and get TFG109
+    # evidence recorded for lint_plan). --------------------------------
+    algebraic = seg_info is not None and all(
+        op in _SEGMENT_OPS or op == "reduce_mean" for _, op, _ in seg_info
+    )
+    from ..plan import ir as plan_ir
+
+    if (
+        getattr(frame, "_plan", None) is not None
+        and not frame.is_sharded
+        and plan_ir.fusion_enabled()
+        and jax.process_count() == 1
+    ):
+        if algebraic:
+            node = plan_ir.PlanNode(
+                "aggregate",
+                parent=plan_ir.node_for_parent(frame),
+                program=program,
+                out_names=out_names,
+                keys=keys,
+                spec=tuple(seg_info),
+                schema=Schema(_agg_schema_infos(frame.schema, keys, program)),
+            )
+            node._extended = True  # terminal: consumers re-source on it
+
+            def agg_pending():
+                from ..plan.lower import execute_aggregate
+
+                return execute_aggregate(node)
+
+            result = TensorFrame(None, node.schema, pending=agg_pending)
+            node.bind(result)
+            result._plan = node
+            return result
+        unfused_reason = (
+            "non-algebraic fetches (no segment lowering): the chain "
+            "materializes before the generic UDAF path runs — use "
+            "reduce_sum/min/max/mean DSL fetches to fuse the epilogue"
+        )
 
     # -- sharded fast path: per-shard dense segment reduce + one ICI
     # collective (no host gather, no sort — see ops/device_agg.py) ----------
@@ -1326,27 +1488,24 @@ def aggregate(
     # column_values on a multi-process sharded frame raises for
     # non-addressable columns even when there is nothing to gather
     if frame.num_rows == 0:
-        infos = [
-            frame.schema[k].with_block_shape(
-                frame.schema[k].cell_shape.prepend(Unknown)
-            )
-            for k in keys
-        ] + [
-            ColumnInfo(o.name, o.dtype, o.shape.prepend(Unknown))
-            for o in sorted(program.outputs, key=lambda s: s.name)
-        ]
-        empty: Block = {}
-        for i in infos:
-            dims = tuple(0 if d == Unknown else d for d in i.cell_shape.dims)
-            if i.is_device:
-                empty[i.name] = np.empty((0,) + dims, dtype=i.dtype.np_dtype)
-            else:
-                empty[i.name] = []
+        schema_e = Schema(_agg_schema_infos(frame.schema, keys, program))
         profiling.record("aggregate", time.perf_counter() - t0, 0)
-        return TensorFrame([empty], Schema(infos))
+        return TensorFrame(_empty_agg_blocks(schema_e), schema_e)
 
-    # -- gather rows to host, encode group keys -----------------------------
-    key_cols = {k: frame.column_values(k) for k in keys}
+    # -- host paths ---------------------------------------------------------
+    if algebraic:
+        # -- segment fast path: gather + cached key encode + ONE
+        # vectorized segment dispatch (shared with the plan lowering's
+        # fallback — see _host_fast_aggregate) ------------------------------
+        out_key_cols, out_cols, n = _host_fast_aggregate(
+            program, frame, keys, seg_info, out_names
+        )
+        return _assemble(out_key_cols, out_cols, n)
+
+    # -- generic (UDAF-equivalent) path: level-batched device
+    # compaction — see _batched_compaction ----------------------------------
+    from .keys import frame_group_ids
+
     val_cols = {}
     for x in out_names:
         vals = frame.column_values(x)
@@ -1356,41 +1515,8 @@ def aggregate(
                 "(run analyze() first)."
             )
         val_cols[x] = _demote_cast(vals, program.input(f"{x}_input"))
-    n = len(next(iter(key_cols.values())))
-    seg_ids, out_key_cols, num_groups = _host_group_ids(key_cols, keys)
-
-    out_cols: Dict[str, np.ndarray] = {}
-    if seg_info is not None and all(op in _SEGMENT_OPS or op == "reduce_mean" for _, op, _ in seg_info):
-        # -- segment fast path ----------------------------------------------
-        # the jitted program is module-level with (ops, num_groups) static
-        # and sids a real argument, so repeated aggregates with the same
-        # shapes reuse one XLA executable (no giant captured constants)
-        ops_key = tuple((out_name, op) for out_name, op, _ in seg_info)
-        seg_vals = {x: jnp.asarray(val_cols[x]) for x in out_names}
-        # int32 ids: halves the host→HBM id-column transfer (the hot cost
-        # on relay-attached chips); group counts can't exceed int32 — the
-        # id space is bounded by row count long before 2^31
-        sids = jnp.asarray(seg_ids.astype(np.int32))
-        try:
-            res = _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
-        except Exception as e:
-            from . import segment as _segment
-
-            # only a pallas kernel-compile failure (Mosaic) justifies the
-            # process-wide fallback; transient TPU errors (OOM etc.) and
-            # genuine program bugs re-raise untouched
-            if not _segment.pallas_enabled() or "Mosaic" not in str(e):
-                raise
-            _segment.disable_pallas(f"{type(e).__name__} in aggregate")
-            _seg_fast_for.cache_clear()  # drop executables traced w/ pallas
-            res = _seg_fast_for(ops_key, num_groups)(seg_vals, sids)
-        out_cols = {x: np.asarray(res[x]) for x in out_names}
-    else:
-        # -- generic (UDAF-equivalent) path: level-batched device
-        # compaction — see _batched_compaction ------------------------------
-        out_cols = _batched_compaction(
-            program, val_cols, seg_ids, num_groups, out_names
-        )
-
-    # -- assemble result frame: key cols + fetch cols -----------------------
-    return _assemble(out_key_cols, out_cols, n)
+    seg_ids, group_key_cols, num_groups = frame_group_ids(frame, keys)
+    out_cols = _batched_compaction(
+        program, val_cols, seg_ids, num_groups, out_names
+    )
+    return _assemble(dict(zip(keys, group_key_cols)), out_cols, len(seg_ids))

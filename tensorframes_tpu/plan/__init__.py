@@ -26,21 +26,40 @@ from .ir import (  # noqa: F401
     explain_plan,
     fusion_enabled,
     mark_barrier,
+    mark_unfused,
     node_for_parent,
     parent_is_fusable,
     program_has_callback,
     resolve_chain,
+    unfused_epilogues,
 )
-from .lower import execute_plan  # noqa: F401
-from .rules import SegmentPlan, plan_segment, split_segments  # noqa: F401
+from .lower import execute_aggregate, execute_plan, lower_reduce  # noqa: F401
+from .rules import (  # noqa: F401
+    Decision,
+    SegmentPlan,
+    decide_epilogue,
+    decide_fuse,
+    decide_segment_bucket,
+    plan_segment,
+    reassoc_safe,
+    split_segments,
+)
 
 __all__ = [
+    "Decision",
     "PlanNode",
     "SegmentPlan",
     "chain_barriers",
+    "decide_epilogue",
+    "decide_fuse",
+    "decide_segment_bucket",
+    "execute_aggregate",
     "execute_plan",
     "explain_plan",
     "fusion_enabled",
+    "lower_reduce",
     "plan_segment",
+    "reassoc_safe",
     "split_segments",
+    "unfused_epilogues",
 ]

@@ -20,6 +20,21 @@ Node kinds:
   stage computes; fuses the mask program into the upstream run and
   splits the chain for downstream stages (a data-dependent row count is
   a fusion barrier by nature).
+* ``join`` — a hash join whose probe (left) side is this chain;
+  ``right`` holds the build-side frame (an independent plan input, so a
+  strong reference) and ``spec`` the normalized join description
+  (:class:`tensorframes_tpu.frame._JoinSpec`). Like ``filter`` it ends
+  its segment (the output row count is data-dependent), but the
+  upstream probe-side maps fuse into the probe dispatch and the
+  needed-columns pass prunes THROUGH it on both sides.
+* ``aggregate`` — a keyed segment-reduce epilogue: ``program`` is the
+  normalized reduce program, ``keys`` the group-by columns, ``spec``
+  the ``segment_reduce_info`` op list. Terminal: the lowering composes
+  the upstream fused maps with the segment reduction into one Program
+  per block (tree-combined across blocks), so the mapped value columns
+  are never materialized.
+* ``reduce`` — a whole-frame ``reduce_blocks``/``reduce_rows``
+  epilogue (``spec`` is the mode string); terminal like ``aggregate``.
 
 Nodes hold a **weak** reference to the frame they describe: if an
 intermediate frame was already forced (or an internal mask frame was
@@ -41,12 +56,15 @@ from typing import List, Optional, Sequence, Tuple
 
 __all__ = [
     "PlanNode",
+    "allow_planning",
     "fusion_enabled",
     "lowering",
     "lowering_active",
     "node_for_parent",
     "resolve_chain",
     "mark_barrier",
+    "mark_unfused",
+    "unfused_epilogues",
     "parent_is_fusable",
     "program_has_callback",
     "chain_barriers",
@@ -58,14 +76,17 @@ class PlanNode:
     """One step of a logical plan (immutable after construction)."""
 
     __slots__ = (
-        "kind",        # 'source' | 'map' | 'select' | 'filter'
+        "kind",        # 'source'|'map'|'select'|'filter'|'join'|'aggregate'|'reduce'
         "parent",      # upstream PlanNode (None for source)
         "source_frame",  # kind == 'source': the wrapped frame (strong ref)
-        "program",     # kind == 'map': normalized Program (feed_dict applied)
+        "program",     # 'map': stage Program; 'aggregate'/'reduce': reduce Program
         "rows",        # kind == 'map': True for map_rows semantics
-        "out_names",   # kind == 'map': the program's output column names
+        "out_names",   # 'map': program outputs; 'aggregate'/'reduce': fetch names
         "names",       # kind == 'select': kept column names, in order
         "mask_name",   # kind == 'filter': the mask column (parent map's out)
+        "right",       # kind == 'join': the build-side frame (strong ref)
+        "spec",        # 'join': _JoinSpec; 'aggregate': seg_info; 'reduce': mode
+        "keys",        # kind == 'aggregate': group-by column names
         "schema",      # result Schema of this node's frame
         "_frame_ref",  # weakref to the frame this node describes
         "_extended",   # a downstream node already chains on this one
@@ -81,6 +102,9 @@ class PlanNode:
         out_names: Sequence[str] = (),
         names: Sequence[str] = (),
         mask_name: Optional[str] = None,
+        right=None,
+        spec=None,
+        keys: Sequence[str] = (),
         schema=None,
     ):
         self.kind = kind
@@ -91,6 +115,9 @@ class PlanNode:
         self.out_names = tuple(out_names)
         self.names = tuple(names)
         self.mask_name = mask_name
+        self.right = right
+        self.spec = spec
+        self.keys = tuple(keys)
         self.schema = schema
         self._frame_ref = None
         self._extended = False
@@ -110,6 +137,15 @@ class PlanNode:
             return f"select({list(self.names)})"
         if self.kind == "filter":
             return f"filter(mask={self.mask_name!r})"
+        if self.kind == "join":
+            return (
+                f"join(on={list(self.spec.keys)}, how={self.spec.how!r})"
+            )
+        if self.kind == "aggregate":
+            ops = [op for _, op, _ in (self.spec or ())]
+            return f"aggregate(keys={list(self.keys)}, ops={ops})"
+        if self.kind == "reduce":
+            return f"reduce_{self.spec}({', '.join(self.out_names)})"
         return "source"
 
 
@@ -132,6 +168,21 @@ def lowering():
         yield
     finally:
         _TLS.depth -= 1
+
+
+@contextlib.contextmanager
+def allow_planning():
+    """Escape the re-entrancy guard for an INDEPENDENT chain: the
+    lowering pass must not re-plan the chain it is executing, but a
+    join's build side is its own pipeline — planning (and therefore
+    pushdown-pruning) it is both safe and required. Restores the
+    ambient depth on exit."""
+    depth = getattr(_TLS, "depth", 0)
+    _TLS.depth = 0
+    try:
+        yield
+    finally:
+        _TLS.depth = depth
 
 
 def fusion_enabled() -> bool:
@@ -199,6 +250,29 @@ def mark_barrier(frame, reason: str, parent) -> None:
         frame._fusion_barrier_upstream = parent_is_fusable(parent)
     except AttributeError:  # pragma: no cover - exotic frame-likes
         pass
+
+
+def mark_unfused(frame, verb: str, reason: str) -> None:
+    """Record that ``frame`` came out of an ``aggregate``/``join`` whose
+    epilogue stayed a fusion barrier for a *fusable* reason — the
+    TFG109 evidence. Called at verb time for statically-knowable causes
+    (non-algebraic fetches) and appended at force time for runtime ones
+    (ragged value cells, a group key computed by a chained stage).
+    Mandatory fallbacks (sharded / multi-process feeds) are honest, not
+    fusable, and are never recorded here."""
+    try:
+        log = getattr(frame, "_plan_unfused", None)
+        if log is None:
+            log = frame._plan_unfused = []
+        log.append({"verb": verb, "reason": reason})
+    except AttributeError:  # pragma: no cover - exotic frame-likes
+        pass
+
+
+def unfused_epilogues(frame) -> List[dict]:
+    """The TFG109 evidence recorded by :func:`mark_unfused` (empty when
+    every epilogue fused, or nothing was recorded)."""
+    return list(getattr(frame, "_plan_unfused", ()) or ())
 
 
 def program_has_callback(program) -> bool:

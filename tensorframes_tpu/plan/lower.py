@@ -2,7 +2,8 @@
 
 ``execute_plan`` is the pending computation of every plan-carrying
 frame. It resolves the chain to its effective source, splits it into
-segments at filters (:mod:`.rules`), and runs each segment either
+segments at filters and joins (:mod:`.rules`), and runs each segment
+either
 
 * **fused** — the segment's included map stages compose into a single
   :class:`~tensorframes_tpu.program.Program` (map_rows stages enter in
@@ -14,14 +15,35 @@ segments at filters (:mod:`.rules`), and runs each segment either
   stage, a trace failure) or when fusing would not help (a bare single
   map keeps its specialized path, lead-dim bucketing included).
 
+A segment ending in a ``join`` node runs its probe-side maps fused as
+above, then executes the hash join through the SAME
+:func:`~tensorframes_tpu.frame._hash_join_cols` core the eager path
+uses — over only the columns the needed-columns pass kept on either
+side.
+
+``execute_aggregate`` is the pending computation of a plan-recorded
+keyed ``aggregate``: the upstream fused map Program composes with a
+segment-reduce epilogue into ONE Program per block whose ``[K, ...]``
+partial tables tree-combine across blocks — the mapped value columns
+are never materialized. When a float sum/mean would reassociate across
+blocks (tree-combining is then not bit-identical to the unfused global
+reduction), the cost model picks the **concat epilogue** instead: the
+fused map runs per block with device-resident outputs and ONE segment
+dispatch reduces the concatenation — the exact program, values, and row
+order of the unfused path. ``lower_reduce`` does the same for
+whole-frame ``reduce_blocks``/``reduce_rows`` (scan epilogue for the
+pairwise fold), returning per-block partials for the verbs' unchanged
+combine step.
+
 Fused programs are cached by stage identity so steady-state serving
 loops (rebuild the chain each batch from the same pre-compiled
 Programs) reuse one XLA executable instead of re-tracing per force.
 
 Observability: ``tftpu_plan_*`` metrics are registered at import (the
-fused-stages counter, the intermediate-bytes-avoided counter, the
-plan-lowering-seconds histogram, and per-reason fallback counters) and
-``plan.lower`` / ``plan.execute`` spans land on the structured trace
+fused-stages/epilogue counters, the intermediate-bytes-avoided counter,
+the plan-lowering-seconds histogram, per-reason fallback counters, and
+per-decision cost-model counters) and ``plan.lower`` / ``plan.execute``
+spans plus ``plan.cost`` decision instants land on the structured trace
 timeline when tracing is on.
 """
 
@@ -38,12 +60,14 @@ from ..observability import events as _events
 from ..observability.metrics import counter as _counter
 from ..observability.metrics import histogram as _histogram
 from ..utils import get_logger
+from ..utils import profiling
 from . import ir
+from . import rules as _rules
 from .rules import SegmentPlan, plan_segment, split_segments
 
 logger = get_logger(__name__)
 
-__all__ = ["execute_plan"]
+__all__ = ["execute_plan", "execute_aggregate", "lower_reduce"]
 
 # Registered at import so expositions always carry the plan family
 # (a process that never fused reads 0 — the series does not vanish).
@@ -67,8 +91,58 @@ _FALLBACKS = {
         "Plan segments that fell back to per-stage execution, by reason",
         labels={"reason": reason},
     )
-    for reason in ("ragged", "host_callback", "trace_error", "single_stage")
+    for reason in (
+        "ragged", "host_callback", "trace_error", "single_stage",
+        "computed_key",
+    )
 }
+# Whole-pipeline epilogues that fused into the plan, by consuming verb.
+_FUSED_EPILOGUES = {
+    verb: _counter(
+        "tftpu_plan_fused_epilogues_total",
+        "Aggregate/reduce/join epilogues executed inside the plan "
+        "(mapped inputs never materialized), by verb",
+        labels={"verb": verb},
+    )
+    for verb in ("aggregate", "reduce_blocks", "reduce_rows", "join")
+}
+# Cost-model decisions, by decision kind (plan/rules.py decide_*).
+_COST_DECISIONS = {
+    kind: _counter(
+        "tftpu_plan_cost_decisions_total",
+        "Lowering choices made by the plan cost model, by decision",
+        labels={"decision": kind},
+    )
+    for kind in (
+        "fuse", "split_single_stage", "epilogue_per_block",
+        "epilogue_concat", "bucket_segments", "host_segment_reduce",
+    )
+}
+
+
+def _note_decision(decision: "_rules.Decision") -> None:
+    """Count + trace one cost-model decision (the decision log the
+    bench's ``# plan |`` summary and post-hoc trace reads)."""
+    c = _COST_DECISIONS.get(decision.kind)
+    if c is not None:
+        c.inc()
+    if _events.TRACER.enabled:
+        _events.TRACER.instant(
+            "plan.cost", cat="plan",
+            decision=decision.kind, reason=decision.reason,
+            **{k: str(v) for k, v in decision.details.items()},
+        )
+
+
+def _lowering_seconds_mean() -> Optional[float]:
+    """Mean observed lowering wall-clock — the live-metrics input the
+    cost model's fuse decision records for post-hoc inspection."""
+    try:
+        if _LOWER_SECONDS.count:
+            return _LOWER_SECONDS.sum / _LOWER_SECONDS.count
+    except Exception:  # pragma: no cover - metrics internals moved
+        pass
+    return None
 
 # fused-Program cache: steady-state loops rebuild chains from the same
 # stage Programs every iteration; re-composing (and re-jitting) per
@@ -78,6 +152,17 @@ _FALLBACKS = {
 _CACHE_LOCK = threading.Lock()
 _FUSED_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _FUSED_CACHE_MAX = 64
+
+
+def clear_fused_cache() -> None:
+    """Drop every cached fused Program. ``ops.segment.disable_pallas``
+    calls this when the pallas kill-switch trips: per-block aggregate
+    epilogues embed ``segment_sum``'s pallas-vs-XLA branch at TRACE
+    time, so a program traced while pallas was enabled would keep
+    failing from the cache forever — re-tracing after the switch picks
+    the XLA scatter and the fused path recovers."""
+    with _CACHE_LOCK:
+        _FUSED_CACHE.clear()
 
 
 def _input_specs(plan: SegmentPlan, schema):
@@ -353,6 +438,117 @@ def _run_per_stage(source, plan: SegmentPlan):
     return cur
 
 
+
+def _run_join(cur, plan: SegmentPlan):
+    """Execute a segment's trailing join node: gather the (pruned)
+    probe side, force the (pruned) build side, and run the SAME hash
+    join core the eager path runs (frame._hash_join_cols). Returns a
+    one-block frame holding exactly the join outputs the consumer
+    needs — build-side pushdown selects the right frame down to
+    ``right_needed`` first, so a lazy right chain never computes (or
+    match-expands) dead columns."""
+    from ..frame import (
+        TensorFrame,
+        _block_num_rows,
+        _hash_join_cols,
+        _merged_global_columns,
+    )
+
+    jn = plan.join_node
+    t0 = time.perf_counter()
+    right = jn.right
+    r_needed = set(plan.right_needed or [])
+    r_names = [n for n in right.schema.names if n in r_needed]
+    # the build side is an INDEPENDENT pipeline: escape the lowering
+    # re-entrancy guard so its select records on ITS plan and pushdown
+    # genuinely prunes the build chain (a guarded select would take the
+    # legacy pending path and force every build column first)
+    with ir.allow_planning():
+        if list(right.schema.names) != r_names:
+            right_p = right.select(r_names)
+        else:
+            right_p = right
+        rcols = _merged_global_columns(right_p, r_names, "join")
+    lcols = _merged_global_columns(cur, list(cur.schema.names), "join")
+    out = _hash_join_cols(lcols, rcols, jn.spec)
+    keep = list(plan.join_out_names)
+    out = {n: out[n] for n in keep}
+    # same observability contract as the eager join span: INPUT rows
+    profiling.record(
+        "join", time.perf_counter() - t0,
+        _block_num_rows(lcols) + _block_num_rows(rcols),
+    )
+    _FUSED_EPILOGUES["join"].inc()
+    return TensorFrame([out], jn.schema.select(keep))
+
+
+def _plan_segments(
+    source, nodes: Sequence[ir.PlanNode], final_names: Sequence[str]
+) -> List[SegmentPlan]:
+    """Split + backward needed-columns pass: segment k must produce what
+    segment k+1 reads off its source — k+1's fused inputs plus its
+    pass-through columns (join segments map the requirement back
+    through the join's rename tables, see rules.plan_segment)."""
+    segments = split_segments(nodes)
+    plans: List[Optional[SegmentPlan]] = [None] * len(segments)
+    need = list(final_names)
+    for k in range(len(segments) - 1, -1, -1):
+        src_names = (
+            source.schema.names if k == 0
+            else list(segments[k - 1][-1].schema.names)
+        )
+        plans[k] = plan_segment(segments[k], need, src_names)
+        req = set(plans[k].source_inputs) | set(plans[k].pass_through)
+        need = [n for n in src_names if n in req]
+    return plans
+
+
+def _run_one_segment(cur, plan: SegmentPlan, fusion_on: bool):
+    """Execute one segment (inner stages + optional trailing join) over
+    ``cur``, honoring the escape hatch and the runtime barriers."""
+    if not fusion_on:
+        cur = _run_per_stage(cur, plan)
+        return _run_join(cur, plan) if plan.has_join else cur
+    if not plan.included and not plan.has_filter:
+        # pushdown pruned every stage (or the segment was pure
+        # projection): no program to dispatch — just project
+        cur = _pruned_source(cur, plan.final_names)
+        return _run_join(cur, plan) if plan.has_join else cur
+    fused_ok = plan.fusable
+    reason = None
+    if fused_ok and any(
+        ir.program_has_callback(n.program) for n in plan.included
+    ):
+        fused_ok, reason = False, "host_callback"
+    if fused_ok and _segment_ragged(cur, plan.source_inputs):
+        fused_ok, reason = False, "ragged"
+    if reason is None:
+        # the cost model speaks only when no hard barrier already
+        # decided; its fuse/split choice is counted + traced
+        decision = _rules.decide_fuse(plan, _lowering_seconds_mean())
+        _note_decision(decision)
+        fused_ok = decision.kind == "fuse"
+    if fused_ok:
+        try:
+            cur = _run_fused(cur, plan)
+        except Exception as e:
+            from ..validation import ValidationError
+
+            if isinstance(e, (ValidationError, ValueError)):
+                raise  # genuine contract violations stay loud
+            logger.debug("fused segment failed, replaying "
+                         "per-stage: %s", e)
+            _FALLBACKS["trace_error"].inc()
+            cur = _run_per_stage(cur, plan)
+    else:
+        if reason is not None:
+            _FALLBACKS[reason].inc()
+        elif len(plan.included) <= 1:
+            _FALLBACKS["single_stage"].inc()
+        cur = _run_per_stage(cur, plan)
+    return _run_join(cur, plan) if plan.has_join else cur
+
+
 def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
     """Force a plan-carrying frame: lower its chain and return the final
     blocks (the frame's ``pending`` contract)."""
@@ -363,19 +559,7 @@ def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
             {n: b[n] for n in final_names} for b in source.blocks()
         ]
 
-    segments = split_segments(nodes)
-    # backward pass: segment k must produce what segment k+1 reads off
-    # its source — k+1's fused inputs plus its pass-through columns
-    plans: List[Optional[SegmentPlan]] = [None] * len(segments)
-    need = final_names
-    for k in range(len(segments) - 1, -1, -1):
-        src_names = (
-            source.schema.names if k == 0
-            else list(segments[k - 1][-1].schema.names)
-        )
-        plans[k] = plan_segment(segments[k], need, src_names)
-        req = set(plans[k].source_inputs) | set(plans[k].pass_through)
-        need = [n for n in src_names if n in req]
+    plans = _plan_segments(source, nodes, final_names)
 
     from ..config import get_config
 
@@ -388,43 +572,557 @@ def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
     cur = source
     with ir.lowering():
         for plan in plans:
-            if not fusion_on:
-                cur = _run_per_stage(cur, plan)
-                continue
-            if not plan.included and not plan.has_filter:
-                # pushdown pruned every stage (or the segment was pure
-                # projection): no program to dispatch — just project
-                cur = _pruned_source(cur, plan.final_names)
-                continue
-            fused_ok = plan.fusable
-            reason = None
-            if fused_ok and any(
-                ir.program_has_callback(n.program) for n in plan.included
-            ):
-                fused_ok, reason = False, "host_callback"
-            if fused_ok and _segment_ragged(cur, plan.source_inputs):
-                fused_ok, reason = False, "ragged"
-            if fused_ok:
-                try:
-                    cur = _run_fused(cur, plan)
-                except Exception as e:
-                    from ..validation import ValidationError
-
-                    if isinstance(e, (ValidationError, ValueError)):
-                        raise  # genuine contract violations stay loud
-                    logger.debug("fused segment failed, replaying "
-                                 "per-stage: %s", e)
-                    _FALLBACKS["trace_error"].inc()
-                    cur = _run_per_stage(cur, plan)
-            else:
-                if reason is not None:
-                    _FALLBACKS[reason].inc()
-                elif len(plan.included) <= 1:
-                    _FALLBACKS["single_stage"].inc()
-                cur = _run_per_stage(cur, plan)
+            cur = _run_one_segment(cur, plan, fusion_on)
     if _events.TRACER.enabled:
         _events.TRACER.emit_complete(
             "plan.execute", t_exec, time.perf_counter() - t_exec,
-            args={"segments": len(segments)}, cat="plan",
+            args={"segments": len(plans)}, cat="plan",
         )
     return [{n: b[n] for n in final_names} for b in cur.blocks()]
+
+
+# ---------------------------------------------------------------------------
+# whole-pipeline epilogues: aggregate / reduce fused onto the map chain
+# ---------------------------------------------------------------------------
+
+def _value_dtype(plan: SegmentPlan, schema, name: str):
+    """np dtype of value column ``name`` as the fused run produces it:
+    a stage output's spec dtype when computed, else the (demotion-
+    aware) source column dtype."""
+    from .. import dtypes as dt
+
+    for n in plan.included:
+        for o in (n.program.outputs or []):
+            if o.name == name:
+                return np.dtype(o.dtype.np_dtype)
+    col = schema[name]
+    d = dt.demote(col.dtype) if dt.demotion_active() else col.dtype
+    return np.dtype(d.np_dtype)
+
+
+def _compose_with_epilogue(
+    plan: SegmentPlan,
+    schema,
+    value_names: Sequence[str],
+    cache_key: tuple,
+    extra_specs: Sequence,
+    epilogue,
+    extra_pinned: tuple = (),
+):
+    """The shared compose-and-cache core of every epilogue builder:
+    demotion-aware input specs over the segment's source inputs plus
+    the pass-through value columns (plus any ``extra_specs``, e.g. the
+    segment-id slice), the fused-Program cache lookup/insert with
+    pinned-identity validation (stage programs + ``extra_pinned``, so
+    id() reuse can never alias a stale entry), and the stage-threading
+    function body. ``epilogue(env)`` maps the post-stage column
+    environment to the program outputs."""
+    import jax
+
+    from .. import dtypes as dt
+    from ..program import Program, TensorSpec, analyze_program
+
+    in_names = list(plan.source_inputs)
+    for x in value_names:
+        if x in plan.pass_through and x not in in_names:
+            in_names.append(x)
+    demote = dt.demotion_active()
+    in_specs = []
+    for name in in_names:
+        col = schema[name]
+        dtype = dt.demote(col.dtype) if demote else col.dtype
+        in_specs.append(TensorSpec(name, dtype, col.block_shape))
+    in_specs.extend(extra_specs)
+
+    key = (
+        cache_key,
+        tuple((id(n.program), n.rows, n.out_names) for n in plan.included),
+        tuple((s.name, s.dtype.name, tuple(s.shape.dims)) for s in in_specs),
+        bool(demote),
+    )
+    pinned_expect = tuple(n.program for n in plan.included) + tuple(
+        extra_pinned
+    )
+    with _CACHE_LOCK:
+        hit = _FUSED_CACHE.get(key)
+        if hit is not None:
+            fused, pinned = hit
+            if len(pinned) == len(pinned_expect) and all(
+                p is q for p, q in zip(pinned, pinned_expect)
+            ):
+                _FUSED_CACHE.move_to_end(key)
+                return fused
+
+    stages = [
+        (jax.vmap(n.program.fn) if n.rows else n.program.fn,
+         tuple(n.program.input_names), tuple(n.out_names))
+        for n in plan.included
+    ]
+
+    def fn(feeds: Dict[str, object]) -> Dict[str, object]:
+        env = dict(feeds)
+        for stage_fn, s_ins, s_outs in stages:
+            outs_ = stage_fn({k: env[k] for k in s_ins})
+            for k2 in s_outs:
+                env[k2] = outs_[k2]
+        return epilogue(env)
+
+    fused = analyze_program(Program(fn, in_specs))
+    with _CACHE_LOCK:
+        _FUSED_CACHE[key] = (fused, pinned_expect)
+        while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+            _FUSED_CACHE.popitem(last=False)
+    return fused
+
+
+def _fused_agg_program(plan: SegmentPlan, schema, seg_info, num_segments):
+    """Compose the segment's map stages with a segment-reduce epilogue
+    into ONE block-level Program: inputs are the stages' source columns,
+    any pass-through value columns, and the per-block ``__tftpu_seg__``
+    id slice; outputs are the ``[K, ...]`` partial tables (plus a count
+    table per mean). Cached by stage identity + op set + K, like the
+    plain fused-map Programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import dtypes as dt
+    from ..ops.segment import segment_sum as _segment_sum
+    from ..program import TensorSpec
+    from ..shape import Shape, Unknown
+
+    ops = tuple((x, op) for x, op, _ in seg_info)
+    K = int(num_segments)
+
+    def epilogue(env: Dict[str, object]) -> Dict[str, object]:
+        sids = env.pop("__tftpu_seg__")
+        outs: Dict[str, object] = {}
+        for x, op in ops:
+            v = env[x]
+            if op in ("reduce_sum", "reduce_mean"):
+                outs[x] = _segment_sum(v, sids, num_segments=K)
+                if op == "reduce_mean":
+                    outs["__cnt__" + x] = jax.ops.segment_sum(
+                        jnp.ones(v.shape[:1], v.dtype), sids,
+                        num_segments=K,
+                    )
+            elif op == "reduce_min":
+                outs[x] = jax.ops.segment_min(v, sids, num_segments=K)
+            else:  # reduce_max (callers gate the op set)
+                outs[x] = jax.ops.segment_max(v, sids, num_segments=K)
+        return outs
+
+    return _compose_with_epilogue(
+        plan, schema,
+        value_names=[x for x, _, _ in seg_info],
+        cache_key=("agg", ops, K),
+        extra_specs=[TensorSpec("__tftpu_seg__", dt.int32,
+                                Shape((Unknown,)))],
+        epilogue=epilogue,
+    )
+
+
+def _epilogue_value_bytes(
+    plan: SegmentPlan, schema, seg_info, n_rows: int
+) -> int:
+    """Estimated bytes of the mapped value columns (the concat
+    epilogue's device-residency cost; Unknown inner dims skipped so the
+    estimate never overclaims)."""
+    from ..shape import Unknown
+
+    total = 0
+    for x, _, _ in seg_info:
+        try:
+            dims = list(schema[x].cell_shape.dims)
+        except KeyError:
+            dims = []
+        if any(d == Unknown for d in dims):
+            continue
+        cell = 1
+        for d in dims:
+            cell *= int(d)
+        total += n_rows * cell * _value_dtype(plan, schema, x).itemsize
+    return total
+
+
+def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
+    """Force a plan-recorded keyed aggregate: fuse the upstream map
+    chain with a segment-reduce epilogue (strategy chosen by the cost
+    model), or fall back honestly — the per-stage chain replay plus the
+    eager host aggregate, counted by reason. The mapped value columns
+    are never host-materialized on any fused path."""
+    import jax.numpy as jnp
+
+    from ..config import get_config
+    from ..frame import _block_num_rows
+    from ..ops.keys import frame_group_ids
+    from ..ops.verbs import _empty_agg_blocks, _segment_reduce_best
+
+    t_exec = time.perf_counter()
+    source, nodes = ir.resolve_chain(node)
+    inner = [n for n in nodes if n is not node]
+    keys = list(node.keys)
+    out_names = list(node.out_names)
+    seg_info = list(node.spec)
+    need = list(dict.fromkeys(keys + out_names))
+    fusion_on = bool(get_config().plan_fusion)
+
+    def host_fallback(frame, reason: Optional[str]) -> List[Dict[str, object]]:
+        """Chain already executed into ``frame``; run the eager host
+        epilogue over it (bit-identical to TFTPU_FUSION=0)."""
+        if reason is not None:
+            c = _FALLBACKS.get(reason)
+            if c is not None:
+                c.inc()
+            f = node.frame()
+            if f is not None and reason in (
+                "computed_key", "ragged", "host_callback"
+            ):
+                ir.mark_unfused(f, "aggregate", {
+                    "computed_key": "group key is computed by a chained "
+                                    "stage (group by a source column, or "
+                                    "materialize the chain first)",
+                    "ragged": "value column holds ragged cells (run "
+                              "analyze() to densify)",
+                    "host_callback": "a chained stage contains a host "
+                                     "callback (keep callbacks out of "
+                                     "aggregated chains)",
+                }[reason])
+        if frame.num_rows == 0:
+            return _empty_agg_blocks(node.schema)
+        from ..ops.verbs import _host_fast_aggregate
+
+        out_key_cols, out_cols, _n = _host_fast_aggregate(
+            node.program, frame, keys, seg_info, out_names
+        )
+        block = dict(out_key_cols)
+        block.update({x: out_cols[x] for x in out_names})
+        profiling.record(
+            "aggregate", time.perf_counter() - t_exec, _n
+        )
+        return [block]
+
+    with ir.lowering():
+        if not inner:
+            return host_fallback(source, None)
+        plans = _plan_segments(source, inner, need)
+        mid = source
+        for plan in plans[:-1]:
+            mid = _run_one_segment(mid, plan, fusion_on)
+        last = plans[-1]
+
+        reason = None
+        if not fusion_on or last.has_join or last.has_filter or not last.included:
+            # join/filter-tailed pipelines run their tail through the
+            # plan (probe-side maps fused, pushdown applied) and apply
+            # the segment epilogue DIRECTLY on the tail's output — no
+            # user-visible intermediate frame ever exists, but the
+            # epilogue itself dispatched separately, so it does NOT
+            # count as fused (the join/filter tail already recorded its
+            # own in-plan execution). A bare pass-through tail (or the
+            # escape hatch) likewise takes the eager epilogue; none of
+            # these are fallbacks to count either.
+            cur = _run_one_segment(mid, last, fusion_on)
+            return host_fallback(cur, None)
+        computed = set()
+        for n in last.included:
+            computed |= set(n.out_names)
+        if any(k in computed for k in keys):
+            reason = "computed_key"
+        elif any(
+            ir.program_has_callback(n.program) for n in last.included
+        ):
+            reason = "host_callback"
+        elif _segment_ragged(mid, last.source_inputs):
+            reason = "ragged"
+        if reason is not None:
+            cur = _run_one_segment(mid, last, fusion_on)
+            return host_fallback(cur, reason)
+
+        # ---- fused epilogue -------------------------------------------
+        t0 = time.perf_counter()
+        src_cols = [
+            n for n in mid.schema.names
+            if n in set(last.source_inputs) | set(last.pass_through)
+        ]
+        pruned = _pruned_source(mid, src_cols)
+        blocks = pruned.blocks()
+        rows = [_block_num_rows(b) for b in blocks]
+        n_total = sum(rows)
+        if n_total == 0:
+            return _empty_agg_blocks(node.schema)
+        # group ids encode ONCE from the (cached) key dictionary —
+        # steady-state repeated aggregates skip the re-encode entirely
+        seg_ids, group_key_cols, num_groups = frame_group_ids(mid, keys)
+
+        ops_key = tuple((x, op) for x, op, _ in seg_info)
+        ops_and_dtypes = [
+            (op, _value_dtype(last, pruned.schema, x))
+            for x, op, _ in seg_info
+        ]
+        decision = _rules.decide_epilogue(
+            ops_and_dtypes, num_groups,
+            _epilogue_value_bytes(last, pruned.schema, seg_info, n_total),
+        )
+        _note_decision(decision)
+        k_eff, bucket_dec = _rules.decide_segment_bucket(
+            ops_key, num_groups
+        )
+        if bucket_dec is not None:
+            _note_decision(bucket_dec)
+
+        from ..ops.executor import gather_feeds
+
+        try:
+            if decision.kind == "epilogue_per_block":
+                fused = _fused_agg_program(
+                    last, pruned.schema, seg_info, k_eff
+                )
+                _LOWER_SECONDS.observe(time.perf_counter() - t0)
+                compiled = fused.compiled()
+                base_ins = [
+                    s.name for s in fused.inputs
+                    if s.name != "__tftpu_seg__"
+                ]
+                partials = []
+                off = 0
+                for b, nb in zip(blocks, rows):
+                    if nb == 0:
+                        continue
+                    feeds = gather_feeds(b, base_ins, fused)
+                    feeds["__tftpu_seg__"] = np.ascontiguousarray(
+                        seg_ids[off:off + nb], dtype=np.int32
+                    )
+                    off += nb
+                    partials.append(
+                        compiled.run_block(feeds, to_numpy=False)
+                    )
+                totals = dict(partials[0])
+                for p in partials[1:]:
+                    for x, op in ops_key:
+                        if op in ("reduce_sum", "reduce_mean"):
+                            totals[x] = totals[x] + p[x]
+                            if op == "reduce_mean":
+                                cx = "__cnt__" + x
+                                totals[cx] = totals[cx] + p[cx]
+                        elif op == "reduce_min":
+                            totals[x] = jnp.minimum(totals[x], p[x])
+                        else:
+                            totals[x] = jnp.maximum(totals[x], p[x])
+                out_cols = {}
+                for x, op in ops_key:
+                    v = totals[x]
+                    if op == "reduce_mean":
+                        c = totals["__cnt__" + x]
+                        c = c.reshape((-1,) + (1,) * (v.ndim - 1))
+                        v = (v / c).astype(totals[x].dtype)
+                    out_cols[x] = np.asarray(v)[:num_groups]
+            else:
+                # concat epilogue: fused map per block, outputs stay on
+                # device, ONE segment dispatch over the concatenation —
+                # the exact program + row order of the unfused path
+                from .. import dtypes as dt
+
+                parts: Dict[str, list] = {x: [] for x, _, _ in seg_info}
+                if last.included:
+                    fused_map = _fused_program(last, pruned.schema)
+                    _LOWER_SECONDS.observe(time.perf_counter() - t0)
+                    compiled = fused_map.compiled()
+                    for b, nb in zip(blocks, rows):
+                        if nb == 0:
+                            continue
+                        feeds = gather_feeds(
+                            b, fused_map.input_names, fused_map
+                        )
+                        outs = compiled.run_block(feeds, to_numpy=False)
+                        for x in last.computed_names:
+                            if x in parts:
+                                parts[x].append(outs[x])
+                seg_vals = {}
+                demote = dt.demotion_active()
+                for x, _, _ in seg_info:
+                    if parts[x]:
+                        seg_vals[x] = (
+                            parts[x][0] if len(parts[x]) == 1
+                            else jnp.concatenate(parts[x])
+                        )
+                    else:  # pass-through value column, straight off source
+                        vals = np.concatenate([
+                            np.asarray(b[x]) for b in blocks if len(b[x])
+                        ])
+                        if demote:
+                            tgt = dt.demote(pruned.schema[x].dtype)
+                            if vals.dtype != tgt.np_dtype:
+                                vals = vals.astype(tgt.np_dtype)
+                        seg_vals[x] = jnp.asarray(vals)
+                res = _segment_reduce_best(
+                    ops_key, k_eff, seg_vals, seg_ids
+                )
+                out_cols = {
+                    x: np.asarray(res[x])[:num_groups] for x, _ in ops_key
+                }
+        except Exception as e:
+            from ..validation import ValidationError
+
+            if isinstance(e, (ValidationError, ValueError)):
+                raise
+            logger.debug(
+                "fused aggregate epilogue failed, replaying eagerly: %s", e
+            )
+            cur = _run_one_segment(mid, last, fusion_on)
+            return host_fallback(cur, "trace_error")
+
+    _FUSED_STAGES.inc(len(last.included))
+    _FUSED_EPILOGUES["aggregate"].inc()
+    avoided = SegmentPlan(
+        nodes=[], included=[], excluded=[], final_names=[],
+        computed_names=[], pass_through=[], source_inputs=[],
+        mask_name=None,
+        avoided_outputs=[
+            (o.name, o)
+            for n in last.included for o in (n.program.outputs or [])
+        ],
+    )
+    _BYTES_AVOIDED.inc(_avoided_bytes(avoided, blocks))
+    block = dict(zip(keys, group_key_cols))
+    block.update({x: out_cols[x] for x in out_names})
+    profiling.record("aggregate", time.perf_counter() - t_exec, n_total)
+    if _events.TRACER.enabled:
+        _events.TRACER.emit_complete(
+            "plan.execute", t_exec, time.perf_counter() - t_exec,
+            args={"segments": len(plans), "verb": "aggregate",
+                  "epilogue": decision.kind}, cat="plan",
+        )
+    return [block]
+
+
+def lower_reduce(
+    frame, program, out_names: Sequence[str], mode: str
+) -> Optional[tuple]:
+    """Fuse a whole-frame reduce onto ``frame``'s recorded map chain:
+    one composed Program per block computes the chained stages AND the
+    reduce epilogue (the reduce program applied block-level for
+    ``reduce_blocks``; the pairwise lax.scan fold for ``reduce_rows``),
+    so the mapped columns are never materialized. Returns
+    ``(per_block_partials, input_rows)`` for the verbs' unchanged
+    combine step (the row count rides along so the caller's profiling
+    span never forces the still-lazy frame), or None when the chain is
+    ineligible (no plan, barriers, sharded/multi-process feeds) — the
+    caller then takes the eager path, which forces the frame through
+    the ordinary plan lowering."""
+    import jax
+
+    if getattr(frame, "_plan", None) is None or not ir.fusion_enabled():
+        return None
+    if frame.is_sharded or frame.is_materialized:
+        return None
+    if jax.process_count() > 1:
+        return None
+    # record the epilogue on the IR (branch bookkeeping included: a
+    # later consumer of the same lazy frame re-sources on it, so the
+    # shared prefix materializes once instead of refusing per branch)
+    node = ir.PlanNode(
+        "reduce",
+        parent=ir.node_for_parent(frame),
+        program=program,
+        out_names=list(out_names),
+        spec=mode,
+        schema=frame.schema,
+    )
+    node._extended = True  # terminal: nothing chains on a reduce
+    source, nodes = ir.resolve_chain(node)
+    inner = [n for n in nodes if n is not node]
+    if not inner or any(n.kind not in ("map", "select") for n in inner):
+        return None
+    plan = plan_segment(inner, list(out_names), source.schema.names)
+    if not plan.included:
+        return None
+    if any(ir.program_has_callback(n.program) for n in plan.included):
+        _FALLBACKS["host_callback"].inc()
+        return None
+    src_cols = [
+        n for n in source.schema.names
+        if n in set(plan.source_inputs) | set(plan.pass_through)
+    ]
+    pruned = _pruned_source(source, src_cols)
+    if _segment_ragged(pruned, plan.source_inputs):
+        _FALLBACKS["ragged"].inc()
+        return None
+
+    t0 = time.perf_counter()
+    fused = _fused_reduce_program(plan, pruned.schema, program,
+                                  list(out_names), mode)
+    _LOWER_SECONDS.observe(time.perf_counter() - t0)
+    from ..frame import _block_num_rows
+    from ..ops.executor import gather_feeds
+
+    compiled = fused.compiled()
+    partials: List[Dict[str, np.ndarray]] = []
+    blocks = pruned.blocks()
+    n_rows = 0
+    try:
+        for b in blocks:
+            nb = _block_num_rows(b)
+            if nb == 0:
+                continue
+            n_rows += nb
+            feeds = gather_feeds(b, fused.input_names, fused)
+            res = compiled.run_block(feeds, to_numpy=False)
+            partials.append({x: np.asarray(res[x]) for x in out_names})
+    except Exception as e:
+        from ..validation import ValidationError
+
+        if isinstance(e, (ValidationError, ValueError)):
+            raise
+        logger.debug("fused reduce failed, replaying eagerly: %s", e)
+        _FALLBACKS["trace_error"].inc()
+        return None
+    if not partials:
+        return None  # all-empty frame: the eager path owns the error
+    _FUSED_STAGES.inc(len(plan.included))
+    _FUSED_EPILOGUES["reduce_" + mode].inc()
+    avoided = [
+        (o.name, o)
+        for n in plan.included for o in (n.program.outputs or [])
+    ]
+    plan_for_bytes = SegmentPlan(
+        nodes=[], included=[], excluded=[], final_names=[],
+        computed_names=[], pass_through=[], source_inputs=[],
+        mask_name=None, avoided_outputs=avoided,
+    )
+    _BYTES_AVOIDED.inc(_avoided_bytes(plan_for_bytes, blocks))
+    return partials, n_rows
+
+
+def _fused_reduce_program(
+    plan: SegmentPlan, schema, reduce_program, out_names: List[str],
+    mode: str,
+):
+    """Compose map stages with a reduce epilogue into one block-level
+    Program: ``blocks`` mode applies the reduce program's function to
+    the chained columns under the ``x_input`` naming contract;
+    ``rows`` mode applies the SAME pairwise lax.scan fold the eager
+    reduce_rows runs (executor.pair_fold_body), so fold semantics
+    cannot diverge. Cached by stage + reduce-program identity."""
+    value_names = list(out_names)
+    if mode == "rows":
+        from ..ops.executor import pair_fold_body
+
+        fold = pair_fold_body(reduce_program, value_names)
+
+        def epilogue(env):
+            return fold({x: env[x] for x in value_names})
+    else:
+        def epilogue(env):
+            outs = reduce_program.fn(
+                {f"{x}_input": env[x] for x in value_names}
+            )
+            return {x: outs[x] for x in value_names}
+
+    return _compose_with_epilogue(
+        plan, schema,
+        value_names=value_names,
+        cache_key=("reduce", mode, id(reduce_program), tuple(out_names)),
+        extra_specs=[],
+        epilogue=epilogue,
+        extra_pinned=(reduce_program,),
+    )

@@ -3,7 +3,7 @@
 Functions from node chains to :class:`SegmentPlan` descriptions — no
 execution, no compilation; the only tracing is the (cached) host-
 callback probe that keeps effectful stages out of pushdown pruning.
-Three rules:
+Four rules:
 
 * **map∘map composition** — consecutive map stages compose into one
   traced function: map_rows stages contribute their already-vmapped
@@ -18,16 +18,39 @@ Three rules:
   the mask); the row subsetting itself is a fusion barrier (its output
   row count is data-dependent), so the chain splits after it and
   downstream stages start a new segment.
+* **join pushdown** — a trailing ``join`` node ends its segment (its
+  output row count is data-dependent, like a filter's), but the
+  needed-columns pass maps the segment's requirements back THROUGH the
+  join's rename tables: only the probe-side originals of needed output
+  columns flow into the upstream map fusion, and only the needed
+  build-side columns are read off the right frame.
+
+The module also hosts the **cost model** (:class:`Decision` and the
+``decide_*`` functions): pure functions from segment descriptions +
+memoized ``Program.cost_analysis`` + live metric readings to lowering
+choices (fuse vs split, aggregate-epilogue strategy, segment-count
+bucketing). The lowering (:mod:`.lower`) counts and traces every
+decision; this module never touches metrics itself.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Set, Tuple
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .ir import PlanNode, program_has_callback
 
-__all__ = ["SegmentPlan", "split_segments", "plan_segment"]
+__all__ = [
+    "SegmentPlan",
+    "split_segments",
+    "plan_segment",
+    "Decision",
+    "decide_fuse",
+    "decide_epilogue",
+    "decide_segment_bucket",
+    "reassoc_safe",
+]
 
 
 @dataclasses.dataclass
@@ -46,10 +69,21 @@ class SegmentPlan:
     #: either consumed by a later stage or pruned by a select; the
     #: intermediate-bytes-avoided accounting reads this
     avoided_outputs: List[Tuple[str, object]]
+    #: trailing join node (the segment ends at it) and the pruned
+    #: column sets the join actually reads: ``final_names`` then names
+    #: the PROBE-side columns the upstream fusion must produce, while
+    #: ``join_out_names`` names the join outputs the consumer needs.
+    join_node: Optional[PlanNode] = None
+    right_needed: Optional[List[str]] = None
+    join_out_names: Optional[List[str]] = None
 
     @property
     def has_filter(self) -> bool:
         return self.mask_name is not None
+
+    @property
+    def has_join(self) -> bool:
+        return self.join_node is not None
 
     @property
     def fusable(self) -> bool:
@@ -65,14 +99,15 @@ class SegmentPlan:
 
 
 def split_segments(nodes: Sequence[PlanNode]) -> List[List[PlanNode]]:
-    """Split a chain at filter nodes: a filter's data-dependent output
-    row count bars fusing across it, so it ends its segment (its mask
-    program still fuses upstream)."""
+    """Split a chain at filter and join nodes: both have data-dependent
+    output row counts, which bars fusing across them, so each ends its
+    segment (a filter's mask program — and a join's probe-side maps —
+    still fuse upstream)."""
     segs: List[List[PlanNode]] = []
     cur: List[PlanNode] = []
     for n in nodes:
         cur.append(n)
-        if n.kind == "filter":
+        if n.kind in ("filter", "join"):
             segs.append(cur)
             cur = []
     if cur:
@@ -91,7 +126,39 @@ def plan_segment(
     schema for the last segment; the next segment's source requirements
     otherwise). Stages none of whose outputs are needed are pruned —
     with their exclusive source inputs, which therefore never gather.
+
+    A segment ending in a ``join`` node maps the needed output columns
+    back through the join's rename tables first: the backward pass then
+    runs over the probe-side stages with the probe-side requirements,
+    and the build-side requirements are recorded as ``right_needed``.
     """
+    nodes = list(nodes)
+    join_node: Optional[PlanNode] = None
+    right_needed: Optional[List[str]] = None
+    join_out_names: Optional[List[str]] = None
+    if nodes and nodes[-1].kind == "join":
+        join_node = nodes[-1]
+        spec = join_node.spec
+        # keys are always required on both sides (they drive the match);
+        # non-key outputs map back to their side's original name
+        inv_l = {out: orig for orig, out in spec.lname}
+        inv_r = {out: orig for orig, out in spec.rname}
+        join_out_names = [
+            n for n in join_node.schema.names
+            if n in set(final_names) or n in spec.keys
+        ]
+        left_needed = list(spec.keys)
+        right_needed = list(spec.keys)
+        for name in join_out_names:
+            if name in spec.keys:
+                continue
+            if name in inv_l:
+                left_needed.append(inv_l[name])
+            elif name in inv_r:
+                right_needed.append(inv_r[name])
+        nodes = nodes[:-1]
+        final_names = left_needed
+
     needed: Set[str] = set(final_names)
     mask_name: Optional[str] = None
     included_rev: List[PlanNode] = []
@@ -161,6 +228,9 @@ def plan_segment(
         for o in (n.program.outputs or []):
             avoided.append((o.name, o))
 
+    # NOTE: ``nodes`` holds the segment's INNER (pre-join) nodes only;
+    # the trailing join rides in ``join_node`` so the per-stage replay
+    # and the fused result schema both see the probe-side chain.
     return SegmentPlan(
         nodes=list(nodes),
         included=included,
@@ -171,4 +241,178 @@ def plan_segment(
         source_inputs=source_inputs,
         mask_name=mask_name,
         avoided_outputs=avoided,
+        join_node=join_node,
+        right_needed=right_needed,
+        join_out_names=join_out_names,
+    )
+
+
+# ---------------------------------------------------------------------------
+# cost model: pure decision functions. The lowering counts + traces
+# every Decision (tftpu_plan_cost_decisions_total{decision=}); this
+# module only DECIDES, consulting the memoized Program.cost_analysis
+# and whatever live metric readings the caller hands in.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One lowering choice: ``kind`` is the pre-registered counter label
+    (``fuse`` | ``split_single_stage`` | ``epilogue_per_block`` |
+    ``epilogue_concat`` | ``bucket_segments``), ``reason`` the
+    human-readable why, ``details`` the numbers that drove it (logged on
+    the trace event so a decision is reconstructible post-hoc)."""
+
+    kind: str
+    reason: str
+    details: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+def _stage_costs(plan: SegmentPlan) -> Dict[str, float]:
+    """Summed memoized cost_analysis over the segment's included stages
+    (zero when a backend reports no costs, as some CPU builds do) —
+    never compiles: Program.cost_analysis memoizes per probe."""
+    flops = 0.0
+    bytes_accessed = 0.0
+    for n in plan.included:
+        try:
+            c = n.program.cost_analysis()
+            flops += float(c.get("flops", 0.0) or 0.0)
+            bytes_accessed += float(c.get("bytes accessed", 0.0) or 0.0)
+        except Exception:  # pragma: no cover - cost query must not gate
+            pass
+    return {"flops": flops, "bytes_accessed": bytes_accessed}
+
+
+def decide_fuse(
+    plan: SegmentPlan, lowering_seconds_mean: Optional[float] = None
+) -> Decision:
+    """Fuse-vs-split for one map segment. Composition is essentially
+    always a win once two stages (or a mask/pruning select) are in
+    play: the fused dispatch saves one executor round-trip, one
+    device<->host materialization, and one output-validation pass PER
+    ELIDED STAGE, while the composed program's cost is the sum of its
+    parts (XLA re-fuses the elementwise chain). A bare single map keeps
+    the single-verb path — fusing it buys nothing and would bypass the
+    specialized lead-dim bucketing."""
+    details = _stage_costs(plan)
+    details["stages"] = len(plan.included)
+    if lowering_seconds_mean is not None:
+        details["lowering_seconds_mean"] = round(lowering_seconds_mean, 6)
+    if plan.fusable:
+        why = (
+            f"{len(plan.included)} composable stage(s)"
+            + (", mask fuses upstream" if plan.has_filter else "")
+            + (
+                f", {len(plan.excluded)} stage(s) pruned"
+                if plan.excluded else ""
+            )
+        )
+        return Decision("fuse", why, details)
+    return Decision(
+        "split_single_stage",
+        "bare single map keeps the specialized single-verb path "
+        "(lead-dim bucketing included); fusing buys no elided dispatch",
+        details,
+    )
+
+
+#: ops whose cross-block tree-combine is exact for ANY value dtype
+_EXACT_COMBINE_OPS = ("reduce_min", "reduce_max")
+
+
+def reassoc_safe(op: str, np_dtype) -> bool:
+    """True when per-block partials of ``op`` tree-combine to the SAME
+    bits as one global reduction over row order: min/max always (order
+    free); sum/mean only for integer/bool values (exact associative
+    arithmetic). Float sums reassociate — the bit-identical contract
+    then requires the concat epilogue instead."""
+    import numpy as _np
+
+    if op in _EXACT_COMBINE_OPS:
+        return True
+    kind = _np.dtype(np_dtype).kind
+    return kind in ("i", "u", "b")
+
+
+def decide_epilogue(
+    ops_and_dtypes: Sequence[Tuple[str, object]],
+    num_groups: int,
+    value_bytes: float,
+) -> Decision:
+    """Aggregate-epilogue strategy for a fused map→aggregate segment.
+
+    * ``epilogue_per_block`` — the segment reduction fuses INTO each
+      block's program (one dispatch per block yields a ``[K, ...]``
+      partial table; tables tree-combine). Chosen when every (op,
+      value-dtype) pair is reassociation-safe: the combine is then
+      bit-identical to the unfused global reduction.
+    * ``epilogue_concat`` — the fused map runs per block with outputs
+      kept on device, the concatenated values feed ONE segment-reduce
+      dispatch (the very program the unfused host path runs, over the
+      same values in the same row order — bit-identical by
+      construction, at the cost of holding the mapped columns in
+      device memory once).
+    """
+    unsafe = [
+        (op, str(getattr(dt, "name", dt)))
+        for op, dt in ops_and_dtypes
+        if not reassoc_safe(op, dt)
+    ]
+    details = {
+        "num_groups": int(num_groups),
+        "value_bytes": int(value_bytes),
+        "ops": [op for op, _ in ops_and_dtypes],
+    }
+    if not unsafe:
+        return Decision(
+            "epilogue_per_block",
+            "all ops tree-combine exactly (min/max or integer sums): "
+            "per-block partial tables, mapped columns never leave the "
+            "dispatch",
+            details,
+        )
+    return Decision(
+        "epilogue_concat",
+        "float sum/mean reassociates across blocks — one segment "
+        f"dispatch over device-concatenated values keeps {unsafe} "
+        "bit-identical to the unfused path",
+        details,
+    )
+
+
+# Segment-count bucketing history: per (ops fingerprint), the distinct
+# group counts recently lowered. Varying K retraces the epilogue per
+# distinct count; once the history shows proliferation, round K up to
+# the next power of two (results are sliced back to the true K, so the
+# choice is invisible to callers).
+_K_LOCK = threading.Lock()
+_K_HISTORY: Dict[tuple, Set[int]] = {}
+_K_HISTORY_MAX = 64
+
+
+def decide_segment_bucket(
+    ops_key: tuple, num_groups: int
+) -> Tuple[int, Optional[Decision]]:
+    """Returns ``(effective_num_segments, decision)`` — ``decision`` is
+    non-None only when bucketing engaged (the caller counts it)."""
+    with _K_LOCK:
+        seen = _K_HISTORY.setdefault(ops_key, set())
+        seen.add(int(num_groups))
+        distinct = len(seen)
+        if len(_K_HISTORY) > _K_HISTORY_MAX:  # bound the module state
+            _K_HISTORY.pop(next(iter(_K_HISTORY)))
+    if distinct < 3:
+        return int(num_groups), None
+    k_pad = 1
+    while k_pad < num_groups:
+        k_pad <<= 1
+    if k_pad == num_groups:
+        return int(num_groups), None
+    return k_pad, Decision(
+        "bucket_segments",
+        f"{distinct} distinct group counts for this op set — pad "
+        f"segments {num_groups}->{k_pad} so the epilogue executable "
+        "is reused across counts (padded groups slice away)",
+        {"num_groups": int(num_groups), "padded": int(k_pad),
+         "distinct_counts": distinct},
     )

@@ -566,3 +566,435 @@ def test_sharded_chain_keeps_mesh_and_matches():
     np.testing.assert_array_equal(
         got, np.asarray(exp_frame.column_values("z"))
     )
+
+
+# ---------------------------------------------------------------------------
+# whole-pipeline compilation (ISSUE 7): aggregate / reduce / join
+# epilogues fuse into the plan; fused == unfused bit-identical
+# ---------------------------------------------------------------------------
+
+def _agg_chain(dtype, op, keys_kind="int", n=48, num_blocks=3):
+    """map->map->aggregate over a multi-block frame. Data is exact for
+    every dtype (small integers), so fused and unfused results must be
+    BIT-identical even for float sums."""
+    rng = np.random.default_rng(5)
+    cols = {"x": (np.arange(n) % 7).astype(dtype)}
+    if keys_kind == "int":
+        cols["k"] = rng.integers(0, 5, n).astype(np.int64)
+        fr = tfs.frame_from_arrays(cols, num_blocks=num_blocks)
+    else:
+        rows = [
+            {"k": f"g{rng.integers(0, 5)}", "x": dtype(int(v))}
+            for v in cols["x"]
+        ]
+        fr = tfs.frame_from_rows(rows, num_blocks=num_blocks)
+    f1 = tfs.map_blocks(lambda x: {"y": x + x}, fr)
+    f2 = f1.map_rows(lambda y: {"z": y * y})
+    with tfs.with_graph():
+        z_in = tfs.block(f2, "z", tf_name="z_input")
+        fetch = getattr(tfs, op)(z_in, axis=0, name="z")
+        agg = tfs.aggregate(fetch, f2.group_by("k"))
+    return agg.collect(), f2
+
+
+AGG_DTYPES = [np.int32, np.int64, np.float32, np.float64]
+AGG_OPS = ["reduce_sum", "reduce_min", "reduce_max", "reduce_mean"]
+
+
+@pytest.mark.parametrize("dtype", AGG_DTYPES, ids=lambda d: d.__name__)
+@pytest.mark.parametrize("op", AGG_OPS)
+def test_aggregate_epilogue_bit_identical(dtype, op):
+    fused, chain_frame = _agg_chain(dtype, op)
+    assert not chain_frame.is_materialized, (
+        "fused aggregate must not materialize the mapped chain"
+    )
+    unfused, _ = _unfused(lambda: _agg_chain(dtype, op))
+    _rows_equal(fused, unfused)
+
+
+@pytest.mark.parametrize("op", ["reduce_sum", "reduce_mean"])
+def test_aggregate_epilogue_string_keys_bit_identical(op):
+    fused, _ = _agg_chain(np.float32, op, keys_kind="str")
+    unfused, _ = _unfused(lambda: _agg_chain(np.float32, op, "str"))
+    _rows_equal(fused, unfused)
+
+
+def test_aggregate_epilogue_decisions_counted():
+    def count(kind):
+        key = ("tftpu_plan_cost_decisions_total", (("decision", kind),))
+        d = _snap().get(key)
+        return d["value"] if d else 0.0
+
+    pb0, cc0 = count("epilogue_per_block"), count("epilogue_concat")
+    _agg_chain(np.int64, "reduce_sum")   # int sum: exact tree-combine
+    assert count("epilogue_per_block") == pb0 + 1
+    _agg_chain(np.float32, "reduce_sum")  # float sum: concat epilogue
+    assert count("epilogue_concat") == cc0 + 1
+
+
+def test_aggregate_epilogue_metrics_and_laziness():
+    before = _snap().get(
+        ("tftpu_plan_fused_epilogues_total", (("verb", "aggregate"),))
+    )
+    before = before["value"] if before else 0.0
+    fused, chain_frame = _agg_chain(np.float32, "reduce_sum")
+    after = _snap()[
+        ("tftpu_plan_fused_epilogues_total", (("verb", "aggregate"),))
+    ]["value"]
+    assert after == before + 1
+    assert not chain_frame.is_materialized
+
+
+def test_aggregate_computed_key_falls_back_and_matches():
+    """A group key computed by a chained stage cannot pre-encode on the
+    host: the epilogue falls back (counted, TFG109-marked) and results
+    still match the escape hatch exactly."""
+    def build():
+        fr = tfs.frame_from_arrays(
+            {"x": (np.arange(24) % 6).astype(np.int64)}, num_blocks=2
+        )
+        f1 = tfs.map_blocks(lambda x: {"kk": x % 3, "y": x * 2}, fr)
+        with tfs.with_graph():
+            y_in = tfs.block(f1, "y", tf_name="y_input")
+            fetch = tfs.reduce_sum(y_in, axis=0, name="y")
+            return tfs.aggregate(fetch, f1.group_by("kk"))
+
+    key = ("tftpu_plan_fallback_total", (("reason", "computed_key"),))
+    b0 = _snap().get(key)
+    b0 = b0["value"] if b0 else 0.0
+    agg = build()
+    fused = agg.collect()
+    assert _snap()[key]["value"] == b0 + 1
+    rep = tfs.lint_plan(agg)
+    assert any(d.code == "TFG109" for d in rep)
+    _rows_equal(fused, _unfused(lambda: build().collect()))
+
+
+def test_aggregate_nonalgebraic_fetch_marks_tfg109():
+    fr = tfs.frame_from_arrays(
+        {"k": np.array([0, 1, 0, 1]), "x": np.arange(4, dtype=np.float32)}
+    )
+    f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, fr)
+    agg = tfs.aggregate(
+        lambda y_input: {"y": y_input.max(axis=0) - y_input.min(axis=0)},
+        f1.group_by("k"),
+    )
+    rep = tfs.lint_plan(agg)
+    assert any(d.code == "TFG109" for d in rep)
+    assert "non-algebraic" in next(
+        d for d in rep if d.code == "TFG109"
+    ).explain()
+
+
+def test_aggregate_ragged_source_falls_back_and_matches():
+    def build():
+        rows = [
+            {"k": i % 3, "v": np.arange(1 + i % 4, dtype=np.float32)}
+            for i in range(18)
+        ]
+        fr = tfs.frame_from_rows(rows, num_blocks=2)
+        f1 = tfs.map_rows(lambda v: {"s": v.sum()}, fr)
+        with tfs.with_graph():
+            s_in = tfs.block(f1, "s", tf_name="s_input")
+            fetch = tfs.reduce_sum(s_in, axis=0, name="s")
+            return tfs.aggregate(fetch, f1.group_by("k")).collect()
+
+    key = ("tftpu_plan_fallback_total", (("reason", "ragged"),))
+    b0 = _snap().get(key)
+    b0 = b0["value"] if b0 else 0.0
+    fused = build()
+    assert _snap()[key]["value"] >= b0 + 1
+    _rows_equal(fused, _unfused(build))
+
+
+def test_aggregate_empty_after_filter_keeps_schema():
+    def build():
+        fr = tfs.frame_from_arrays(
+            {"k": np.arange(8, dtype=np.int64),
+             "x": np.arange(8, dtype=np.float32)}, num_blocks=2
+        )
+        f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, fr)
+        f2 = f1.filter(lambda y: {"keep": y > 1e9})
+        with tfs.with_graph():
+            y_in = tfs.block(f2, "y", tf_name="y_input")
+            fetch = tfs.reduce_sum(y_in, axis=0, name="y")
+            return tfs.aggregate(fetch, f2.group_by("k"))
+
+    agg = build()
+    assert agg.num_rows == 0
+    assert agg.schema.names == ["k", "y"]
+    _rows_equal(agg.collect(), _unfused(lambda: build().collect()))
+
+
+def test_aggregate_one_compile_per_block_shape_steady_state():
+    n = 64
+    fr = tfs.frame_from_arrays(
+        {"k": (np.arange(n) % 4).astype(np.int64),
+         "x": (np.arange(n) % 8).astype(np.int64)},
+        num_blocks=4,
+    )
+    p1 = tfs.compile_program(lambda x: {"y": x * 2}, fr)
+    f0 = tfs.map_blocks(p1, fr)
+    with tfs.with_graph():
+        y_in = tfs.block(f0, "y", tf_name="y_input")
+        fetch = tfs.reduce_sum(y_in, axis=0, name="y")
+        agg_program = tfs.compile_program([fetch], f0, reduce_mode="blocks")
+
+    def run():
+        f1 = tfs.map_blocks(p1, fr)
+        return tfs.aggregate(agg_program, f1.group_by("k")).blocks()
+
+    run()  # warm: compiles once per block shape
+    m0 = _JIT_MISSES.value
+    run()
+    run()
+    assert _JIT_MISSES.value - m0 == 0
+
+
+def test_segment_bucket_decision_engages_on_varying_group_counts():
+    key = ("tftpu_plan_cost_decisions_total",
+           (("decision", "bucket_segments"),))
+    b0 = _snap().get(key)
+    b0 = b0["value"] if b0 else 0.0
+    for ng in (3, 5, 6, 7):  # 4 distinct counts for one op set
+        n = 40
+        fr = tfs.frame_from_arrays(
+            {"k": (np.arange(n) % ng).astype(np.int64),
+             "x": (np.arange(n) % 4).astype(np.int64)},
+            num_blocks=2,
+        )
+        f1 = tfs.map_blocks(lambda x: {"zq": x + 1}, fr)
+        with tfs.with_graph():
+            z_in = tfs.block(f1, "zq", tf_name="zq_input")
+            fetch = tfs.reduce_sum(z_in, axis=0, name="zq")
+            tfs.aggregate(fetch, f1.group_by("k")).blocks()
+    assert _snap()[key]["value"] > b0
+
+
+# -- reduce epilogues -------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", AGG_DTYPES, ids=lambda d: d.__name__)
+def test_reduce_blocks_fused_bit_identical(dtype):
+    def build():
+        fr = tfs.frame_from_arrays(
+            {"x": (np.arange(30) % 5).astype(dtype)}, num_blocks=3
+        )
+        f1 = tfs.map_blocks(lambda x: {"y": x + x}, fr)
+        f2 = f1.map_rows(lambda y: {"z": y * y})
+        out = tfs.reduce_blocks(
+            # dtype= pins the fetch dtype (int sums otherwise promote)
+            lambda z_input: {"z": z_input.sum(axis=0, dtype=z_input.dtype)},
+            f2,
+        )
+        return out, f2
+
+    fused, chain_frame = build()
+    assert not chain_frame.is_materialized
+    unfused, _ = _unfused(build)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int64],
+                         ids=lambda d: d.__name__)
+def test_reduce_rows_fused_bit_identical(dtype):
+    def build():
+        fr = tfs.frame_from_arrays(
+            {"x": (np.arange(17) % 5).astype(dtype)}, num_blocks=4
+        )
+        f1 = tfs.map_blocks(lambda x: {"y": x * dtype(2)}, fr)
+        out = tfs.reduce_rows(lambda y_1, y_2: {"y": y_1 + y_2}, f1)
+        return out, f1
+
+    fused, chain_frame = build()
+    assert not chain_frame.is_materialized
+    unfused, _ = _unfused(build)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
+def test_reduce_epilogue_metrics():
+    key = ("tftpu_plan_fused_epilogues_total", (("verb", "reduce_blocks"),))
+    b0 = _snap().get(key)
+    b0 = b0["value"] if b0 else 0.0
+    fr = tfs.frame_from_arrays({"x": np.arange(8, dtype=np.float32)})
+    f1 = tfs.map_blocks(lambda x: {"y": x + 1.0}, fr)
+    tfs.reduce_blocks(lambda y_input: {"y": y_input.sum(axis=0)}, f1)
+    assert _snap()[key]["value"] == b0 + 1
+
+
+def test_reduce_callback_chain_falls_back():
+    import jax
+
+    def cb(a):
+        return a + 1.0
+
+    def cb_stage(y):
+        return {
+            "z": jax.pure_callback(
+                cb, jax.ShapeDtypeStruct(y.shape, y.dtype), y
+            )
+        }
+
+    fr = tfs.frame_from_arrays({"x": np.arange(6, dtype=np.float32)},
+                               num_blocks=2)
+    f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, fr)
+    f2 = tfs.map_blocks(cb_stage, f1)
+    out = tfs.reduce_blocks(lambda z_input: {"z": z_input.sum(axis=0)}, f2)
+    exp = ((np.arange(6) * 2.0) + 1.0).sum()
+    assert float(out) == exp
+
+
+# -- joins in the plan ------------------------------------------------------
+
+def _join_frames(keys_kind="int"):
+    if keys_kind == "int":
+        left = tfs.frame_from_arrays(
+            {"k": np.array([0, 1, 2, 1, 3], np.int64),
+             "x": np.arange(5, dtype=np.float32)},
+            num_blocks=2,
+        )
+        right = tfs.frame_from_arrays(
+            {"k": np.array([1, 2, 4], np.int64),
+             "w": np.array([10.0, 20.0, 40.0], np.float32)},
+        )
+    else:
+        left = tfs.frame_from_rows(
+            [{"k": f"g{i % 3}", "x": float(i)} for i in range(6)],
+            num_blocks=2,
+        )
+        right = tfs.frame_from_rows(
+            [{"k": "g0", "w": 10.0}, {"k": "g2", "w": 20.0}],
+        )
+    return left, right
+
+
+@pytest.mark.parametrize("how,fill", [
+    ("inner", None), ("left", 0.0), ("right", 0.0), ("outer", -1.0),
+])
+@pytest.mark.parametrize("keys_kind", ["int", "str"])
+def test_join_plan_matches_unfused(how, fill, keys_kind):
+    def build():
+        left, right = _join_frames(keys_kind)
+        f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, left)
+        kw = {} if fill is None else {"fill_value": fill}
+        return f1.join(right, on="k", how=how, **kw).collect()
+
+    _rows_equal(build(), _unfused(build))
+
+
+def test_join_result_is_lazy_and_plan_carrying():
+    left, right = _join_frames()
+    j = left.join(right, on="k")
+    assert not j.is_materialized
+    assert getattr(j, "_plan", None) is not None
+    assert "join(on=['k'], how='inner')" in tfs.explain_plan(j)
+
+
+def test_join_pushdown_prunes_both_sides():
+    """A select after the join prunes dead columns through it on BOTH
+    sides: wide stage outputs nobody reads are never computed and their
+    wide source inputs never gather — probe chain and build chain
+    alike (asserted via the executor's gather-bytes counter)."""
+    wide = 256
+    n = 64
+
+    def build(select_cols):
+        left = tfs.frame_from_arrays(
+            {
+                "k": (np.arange(n) % 8).astype(np.int64),
+                "x": np.arange(n, dtype=np.float32),
+                "lsrc": np.ones((n, wide), np.float32),
+            },
+            num_blocks=2,
+        )
+        # the build side is LARGER than the probe side, so the assertion
+        # below fails unless pushdown genuinely prunes the build chain
+        # too (probe-side savings alone cannot carry the 4x margin)
+        nr = 2048
+        right_src = tfs.frame_from_arrays(
+            {
+                "k": (np.arange(nr) % 8).astype(np.int64),
+                "w": np.arange(nr, dtype=np.float32),
+                "rsrc": np.ones((nr, wide), np.float32),
+            },
+        )
+        right = tfs.map_blocks(lambda rsrc: {"rbig": rsrc * 2.0}, right_src)
+        f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, left)
+        f2 = tfs.map_blocks(lambda lsrc: {"lbig": lsrc * 2.0}, f1)
+        return f2.join(right, on="k").select(select_cols).collect()
+
+    g0 = _GATHER_BYTES.value
+    build(["k", "y", "w"])
+    pruned_bytes = _GATHER_BYTES.value - g0
+    g0 = _GATHER_BYTES.value
+    build(["k", "y", "w", "lbig", "rbig"])
+    full_bytes = _GATHER_BYTES.value - g0
+    assert pruned_bytes < full_bytes / 4, (pruned_bytes, full_bytes)
+
+
+def test_map_join_aggregate_pipeline_bit_identical():
+    """The chain3_join bench shape at test size: probe maps fuse, the
+    join runs in-plan, the aggregate epilogue consumes the join output
+    — bit-identical to the per-stage replay, zero steady-state
+    compiles."""
+    n, ng = 96, 8
+
+    def build():
+        rng = np.random.default_rng(2)
+        left = tfs.frame_from_arrays(
+            {
+                "k": rng.integers(0, ng, n).astype(np.int32),
+                "x": (np.arange(n) % 16).astype(np.float32),
+                "dead": np.ones(n, np.float32),
+            },
+            num_blocks=3,
+        )
+        right = tfs.frame_from_arrays(
+            {"k": np.arange(ng, dtype=np.int32),
+             "w": np.arange(ng, dtype=np.float32)},
+        )
+        f1 = tfs.map_blocks(lambda x: {"y": x * 2.0 + 1.0}, left)
+        f2 = tfs.map_blocks(lambda y: {"z": y * y}, f1)
+        j = f2.join(right, on="k")
+        with tfs.with_graph():
+            z_in = tfs.block(j, "z", tf_name="z_input")
+            w_in = tfs.block(j, "w", tf_name="w_input")
+            fz = tfs.reduce_sum(z_in, axis=0, name="z")
+            fw = tfs.reduce_sum(w_in, axis=0, name="w")
+            return tfs.aggregate([fz, fw], j.group_by("k")).collect()
+
+    _rows_equal(build(), _unfused(build))
+
+
+def test_tfg109_counter_is_preregistered():
+    prom = REGISTRY.to_prometheus()
+    assert 'tftpu_analysis_diagnostics_total{code="TFG109"}' in prom
+    for name in (
+        "tftpu_plan_fused_epilogues_total",
+        "tftpu_plan_cost_decisions_total",
+    ):
+        assert name in prom
+
+
+def test_join_lossy_fill_raises_even_when_pruned():
+    """Pushdown must not launder a lossy fill: a fill that cannot
+    represent exactly in a column's dtype raises at join() time, even
+    if a later select prunes that column out of the fused pipeline —
+    fused and TFTPU_FUSION=0 must fail identically."""
+    left = tfs.frame_from_arrays(
+        {"k": np.array([0, 1, 9], np.int64),
+         "x": np.arange(3, dtype=np.float32)},
+    )
+    right = tfs.frame_from_arrays(
+        {"k": np.array([0, 1], np.int64),
+         "w": np.array([1.0, 2.0], np.float32),
+         "tag": np.array([7, 8], np.int64)},
+    )
+    f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, left)
+    for fused in (True, False):
+        tfs.configure(plan_fusion=fused)
+        with pytest.raises(ValueError, match="representable"):
+            f1.join(
+                right, on="k", how="left",
+                fill_value={"w": 0.0, "tag": -1.5},
+            ).select(["k", "y", "w"]).collect()
+    tfs.configure(plan_fusion=True)

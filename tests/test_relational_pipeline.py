@@ -1,0 +1,137 @@
+"""Whole-pipeline relational correctness — map→join→aggregate chains
+checked against plain-numpy references.
+
+Unlike tests/test_plan.py (which pins the fusion knob to compare the
+fused and per-stage executions against each other), this suite honors
+the AMBIENT ``TFTPU_FUSION`` configuration: under tier-1 it exercises
+the plan-fused pipelines, and under the CI fusion-off smoke step the
+very same assertions hold for the per-stage replay — the two runs
+together are the end-to-end statement that fusion changes *when* work
+happens, never *what* computes."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+
+
+def _np_group_sum(keys, vals):
+    return {k: vals[keys == k].sum(dtype=np.float64) for k in np.unique(keys)}
+
+
+def test_map_join_aggregate_pipeline_matches_numpy():
+    rng = np.random.default_rng(11)
+    n, ng = 200, 8
+    k = rng.integers(0, ng, n).astype(np.int32)
+    x = (np.arange(n) % 16).astype(np.float32)
+    left = tfs.frame_from_arrays({"k": k, "x": x}, num_blocks=3)
+    right = tfs.frame_from_arrays(
+        {"k": np.arange(ng, dtype=np.int32),
+         "w": (np.arange(ng) * 3.0).astype(np.float32)},
+    )
+    f1 = tfs.map_blocks(lambda x: {"y": x * 2.0 + 1.0}, left)
+    f2 = tfs.map_blocks(lambda y: {"z": y * y}, f1)
+    j = f2.join(right, on="k")
+    with tfs.with_graph():
+        z_in = tfs.block(j, "z", tf_name="z_input")
+        w_in = tfs.block(j, "w", tf_name="w_input")
+        fz = tfs.reduce_sum(z_in, axis=0, name="z")
+        fw = tfs.reduce_sum(w_in, axis=0, name="w")
+        agg = tfs.aggregate([fz, fw], j.group_by("k"))
+    rows = {r["k"]: r for r in agg.collect()}
+
+    z = (x * 2.0 + 1.0) ** 2
+    exp_z = _np_group_sum(k, z)
+    counts = np.bincount(k, minlength=ng)
+    assert set(rows) == set(int(g) for g in np.unique(k))
+    for g, expected in exp_z.items():
+        got = rows[int(g)]
+        np.testing.assert_allclose(got["z"], expected, rtol=1e-6)
+        np.testing.assert_allclose(
+            got["w"], counts[g] * g * 3.0, rtol=1e-6
+        )
+
+
+@pytest.mark.parametrize("how,fill,exp_rows", [
+    ("inner", None, 4),
+    ("left", -1.0, 5),
+    ("outer", -1.0, 6),
+])
+def test_join_after_map_matches_reference(how, fill, exp_rows):
+    left = tfs.frame_from_arrays(
+        {"k": np.array([0, 1, 2, 1, 5], np.int64),
+         "x": np.arange(5, dtype=np.float32)},
+        num_blocks=2,
+    )
+    right = tfs.frame_from_arrays(
+        {"k": np.array([0, 1, 2, 7], np.int64),
+         "w": np.array([10.0, 20.0, 30.0, 70.0], np.float32)},
+    )
+    f1 = tfs.map_blocks(lambda x: {"y": x + 0.5}, left)
+    kw = {} if fill is None else {"fill_value": fill}
+    out = f1.join(right, on="k", how=how, **kw)
+    rows = out.collect()
+    assert len(rows) == exp_rows
+    for r in rows:
+        if r["k"] in (0, 1, 2):  # matched rows carry both sides
+            assert r["w"] == {0: 10.0, 1: 20.0, 2: 30.0}[r["k"]]
+            assert r["y"] == r["x"] + 0.5
+        elif r["k"] == 5:  # unmatched left
+            assert r["w"] == -1.0
+        elif r["k"] == 7:  # unmatched right (outer only)
+            assert r["x"] == -1.0 and r["y"] == -1.0
+
+
+def test_reduce_after_map_chain_matches_numpy():
+    x = np.arange(101, dtype=np.float64)
+    fr = tfs.frame_from_arrays({"x": x}, num_blocks=4)
+    f1 = tfs.map_blocks(lambda x: {"y": x * 3.0}, fr)
+    f2 = f1.map_rows(lambda y: {"z": y + 1.0})
+    total = tfs.reduce_blocks(
+        lambda z_input: {"z": z_input.sum(axis=0)}, f2
+    )
+    np.testing.assert_allclose(float(total), (x * 3.0 + 1.0).sum())
+    pair = tfs.reduce_rows(lambda z_1, z_2: {"z": z_1 + z_2}, f2)
+    np.testing.assert_allclose(float(pair), (x * 3.0 + 1.0).sum())
+
+
+def test_string_key_aggregate_after_map_matches_numpy():
+    rows = [
+        {"k": f"grp{i % 3}", "v": float(i)} for i in range(30)
+    ]
+    fr = tfs.frame_from_rows(rows, num_blocks=2)
+    f1 = tfs.map_blocks(lambda v: {"y": v * 2.0}, fr)
+    with tfs.with_graph():
+        y_in = tfs.block(f1, "y", tf_name="y_input")
+        agg = tfs.aggregate(
+            tfs.reduce_sum(y_in, axis=0, name="y"), f1.group_by("k")
+        )
+    got = {r["k"]: r["y"] for r in agg.collect()}
+    v = np.arange(30, dtype=np.float64) * 2.0
+    for g in range(3):
+        np.testing.assert_allclose(
+            got[f"grp{g}"], v[np.arange(30) % 3 == g].sum(), rtol=1e-6
+        )
+
+
+def test_filter_then_aggregate_pipeline():
+    fr = tfs.frame_from_arrays(
+        {"k": (np.arange(40) % 4).astype(np.int64),
+         "x": np.arange(40, dtype=np.float32)},
+        num_blocks=3,
+    )
+    f1 = tfs.map_blocks(lambda x: {"y": x * 2.0}, fr)
+    f2 = f1.filter(lambda y: {"keep": y >= 20.0})
+    with tfs.with_graph():
+        y_in = tfs.block(f2, "y", tf_name="y_input")
+        agg = tfs.aggregate(
+            tfs.reduce_sum(y_in, axis=0, name="y"), f2.group_by("k")
+        )
+    got = {r["k"]: r["y"] for r in agg.collect()}
+    x = np.arange(40, dtype=np.float64)
+    y = x * 2.0
+    mask = y >= 20.0
+    for g in range(4):
+        np.testing.assert_allclose(
+            got[g], y[mask & (np.arange(40) % 4 == g)].sum(), rtol=1e-6
+        )

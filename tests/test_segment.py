@@ -131,6 +131,10 @@ def test_aggregate_retries_after_kernel_compile_failure(monkeypatch):
     from functools import lru_cache
 
     monkeypatch.setattr(verbs, "_seg_fast_for", lru_cache(maxsize=8)(flaky))
+    # pin the JITTED segment path: on the CPU backend float sums
+    # normally take the host bincount lowering (no kernel to fail),
+    # which would leave the retry-under-test unreached
+    monkeypatch.setattr(segment, "host_segment_eligible", lambda *a: False)
     was = segment._pallas_disabled
     try:
         segment._pallas_disabled = False
