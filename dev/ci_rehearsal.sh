@@ -136,8 +136,14 @@ run_step "Observability smoke (telemetry example + artifact check)" bash -c "
   test -f '$WORK/obs/tier1_diagnostics.jsonl'
 "
 
+# ci.yml's fleet chaos-drill step: kill-rank + hung-collective +
+# drop-heartbeat on a 2-process CPU fleet, with the flight black box
+# spooled next to the other observability artifacts
+run_step "Fleet chaos drill (kill-rank + hung-collective + drop-heartbeat)" \
+  env TFTPU_FLIGHT_DIR="$WORK/obs/flight" bash "$CLONE/dev/resilience_drill.sh" --only fleet-chaos
+
 run_step "Resilience drill (kill–resume, corrupted restore, fault injection)" \
-  bash "$CLONE/dev/resilience_drill.sh"
+  bash "$CLONE/dev/resilience_drill.sh" --skip fleet-chaos
 
 run_step "Bench smoke (CPU fallback)" bash -c \
   "set -o pipefail; python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()\" | tee bench_out.txt"

@@ -13,8 +13,18 @@ Exercises the acceptance surface of the resilience subsystem end-to-end:
 3. **transient-IO fault absorption**: ``checkpoint.save`` +
    ``io.prefetch.device_put`` faults injected every 2nd attempt must be
    fully absorbed by the retry policies (zero surviving failures).
+4. **fleet chaos** (ISSUE 8): on a 2-process CPU subprocess fleet —
+   kill-rank (SIGKILL a non-zero rank mid-``run_resumable``; the
+   supervisor must restart and the resumed run converge bit-identically),
+   hung-collective (delay-collective injection must trip the dispatch
+   deadline watchdog with a postmortem naming the missing rank), and
+   drop-heartbeat (the silent rank must be detected and the peer abort
+   coordinated).
 
 Run: ``python dev/resilience_drill.py`` (or ``dev/resilience_drill.sh``).
+``--only NAME`` / ``--skip NAME`` select drills (CI runs the fleet leg
+separately with ``TFTPU_FLIGHT_DIR`` armed so the black box ships in the
+observability artifact).
 """
 
 from __future__ import annotations
@@ -108,12 +118,111 @@ def drill_transient_faults(root: str) -> str:
             f"device_put: {put_inj.fired} fired), zero surviving failures")
 
 
-def main() -> int:
+_BLACKBOX_WORKER = """
+import contextlib, os, sys, time
+root = sys.argv[1]
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from tensorframes_tpu.checkpoint import Checkpointer
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.training import run_resumable
+
+rank = int(os.environ["TFTPU_PROCESS_INDEX"])
+attempt = int(os.environ.get("TFTPU_FLEET_ATTEMPT", "0"))
+stack = contextlib.ExitStack()
+if rank == 1 and attempt == 0:
+    stack.enter_context(faults.inject(
+        "fleet.rank.kill", faults.KillRank, after=2, max_times=1,
+    ))
+
+def step(state, batch):
+    time.sleep(0.02)
+    return {"w": state["w"] + batch}, {"loss": 0.0}
+
+run_resumable(
+    step, {"w": jnp.zeros((2,))},
+    Checkpointer(os.path.join(root, "ck", f"r{rank}"), backend="npz"),
+    [jnp.ones((2,))] * 10, num_steps=10, save_every=2,
+)
+"""
+
+
+def drill_fleet_chaos(root: str) -> str:
+    """Delegate to tests/test_fleet.py's chaos trio — kill-rank
+    restart-resume, hung-collective watchdog, drop-heartbeat detection —
+    the single source of the fleet acceptance logic, so the drill and
+    the suite cannot drift. When the caller arms ``TFTPU_FLIGHT_DIR``
+    (CI does), the drill additionally runs a supervised 2-rank
+    kill-rank fleet whose flight spool points AT that directory — the
+    pytest legs pin their black boxes to pytest temp dirs, so this is
+    what actually ships a fleet black box in the artifact."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_fleet.py", "-q",
+         "-p", "no:cacheprovider", "-m", "not slow",
+         "-k", "kill9 or hung_collective or drop_heartbeat"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"fleet chaos tests failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+    shipped = ""
+    flight_dir = os.environ.get("TFTPU_FLIGHT_DIR")
+    if flight_dir:
+        from tensorframes_tpu.resilience import supervise
+
+        result = supervise(
+            [sys.executable, "-c", _BLACKBOX_WORKER, root], 2,
+            rendezvous_dir=os.path.join(root, "fleet"),
+            flight_dir=os.path.abspath(flight_dir),
+            max_restarts=1, grace_s=5.0,
+            env={"JAX_PLATFORMS": "cpu",
+                 "TFTPU_HEARTBEAT_INTERVAL_S": "0.1",
+                 "TFTPU_HEARTBEAT_TIMEOUT_S": "2.0"},
+        )
+        if not (result.ok and result.restarts == 1):
+            raise AssertionError(
+                f"black-box fleet exercise did not restart-recover: "
+                f"{result}"
+            )
+        n = len([f for f in os.listdir(flight_dir)
+                 if f.startswith(("flight_", "postmortem_"))])
+        if n == 0:
+            raise AssertionError(
+                f"no flight black box landed in {flight_dir}"
+            )
+        shipped = f"; black box ({n} spool/postmortem files) → {flight_dir}"
+    return ("kill-rank restarted+resumed bit-identically, hung collective "
+            "tripped the deadline watchdog naming the missing rank, "
+            "drop-heartbeat detected with coordinated abort" + shipped)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--only", action="append", default=[],
+                        help="run only the named drill(s)")
+    parser.add_argument("--skip", action="append", default=[],
+                        help="skip the named drill(s)")
+    args = parser.parse_args(argv)
     drills = [
         ("kill-resume", drill_kill_resume),
         ("corrupted-restore", drill_corrupted_restore),
         ("transient-faults", drill_transient_faults),
+        ("fleet-chaos", drill_fleet_chaos),
     ]
+    names = [n for n, _ in drills]
+    for sel in args.only + args.skip:
+        if sel not in names:
+            print(f"unknown drill {sel!r}; available: {', '.join(names)}")
+            return 2
+    if args.only:
+        drills = [(n, f) for n, f in drills if n in args.only]
+    if args.skip:
+        drills = [(n, f) for n, f in drills if n not in args.skip]
     failures = 0
     with tempfile.TemporaryDirectory() as root:
         for name, fn in drills:

@@ -103,8 +103,8 @@ from .ops.verbs import (  # noqa: E402,F401
 )
 from .checkpoint import Checkpointer, CheckpointCorruptionError  # noqa: E402,F401
 from .training import run_resumable  # noqa: E402,F401
-from . import resilience  # noqa: E402,F401
-from .resilience import RetryPolicy, StepGuard  # noqa: E402,F401
+from . import resilience  # noqa: E402,F401  (registers tftpu_fleet_* metrics)
+from .resilience import RetryPolicy, StepGuard, supervise  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from .io import (  # noqa: E402,F401
     frame_from_arrow,
@@ -156,6 +156,7 @@ __all__ = [
     "resilience",
     "RetryPolicy",
     "StepGuard",
+    "supervise",
     "run_resumable",
     "profiling",
     "observability",

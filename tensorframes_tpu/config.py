@@ -27,6 +27,11 @@ def _env_int(name: str, default: int) -> int:
     return default if v is None else int(v)
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
+
+
 @dataclasses.dataclass
 class Config:
     # Enable float64/int64 end-to-end (the reference's Double/Long columns).
@@ -114,6 +119,24 @@ class Config:
     # escape hatch back to per-stage execution (bit-identical results;
     # the fused path exists purely for speed).
     plan_fusion: bool = _env_bool("TFTPU_FUSION", True)
+    # Hung-dispatch watchdog (resilience/fleet.py): a dispatch — or a
+    # fleet rendezvous barrier — that exceeds this wall-clock deadline
+    # aborts with HungDispatchError plus a flight-recorder postmortem
+    # naming the unresponsive ranks, instead of blocking forever inside
+    # a collective whose peer died. 0 disables (the default: deadline
+    # mode synchronizes dispatch results, trading async pipelining for
+    # boundedness, so it is opt-in). Enforced in ops/executor.py and
+    # parallel/distributed.py.
+    dispatch_deadline_s: float = _env_float("TFTPU_DISPATCH_DEADLINE_S", 0.0)
+    # Fleet heartbeat cadence: every process enrolled in a rendezvous
+    # dir (TFTPU_FLEET_DIR; supervise() arms it for its children)
+    # publishes a beat this often ...
+    heartbeat_interval_s: float = _env_float("TFTPU_HEARTBEAT_INTERVAL_S", 0.25)
+    # ... and a rank whose newest beat is older than this is declared
+    # dead (stragglers are flagged at half the timeout). Must comfortably
+    # exceed the longest host-side stall a healthy rank can hit (GC,
+    # checkpoint fsync, XLA compile on the driving thread).
+    heartbeat_timeout_s: float = _env_float("TFTPU_HEARTBEAT_TIMEOUT_S", 5.0)
     # Demote f64/i64 device columns to f32/i32 at the device boundary:
     # False = never (reference-parity precision, f64 emulated on TPU),
     # True = on TPU backends only, "always" = every backend (testing /

@@ -7,8 +7,13 @@ the system doing right before?* The trace buffer answers it only if
 someone was exporting traces; the metrics registry only in aggregate.
 This module keeps a bounded, always-on ring of the recent
 **operational** events (dispatches, retries, guard trips, fault
-injections, checkpoint IO) and turns it into a redacted JSONL dump at
-the moment of death.
+injections, checkpoint IO — and, since the fleet-supervision layer, the
+``fleet.*`` record family: ``fleet.heartbeat_lost``,
+``fleet.straggler``, ``fleet.abort`` / ``fleet.abort_seen`` /
+``fleet.self_abort``, ``fleet.hung_dispatch``, ``fleet.rank_dead``,
+``fleet.restart``) and turns it into a redacted JSONL dump at the
+moment of death, so ``read_blackbox()`` shows the whole fleet's history
+after a crash.
 
 Two storage layers:
 

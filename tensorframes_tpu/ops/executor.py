@@ -36,10 +36,18 @@ from ..observability import latency as _latency
 from ..observability.metrics import counter as _counter
 from ..observability.metrics import histogram as _histogram
 from ..program import Program
-from ..resilience.faults import fault_point
+from ..resilience import fleet as _fleet
+from ..resilience.faults import delay_point, fault_point, register_site
 from ..utils import get_logger
 
 logger = get_logger(__name__)
+
+register_site(
+    "executor.dispatch",
+    "CompiledProgram._run dispatch body, inside the deadline-watchdog "
+    "scope — an injected Delay simulates a hung collective (the "
+    "dispatch stalls instead of failing) so the watchdog is drillable",
+)
 
 # Registered at import so the exposition always carries the executor
 # family (a cold cache reads hits=0, it does not vanish). "Hit" means
@@ -485,11 +493,44 @@ class CompiledProgram:
                     built = self._build_aot(kind, akey, feeds, donate)
                     if built is not None:
                         call = built[0]
+            deadline = _fleet.dispatch_deadline_s()
+            if deadline and call is None and fresh:
+                # legacy jit path, first dispatch at this shape: the XLA
+                # compile happens lazily INSIDE the call (the AOT path
+                # compiles outside the watchdog, above). A 20-40s TPU
+                # compile is not a hung collective — and under
+                # supervise() a deterministic compile > deadline would
+                # burn the whole restart budget without any rank ever
+                # being hung. First-compile dispatches are therefore
+                # exempt; warmed/steady-state dispatches stay bounded.
+                deadline = 0.0
+
+            def _invoke():
+                delay_point("executor.dispatch")
+                r = (
+                    call(feeds) if call is not None
+                    else self._legacy_call(kind, key, feeds, donate)
+                )
+                if deadline:
+                    # deadline mode synchronizes: a collective wedged on
+                    # a dead peer must hang INSIDE the watchdog scope,
+                    # not at a later np.asarray outside it
+                    r = jax.block_until_ready(r)
+                return r
+
             t0 = time.perf_counter()
-            if call is not None:
-                out = call(feeds)
+            if deadline:
+                out = _fleet.run_with_deadline(
+                    _invoke,
+                    describe=(
+                        f"executor.run_"
+                        f"{'block' if kind == 'block' else 'rows'}"
+                        f"[{','.join(self.program.fetch_order[:4])}]"
+                    ),
+                    deadline=deadline,
+                )
             else:
-                out = self._legacy_call(kind, key, feeds, donate)
+                out = _invoke()
             dt = time.perf_counter() - t0
         except BaseException as e:
             _flight.record(

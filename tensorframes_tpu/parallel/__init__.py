@@ -1,4 +1,5 @@
 from .distributed import (
+    fleet_barrier,
     frame_from_process_local,
     init_distributed,
     is_multiprocess,
@@ -13,6 +14,7 @@ __all__ = [
     "BATCH_AXIS",
     "batch_sharding",
     "device_count",
+    "fleet_barrier",
     "init_distributed",
     "is_multiprocess",
     "frame_from_process_local",

@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 
 from ..observability import context as obs_context
+from ..resilience import fleet as _fleet
 from ..resilience.faults import fault_point
 from ..resilience.retry import RetryPolicy, retry_call
 from ..utils import get_logger
@@ -44,7 +45,11 @@ def init_distributed(
     ``retry`` (a :class:`~tensorframes_tpu.resilience.RetryPolicy`)
     re-attempts the coordinator handshake: in a preemption-restart fleet
     the workers race the coordinator back up, and the losers must back
-    off and redial instead of dying at t=0.
+    off and redial instead of dying at t=0. ``retry.deadline_s`` caps
+    the **total** redial budget (a flaky coordinator must not stretch
+    init unboundedly), and ``configure(dispatch_deadline_s=)``
+    additionally bounds each handshake attempt via the hung-dispatch
+    watchdog.
     """
     global _initialized
     if _initialized:
@@ -60,14 +65,52 @@ def init_distributed(
         os.environ.get("JAX_PROCESS_ID", "0")
     )
 
+    def _handshake_live() -> bool:
+        """True when a previously-abandoned (deadline-expired) attempt
+        finished the handshake on its daemon thread — the runtime is
+        connected even though OUR call timed out."""
+        try:
+            from jax._src import distributed as _jax_distributed
+
+            return _jax_distributed.global_state.client is not None
+        except Exception:  # pragma: no cover - jax internals moved
+            return False
+
     def connect() -> None:
         fault_point("distributed.init")
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
-        )
+        if _handshake_live():
+            logger.info(
+                "init_distributed: an abandoned attempt completed the "
+                "handshake; redial skipped"
+            )
+            return
+        # the handshake is the first place a dead peer wedges a fleet:
+        # under a dispatch deadline it aborts with a postmortem naming
+        # the unresponsive ranks instead of blocking forever (the retry
+        # policy then owns whether to redial — which is why this call
+        # must NOT write the coordinated-abort signal: an abort record
+        # outliving a successful redial would kill every rank the
+        # moment it enrolled)
+        try:
+            _fleet.run_with_deadline(
+                lambda: jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    local_device_ids=local_device_ids,
+                ),
+                describe="distributed.init",
+                signal=False,
+            )
+        except RuntimeError:
+            # the abandoned attempt can win the race BETWEEN the probe
+            # above and our dial ("already initialized") — that is a
+            # success, not a failure (and RuntimeError is deliberately
+            # non-retryable, so without this the redial would fail a
+            # fleet that is in fact fully connected)
+            if _handshake_live():
+                return
+            raise
 
     retry_call(connect, policy=retry, describe="distributed.init")
     _initialized = True
@@ -84,6 +127,19 @@ def init_distributed(
         num_processes,
         coordinator_address,
     )
+
+
+def fleet_barrier(name: str = "sync", timeout: Optional[float] = None) -> None:
+    """Host-side fleet barrier with a deadline: every rank of the
+    supervised fleet (``TFTPU_FLEET_DIR``) marks its arrival and waits
+    for all peers — a missing rank raises
+    :class:`~tensorframes_tpu.resilience.fleet.HungDispatchError`
+    **naming the missing ranks** (after a flight-recorder postmortem and
+    the coordinated-abort signal) instead of wedging the collective
+    forever. A no-op on single-process / un-enrolled runs, so it is safe
+    to call unconditionally at lockstep points (run start, checkpoint
+    epochs). ``timeout`` overrides the dispatch-deadline default."""
+    _fleet.barrier(name, deadline=timeout)
 
 
 def is_multiprocess() -> bool:
@@ -142,8 +198,15 @@ def frame_from_process_local(data, mesh=None, axis: Optional[str] = None):
             host_block[name] = list(v)
             host_infos.append(ColumnInfo(name, dtype, Shape((Unknown,))))
             continue
-        arr = jax.make_array_from_process_local_data(
-            batch_sharding(mesh, arr_np.ndim, axis), arr_np
+        # cross-process array assembly blocks on every peer: under a
+        # dispatch deadline a dead rank yields a named postmortem, not
+        # an indefinite hang (name bound early: the lambda outlives the
+        # loop iteration on the watchdog thread)
+        arr = _fleet.run_with_deadline(
+            lambda sh=batch_sharding(mesh, arr_np.ndim, axis), a=arr_np: (
+                jax.make_array_from_process_local_data(sh, a)
+            ),
+            describe=f"distributed.frame_from_process_local[{name}]",
         )
         block[name] = arr
         infos.append(

@@ -20,6 +20,18 @@ live in the host driver, not the compiled program:
   that detect non-finite losses / states and skip the step, roll back
   to the last good state, or raise; plugged into
   ``training.run_resumable(guard=...)``.
+* :mod:`~tensorframes_tpu.resilience.fleet` — fleet supervision for
+  multi-process runs: heartbeat publishing into a shared rendezvous dir,
+  dead-rank/straggler detection, a hung-collective dispatch-deadline
+  watchdog (``configure(dispatch_deadline_s=)``), a bounded rendezvous
+  ``barrier``, and the coordinated-abort protocol — a wedged or killed
+  rank produces a flight-recorder postmortem naming it, not an
+  indefinite collective hang.
+* :mod:`~tensorframes_tpu.resilience.supervisor` — ``supervise()``: the
+  fleet launcher that spawns ranks with the shared telemetry identity,
+  reaps crashes and wedged heartbeats, tears survivors down via the
+  coordinated abort, and restarts the run resuming from the latest
+  intact checkpoint.
 
 Checkpoint integrity (per-array CRC32 manifests, fsync-before-rename,
 corrupted-step fallback) lives in :mod:`tensorframes_tpu.checkpoint`
@@ -30,9 +42,15 @@ from __future__ import annotations
 
 from .faults import (  # noqa: F401
     SITES,
+    Delay,
+    KillRank,
     active_sites,
+    delay_point,
     fault_point,
     inject,
+    kill_point,
+    list_sites,
+    register_site,
     reset,
 )
 from .guards import NonFiniteError, StepGuard, tree_all_finite  # noqa: F401
@@ -43,13 +61,38 @@ from .retry import (  # noqa: F401
     retry_call,
     retryable,
 )
+from .fleet import (  # noqa: F401
+    ABORT_EXIT_CODE,
+    CoordinatedAbortError,
+    DeadRankError,
+    FleetError,
+    FleetMonitor,
+    FleetStatus,
+    Heartbeater,
+    HungDispatchError,
+    barrier,
+    enroll,
+    run_with_deadline,
+)
+from .supervisor import (  # noqa: F401
+    RankFailure,
+    SuperviseError,
+    SuperviseResult,
+    supervise,
+)
 
 __all__ = [
     "SITES",
     "active_sites",
     "fault_point",
+    "delay_point",
+    "kill_point",
     "inject",
     "reset",
+    "Delay",
+    "KillRank",
+    "list_sites",
+    "register_site",
     "AttemptTimeout",
     "RetryError",
     "RetryPolicy",
@@ -58,4 +101,19 @@ __all__ = [
     "NonFiniteError",
     "StepGuard",
     "tree_all_finite",
+    "ABORT_EXIT_CODE",
+    "FleetError",
+    "DeadRankError",
+    "HungDispatchError",
+    "CoordinatedAbortError",
+    "FleetStatus",
+    "Heartbeater",
+    "FleetMonitor",
+    "barrier",
+    "enroll",
+    "run_with_deadline",
+    "supervise",
+    "SuperviseResult",
+    "SuperviseError",
+    "RankFailure",
 ]

@@ -17,27 +17,28 @@ bit-for-bit. The registry is process-global and thread-safe: prefetch
 workers, retry watchdogs, and the driver thread all hit the same
 counters, which is exactly what a transient-IO drill wants.
 
-Instrumented sites (the stable names; any string is accepted so layers
-can add sites without touching this module):
+Beyond plain raises, two site flavors support **fleet chaos** (ISSUE 8):
+:func:`delay_point` sites catch an injected :class:`Delay` and sleep —
+simulating a stalled-but-alive operation (a hung collective waiting on a
+dead peer) that only a deadline watchdog can unblock; :func:`kill_point`
+sites catch an injected :class:`KillRank` and ``SIGKILL`` their own
+process — the deterministic stand-in for a preempted/OOM-killed rank.
 
-==============================  =============================================
-site                            raised from
-==============================  =============================================
-``executor.run_block``          CompiledProgram.run_block (block execution)
-``executor.run_rows``           CompiledProgram.run_rows (vmapped execution)
-``io.prefetch.device_put``      prefetch_to_device worker (host→HBM transfer)
-``io.save_frame``               io.save_frame (frame persistence write)
-``io.load_frame``               io.load_frame (frame persistence read)
-``checkpoint.save``             Checkpointer.save (inside the retry scope)
-``checkpoint.restore``          Checkpointer restore of one step directory
-``distributed.init``            parallel.distributed.init_distributed
-==============================  =============================================
+Sites are **registered** (:func:`register_site` / :func:`list_sites`) by
+the module that instruments them, so tests can assert the instrumented
+set and the documentation (docs/resilience.md) never drift: any literal
+site name appearing at a ``fault_point``/``delay_point``/``kill_point``
+call in the package must be registered, and every registered site must
+be named in the docs (tests/test_resilience.py drift guard).
 """
 
 from __future__ import annotations
 
+import os
 import random
+import signal
 import threading
+import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Tuple, Union
 
@@ -52,8 +53,10 @@ _INJECTIONS_FIRED = _counter(
     "Armed fault injections that actually raised at a fault_point",
 )
 
-#: The site names instrumented across the package (documentation +
-#: typo guard for tests; fault_point accepts arbitrary names).
+#: The core site names instrumented across the package (documentation +
+#: typo guard for tests; fault_point accepts arbitrary names). The full
+#: live catalog — including sites other modules register at import —
+#: is :func:`list_sites`.
 SITES: Tuple[str, ...] = (
     "executor.run_block",
     "executor.run_rows",
@@ -66,6 +69,29 @@ SITES: Tuple[str, ...] = (
 )
 
 ErrorSpec = Union[BaseException, type]
+
+
+class Delay(Exception):
+    """Injectable stall: a :func:`delay_point` site catches it and sleeps
+    ``seconds`` instead of raising — the deterministic simulation of an
+    operation that hangs (a collective waiting on a dead peer) rather
+    than fails. At a plain :func:`fault_point` it propagates like any
+    other injected error."""
+
+    def __init__(self, seconds: float):
+        super().__init__(f"injected delay of {seconds:g}s")
+        self.seconds = float(seconds)
+
+
+class KillRank(BaseException):
+    """Injectable preemption: a :func:`kill_point` site catches it and
+    ``SIGKILL``s its own process — no exception path, no atexit, exactly
+    the blast shape of a preempted or OOM-killed rank. ``BaseException``
+    so stray ``except Exception`` handlers between the site and the test
+    cannot accidentally absorb a scheduled kill."""
+
+    def __init__(self, message: str = "injected kill-rank fault"):
+        super().__init__(message)
 
 
 class Injection:
@@ -131,6 +157,45 @@ class Injection:
 _lock = threading.Lock()
 _registry: Dict[str, List[Injection]] = {}
 
+# site catalog: name -> where/how it is instrumented. Seeded with the
+# core SITES; modules that add sites (resilience/fleet.py, the executor
+# dispatch watchdog) register theirs at import, and the drift-guard test
+# holds every instrumented literal + every registered name to the docs.
+_sites: Dict[str, str] = {}
+
+
+def register_site(name: str, where: str) -> None:
+    """Declare a fault site in the catalog (idempotent; re-registering
+    with a different description updates it)."""
+    if not name:
+        raise ValueError("site name must be non-empty")
+    with _lock:
+        _sites[name] = where
+
+
+def list_sites() -> Dict[str, str]:
+    """The registered site catalog: ``{site name: where it is
+    instrumented}``, sorted by name. This is the anti-drift surface —
+    tests assert every ``fault_point``/``delay_point``/``kill_point``
+    literal in the package is registered here and documented in
+    docs/resilience.md."""
+    with _lock:
+        return dict(sorted(_sites.items()))
+
+
+_CORE_SITE_DOCS: Dict[str, str] = {
+    "executor.run_block": "CompiledProgram.run_block (block execution)",
+    "executor.run_rows": "CompiledProgram.run_rows (vmapped execution)",
+    "io.prefetch.device_put": "prefetch_to_device worker (host→HBM transfer)",
+    "io.save_frame": "io.save_frame (frame persistence write)",
+    "io.load_frame": "io.load_frame (frame persistence read)",
+    "checkpoint.save": "Checkpointer.save (inside the retry scope)",
+    "checkpoint.restore": "Checkpointer restore of one step directory",
+    "distributed.init": "parallel.distributed.init_distributed handshake",
+}
+for _name, _where in _CORE_SITE_DOCS.items():
+    register_site(_name, _where)
+
 
 def fault_point(site: str) -> None:
     """Instrumentation hook: raise if an armed injection elects to fire.
@@ -157,6 +222,44 @@ def fault_point(site: str) -> None:
         )
         logger.debug("fault_point(%s): raising injected %r", site, err)
         raise err
+
+
+def delay_point(site: str) -> None:
+    """A fault site with stall semantics: an injected :class:`Delay`
+    makes this call sleep in place (the operation hangs, it does not
+    fail), so hung-collective watchdogs are drillable deterministically.
+    Any other injected error propagates exactly like :func:`fault_point`.
+    """
+    try:
+        fault_point(site)
+    except Delay as d:
+        _flight.record("fault.delayed", site=site, seconds=d.seconds)
+        logger.debug("delay_point(%s): sleeping injected %.3gs", site,
+                     d.seconds)
+        time.sleep(d.seconds)
+
+
+def kill_point(site: str = "fleet.rank.kill") -> None:
+    """A fault site with preemption semantics: an injected
+    :class:`KillRank` makes this process ``SIGKILL`` itself — the
+    deterministic kill-rank chaos primitive for subprocess-fleet drills
+    (the flight-recorder disk spool, being line-flushed, survives as the
+    black box). Any other injected error propagates like
+    :func:`fault_point`."""
+    try:
+        fault_point(site)
+    except KillRank:
+        _flight.record("fault.kill_rank", site=site, pid=os.getpid())
+        logger.warning("kill_point(%s): SIGKILLing own process (pid %d)",
+                       site, os.getpid())
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+register_site(
+    "fleet.rank.kill",
+    "kill_point default site: training.run_resumable loop edge (any "
+    "enrolled rank can be deterministically preempted mid-run)",
+)
 
 
 @contextmanager

@@ -76,6 +76,14 @@ class RetryPolicy:
     backoff (no thundering herd on the coordinator redial). Pass an
     explicit ``seed`` for deterministic drill schedules. ``timeout``
     (seconds) arms the per-attempt watchdog; ``None`` disables it.
+    ``deadline_s`` caps the **total elapsed** wall-clock of the whole
+    ``retry_call`` — attempts, backoff sleeps and watchdog waits all
+    included: a per-attempt watchdog alone lets a flaky coordinator
+    stretch ``init_distributed`` to attempts × (timeout + backoff),
+    while a deadline makes the budget a wall-clock promise. The running
+    attempt's watchdog window and every backoff sleep are clipped to
+    the remaining budget; exhaustion raises :class:`RetryError` naming
+    the deadline.
     """
 
     max_attempts: int = 3
@@ -85,12 +93,15 @@ class RetryPolicy:
     timeout: Optional[float] = None
     retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE
     seed: Optional[int] = None
+    deadline_s: Optional[float] = None
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
         if self.backoff < 0 or self.backoff_max < 0 or self.jitter < 0:
             raise ValueError("backoff, backoff_max and jitter must be >= 0")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {self.deadline_s}")
 
     def delay(self, attempt: int, rng) -> float:
         """Sleep before retry number ``attempt`` (1-based)."""
@@ -101,9 +112,23 @@ class RetryPolicy:
         return isinstance(exc, self.retryable)
 
 
-def _run_with_watchdog(fn: Callable, args, kwargs, timeout: float):
-    """Run ``fn`` on a worker thread; raise :class:`AttemptTimeout` if it
-    outlives ``timeout`` seconds (the attempt is abandoned, not killed)."""
+class WatchdogExpired(Exception):
+    """Internal sentinel from :func:`run_abandonable`: the call outlived
+    its window. Deliberately NOT a ``TimeoutError`` — callers translate
+    it into their own surface (:class:`AttemptTimeout` here,
+    ``HungDispatchError`` in the fleet watchdog) and must never confuse
+    it with a timeout the wrapped function itself raised."""
+
+
+def run_abandonable(fn: Callable, args, kwargs, timeout: float,
+                    thread_name: str = "tfs-watchdog-attempt"):
+    """Run ``fn(*args, **kwargs)`` on a daemon thread, waiting at most
+    ``timeout`` seconds: the ONE abandon-path primitive shared by the
+    per-attempt retry watchdog and the fleet dispatch-deadline watchdog.
+    On expiry raises :class:`WatchdogExpired`; the attempt keeps running
+    on its thread (Python cannot safely interrupt an arbitrary blocked
+    call), so only idempotent operations belong under it. The wrapped
+    function's own exceptions re-raise on the caller thread unchanged."""
     outcome: dict = {}
     done = threading.Event()
 
@@ -115,15 +140,26 @@ def _run_with_watchdog(fn: Callable, args, kwargs, timeout: float):
         finally:
             done.set()
 
-    t = threading.Thread(target=attempt, daemon=True, name="tfs-retry-attempt")
+    t = threading.Thread(target=attempt, daemon=True, name=thread_name)
     t.start()
     if not done.wait(timeout):
-        raise AttemptTimeout(
-            f"attempt still running after {timeout:.3g}s (abandoned)"
-        )
+        raise WatchdogExpired(timeout)
     if "error" in outcome:
         raise outcome["error"]
     return outcome["value"]
+
+
+def _run_with_watchdog(fn: Callable, args, kwargs, timeout: float):
+    """Run ``fn`` on a worker thread; raise :class:`AttemptTimeout` if it
+    outlives ``timeout`` seconds (the attempt is abandoned, not killed)."""
+    try:
+        return run_abandonable(
+            fn, args, kwargs, timeout, thread_name="tfs-retry-attempt"
+        )
+    except WatchdogExpired:
+        raise AttemptTimeout(
+            f"attempt still running after {timeout:.3g}s (abandoned)"
+        ) from None
 
 
 def retry_call(
@@ -151,11 +187,29 @@ def retry_call(
         return fn(*args, **kwargs)
     rng = random.Random(policy.seed)
     name = describe or getattr(fn, "__qualname__", repr(fn))
+    t_start = time.monotonic()
+
+    def remaining() -> Optional[float]:
+        if policy.deadline_s is None:
+            return None
+        return policy.deadline_s - (time.monotonic() - t_start)
+
     last: Optional[BaseException] = None
+    deadline_hit = False
     for attempt in range(1, policy.max_attempts + 1):
         try:
-            if policy.timeout is not None:
-                return _run_with_watchdog(fn, args, kwargs, policy.timeout)
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                deadline_hit = True
+                break
+            # the attempt's watchdog window never outlives the total
+            # deadline: a blocked attempt is abandoned the instant the
+            # budget runs out, not at its own (later) timeout
+            window = policy.timeout
+            if rem is not None:
+                window = rem if window is None else min(window, rem)
+            if window is not None:
+                return _run_with_watchdog(fn, args, kwargs, window)
             return fn(*args, **kwargs)
         except BaseException as e:
             if not policy.is_retryable(e):
@@ -163,7 +217,13 @@ def retry_call(
             last = e
             if attempt == policy.max_attempts:
                 break
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                deadline_hit = True
+                break
             delay = policy.delay(attempt, rng)
+            if rem is not None:
+                delay = min(delay, rem)
             _RETRY_ATTEMPTS.inc()
             _RETRY_BACKOFF_SECONDS.inc(delay)
             _flight.record(
@@ -180,12 +240,23 @@ def retry_call(
                 on_retry(attempt, e)
             if delay > 0:
                 time.sleep(delay)
+    deadline_hit = deadline_hit or (
+        policy.deadline_s is not None
+        and time.monotonic() - t_start >= policy.deadline_s
+    )
     _RETRY_EXHAUSTIONS.inc()
     _flight.record(
         "retry.exhausted", site=name, max_attempts=policy.max_attempts,
+        deadline_s=policy.deadline_s if deadline_hit else None,
         error=type(last).__name__ if last else None,
         message=str(last) if last else None,
     )
+    if deadline_hit:
+        raise RetryError(
+            f"{name}: deadline_s={policy.deadline_s:g} exceeded after "
+            f"{time.monotonic() - t_start:.2f}s (gave up at attempt "
+            f"{attempt}/{policy.max_attempts})"
+        ) from last
     raise RetryError(
         f"{name}: all {policy.max_attempts} attempts failed"
     ) from last

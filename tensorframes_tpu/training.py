@@ -21,6 +21,8 @@ from typing import Any, Callable, Iterable, Optional, Tuple, Union
 
 from .checkpoint import Checkpointer
 from .observability.steps import StepTelemetry
+from .resilience import fleet as _fleet
+from .resilience.faults import kill_point
 from .resilience.guards import StepGuard
 from .utils import get_logger
 
@@ -63,6 +65,14 @@ def run_resumable(
     step log, and (when tracing is enabled) the event timeline; it runs
     after ``on_step``, with the same (global step, metrics) arguments.
     """
+    # fleet awareness: under a supervised fleet (TFTPU_FLEET_DIR — the
+    # supervise() launcher arms it for its children) this loop
+    # heartbeats and watches its peers; a plain single-process run pays
+    # a single env read. This is what makes kill -9 of ANY rank
+    # mid-run_resumable converge: survivors abort bounded, the
+    # supervisor restarts, and this resume path replays
+    # deterministically from the latest intact checkpoint.
+    _fleet.enroll()
     if guard is not None:
         guard = StepGuard.coerce(guard)
     start_step = 0
@@ -104,6 +114,9 @@ def run_resumable(
             ) from None
     try:
         while step < num_steps:
+            # kill-rank chaos site: a drill can SIGKILL this rank at an
+            # exact step boundary (un-armed cost: one dict check)
+            kill_point()
             try:
                 batch = next(it)
             except StopIteration:

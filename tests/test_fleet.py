@@ -1,0 +1,791 @@
+"""Fleet-supervision tests (ISSUE 8 tentpole acceptance): 2-process CPU
+subprocess fleets (the tests/test_trace_merge.py pattern) where a rank
+is SIGKILLed mid-``run_resumable`` (supervisor restarts, resumed state
+bit-identical to an uninterrupted run), a deliberately hung collective
+trips the dispatch-deadline watchdog with a flight-recorder postmortem
+naming the missing rank, and a drop-heartbeat injection is detected by
+the surviving peer — plus in-process units for the heartbeat files,
+status classification, coordinated abort, barrier and deadline
+watchdog."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.observability import flight
+from tensorframes_tpu.resilience import faults, fleet, supervisor
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _fleet_env(extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # fast chaos cadence: beats every 0.1s, death verdict at 1.5s
+    env["TFTPU_HEARTBEAT_INTERVAL_S"] = "0.1"
+    env["TFTPU_HEARTBEAT_TIMEOUT_S"] = "1.5"
+    env.update(extra or {})
+    return env
+
+
+# ---------------------------------------------------------------------------
+# kill -9 of a non-zero rank mid-run_resumable: supervise() restarts and
+# the resumed run converges bit-identically (tentpole acceptance #1)
+# ---------------------------------------------------------------------------
+
+# each rank trains its own float32 multiply-accumulate replica (replay
+# order changes the result bits, so a wrong resume point is detectable);
+# rank `kill_rank` SIGKILLs itself at the `kill_after` step edge of its
+# FIRST incarnation via the fleet.rank.kill site instrumented in
+# run_resumable's loop
+_TRAINER = """
+import contextlib, os, sys, time
+ckroot, num_steps, save_every, kill_rank, kill_after, slow0 = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), float(sys.argv[6]),
+)
+import jax.numpy as jnp
+import numpy as np
+from tensorframes_tpu.checkpoint import Checkpointer
+from tensorframes_tpu.resilience import faults
+from tensorframes_tpu.training import run_resumable
+
+rank = int(os.environ["TFTPU_PROCESS_INDEX"])
+attempt = int(os.environ.get("TFTPU_FLEET_ATTEMPT", "0"))
+stack = contextlib.ExitStack()
+if rank == kill_rank and attempt == 0 and kill_after > 0:
+    stack.enter_context(faults.inject(
+        "fleet.rank.kill", faults.KillRank, after=kill_after, max_times=1,
+    ))
+
+sleep_s = slow0 if rank == 0 else 0.01
+
+def step(state, batch):
+    time.sleep(sleep_s)
+    new = {"w": state["w"] * jnp.float32(1.01) + batch}
+    return new, {"loss": new["w"].sum()}
+
+batches = [jnp.full((4,), float(i % 7), jnp.float32) for i in range(num_steps)]
+ck = Checkpointer(os.path.join(ckroot, f"rank{rank}"), backend="npz")
+state, ran = run_resumable(
+    step, {"w": jnp.zeros((4,), jnp.float32)}, ck, batches,
+    num_steps=num_steps, save_every=save_every,
+)
+np.save(os.path.join(ckroot, f"final_rank{rank}.npy"), np.asarray(state["w"]))
+print("DONE", rank, ran, flush=True)
+"""
+
+
+def _reference(num_steps: int) -> np.ndarray:
+    import jax.numpy as jnp
+
+    w = jnp.zeros((4,), jnp.float32)
+    for i in range(num_steps):
+        w = w * jnp.float32(1.01) + jnp.full((4,), float(i % 7), jnp.float32)
+    return np.asarray(w)
+
+
+def _supervise_trainer(tmp_path, *, n, kill_rank, num_steps=40,
+                       save_every=2, kill_after=3, max_restarts=2):
+    ckroot = str(tmp_path / "ck")
+    os.makedirs(ckroot, exist_ok=True)
+    fdir = str(tmp_path / "fleet")
+    bdir = str(tmp_path / "blackbox")
+    result = supervisor.supervise(
+        [sys.executable, "-c", _TRAINER, ckroot, str(num_steps),
+         str(save_every), str(kill_rank), str(kill_after), "0.05"],
+        n,
+        rendezvous_dir=fdir,
+        flight_dir=bdir,
+        max_restarts=max_restarts,
+        heartbeat_timeout_s=5.0,
+        grace_s=5.0,
+        env=_fleet_env(),
+        inherit_env=False,
+    )
+    return result, ckroot, bdir
+
+
+def test_kill9_rank_mid_run_supervisor_restarts_and_resumes(tmp_path):
+    """SIGKILL rank 1 mid-run: the supervisor reaps it (exit -9),
+    survivors abort via the coordinated protocol (no indefinite hang),
+    the fleet restarts resuming from the latest intact checkpoint, and
+    EVERY rank's final state is bit-identical to an uninterrupted run."""
+    result, ckroot, bdir = _supervise_trainer(tmp_path, n=2, kill_rank=1)
+    assert result.ok
+    assert result.restarts == 1
+    assert result.attempts == 2
+    # the first incarnation recorded the SIGKILL of rank 1
+    assert result.exit_codes[0][1] == -signal.SIGKILL
+    assert result.failures[0].rank == 1
+    assert result.failures[0].kind in ("signal", "abort")
+    # the second incarnation finished clean on every rank
+    assert result.exit_codes[1] == {0: 0, 1: 0}
+    ref = _reference(40)
+    for rank in range(2):
+        final = np.load(os.path.join(ckroot, f"final_rank{rank}.npy"))
+        np.testing.assert_array_equal(final, ref)
+    # the black box shows the fleet history: the injected kill is the
+    # last thing rank 1's line-flushed spool recorded before dying, and
+    # the survivor's coordinated abort names rank 1 (the abort FILE is
+    # gone by design — clear_fleet removes it before the restart so the
+    # new incarnation isn't killed at birth)
+    records = flight.read_blackbox(bdir)
+    kinds = {r.get("kind") for r in records}
+    assert "fault.kill_rank" in kinds
+    aborts = [r for r in records if r.get("kind") == "fleet.abort_seen"]
+    assert aborts and aborts[0]["ranks"] == [1]
+    # the survivor left a fleet_abort postmortem
+    posts = [f for f in os.listdir(bdir)
+             if f.startswith("postmortem_") and "_p0_" in f]
+    assert posts
+
+
+@pytest.mark.slow
+def test_kill9_on_4_process_fleet_converges(tmp_path):
+    """The 4-process variant: kill rank 2; all four replicas converge
+    bit-identically after the restart."""
+    result, ckroot, _ = _supervise_trainer(
+        tmp_path, n=4, kill_rank=2, num_steps=60,
+    )
+    assert result.ok and result.restarts == 1
+    assert result.exit_codes[0][2] == -signal.SIGKILL
+    ref = _reference(60)
+    for rank in range(4):
+        final = np.load(os.path.join(ckroot, f"final_rank{rank}.npy"))
+        np.testing.assert_array_equal(final, ref)
+
+
+def test_supervise_restart_budget_exhausted_raises(tmp_path):
+    with pytest.raises(supervisor.SuperviseError) as ei:
+        supervisor.supervise(
+            [sys.executable, "-c", "import sys; sys.exit(9)"], 2,
+            rendezvous_dir=str(tmp_path / "f"), max_restarts=1,
+            grace_s=0.5, env=_fleet_env(), inherit_env=False,
+        )
+    assert ei.value.result.attempts == 2
+    assert not ei.value.result.ok
+    assert all(f.kind == "exit" for f in ei.value.result.failures)
+
+
+def test_supervise_partial_spawn_failure_reaps_started_ranks(tmp_path):
+    """If spawning rank k fails, ranks 0..k-1 must be killed and
+    reaped, not orphaned to run unsupervised."""
+    pid_file = str(tmp_path / "rank0.pid")
+    sleeper = (
+        "import os, time\n"
+        f"open({pid_file!r}, 'w').write(str(os.getpid()))\n"
+        "time.sleep(120)\n"
+    )
+
+    def cmd(rank):
+        if rank == 1:
+            # rank 0 is already spawned; wait until it has genuinely
+            # started (pid file written) so the reap is observable
+            deadline = time.monotonic() + 60
+            while not os.path.exists(pid_file):
+                assert time.monotonic() < deadline, "rank 0 never started"
+                time.sleep(0.05)
+            raise RuntimeError("no argv for rank 1")
+        return [sys.executable, "-c", sleeper]
+
+    with pytest.raises(RuntimeError, match="no argv for rank 1"):
+        supervisor.supervise(
+            cmd, 2, rendezvous_dir=str(tmp_path / "f"),
+            env=_fleet_env(), inherit_env=False,
+        )
+    pid = int(open(pid_file).read())
+    # rank 0 must be gone (kill(pid, 0) raises once reaped)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        os.kill(pid, signal.SIGKILL)
+        raise AssertionError(f"rank 0 (pid {pid}) left running")
+
+
+def test_supervise_clean_single_attempt(tmp_path):
+    script = (
+        "import time\n"
+        "from tensorframes_tpu.resilience import fleet\n"
+        "assert fleet.enroll() is not None\n"
+        "time.sleep(0.5)\n"
+    )
+    result = supervisor.supervise(
+        [sys.executable, "-c", script], 2,
+        rendezvous_dir=str(tmp_path / "f"), max_restarts=0,
+        env=_fleet_env(), inherit_env=False,
+    )
+    assert result.ok and result.attempts == 1 and result.restarts == 0
+    assert result.exit_codes == [{0: 0, 1: 0}]
+
+
+# ---------------------------------------------------------------------------
+# hung collective: the delay-collective injection stalls one rank; the
+# peer's deadline watchdog fires, dumps a postmortem naming the missing
+# rank, and aborts instead of blocking forever (tentpole acceptance #2)
+# ---------------------------------------------------------------------------
+
+_BARRIER_WORKER = """
+import contextlib, os, sys
+import tensorframes_tpu  # config import order
+from tensorframes_tpu.resilience import faults, fleet
+from tensorframes_tpu.observability.metrics import REGISTRY
+
+rank = int(os.environ["TFTPU_PROCESS_INDEX"])
+stack = contextlib.ExitStack()
+if rank == 1:
+    # delay-collective: rank 1 stalls 60s on its way INTO the barrier
+    stack.enter_context(faults.inject("fleet.barrier", faults.Delay(60.0)))
+fleet.enroll(monitor=False)
+try:
+    fleet.barrier("step0", deadline=1.5)
+except fleet.HungDispatchError as e:
+    print("HUNG", str(e), flush=True)
+    hung = [m for m in REGISTRY.collect()
+            if m.name == "tftpu_fleet_hung_dispatches_total"][0]
+    aborts = [m for m in REGISTRY.collect()
+              if m.name == "tftpu_fleet_aborts_total"][0]
+    print(f"COUNTERS hung={hung.value:.0f} aborts={aborts.value:.0f}",
+          flush=True)
+    sys.exit(7)
+print("NOHANG", flush=True)
+"""
+
+
+def test_hung_collective_watchdog_names_missing_rank(tmp_path):
+    """Rank 1 stalls at the rendezvous via the delay-collective fault;
+    rank 0's deadline watchdog trips within the deadline, the postmortem
+    names rank 1 and the stalled dispatch, and the fleet counters
+    reflect the event."""
+    fdir = str(tmp_path / "fleet")
+    bdir = str(tmp_path / "blackbox")
+    env = _fleet_env({
+        "TFTPU_RUN_ID": "hungtest",
+        "TFTPU_FLEET_DIR": fdir,
+        "TFTPU_NUM_PROCESSES": "2",
+        "TFTPU_FLIGHT_DIR": bdir,
+    })
+    procs = []
+    for i in range(2):
+        e = dict(env)
+        e["TFTPU_PROCESS_INDEX"] = str(i)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _BARRIER_WORKER],
+            env=e, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    try:
+        t0 = time.monotonic()
+        out0, err0 = procs[0].communicate(timeout=120)
+        elapsed = time.monotonic() - t0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert procs[0].returncode == 7, (
+        f"rank 0 rc={procs[0].returncode}\nstdout: {out0}\nstderr: {err0}"
+    )
+    # it fired via the watchdog, not the 60s stall draining
+    assert elapsed < 60
+    assert "HUNG" in out0
+    # the error names the missing rank and the stalled dispatch
+    assert "[1]" in out0 and "step0" in out0
+    assert "COUNTERS hung=1 aborts=1" in out0
+    # the coordinated abort landed for any surviving peer to see
+    ab = fleet.abort_requested(fdir, "hungtest")
+    assert ab is not None and ab["ranks"] == [1]
+    # the flight postmortem names the stalled dispatch + missing rank
+    posts = [f for f in os.listdir(bdir) if f.startswith("postmortem_")]
+    assert posts, f"no postmortem in {os.listdir(bdir)}"
+    p0 = [f for f in posts if "_p0_" in f]
+    assert p0
+    lines = [json.loads(line) for line in
+             open(os.path.join(bdir, sorted(p0)[0]))]
+    assert lines[0]["reason"] == "hung_dispatch"
+    hung = [r for r in lines if r.get("kind") == "fleet.hung_dispatch"]
+    assert hung and hung[0]["missing_ranks"] == [1]
+    assert "step0" in hung[0]["entry"]
+
+
+def test_dispatch_deadline_trips_on_delayed_executor_dispatch(tmp_path):
+    """In-process: a Delay injection at the executor dispatch site under
+    a dispatch deadline raises HungDispatchError and dumps a postmortem
+    naming the dispatch."""
+    df = tfs.frame_from_arrays({"x": np.arange(16.0)}, num_blocks=1)
+    program = tfs.compile_program(lambda x: {"y": x + 1.0}, df)
+    before = fleet._HUNG_DISPATCHES.value
+    prev_spool = flight.RECORDER.spool_dir
+    flight.set_spool_dir(str(tmp_path))
+    tfs.configure(dispatch_deadline_s=0.4)
+    try:
+        with faults.inject("executor.dispatch", faults.Delay(10.0),
+                           max_times=1):
+            with pytest.raises(fleet.HungDispatchError, match="deadline"):
+                tfs.map_blocks(program, df).collect()
+    finally:
+        tfs.configure(dispatch_deadline_s=0.0)
+        flight.set_spool_dir(prev_spool)
+    assert fleet._HUNG_DISPATCHES.value == before + 1
+    posts = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("postmortem_")]
+    assert posts
+    lines = [json.loads(line) for line in
+             open(os.path.join(str(tmp_path), sorted(posts)[-1]))]
+    assert lines[0]["reason"] == "hung_dispatch"
+    hung = [r for r in lines if r.get("kind") == "fleet.hung_dispatch"]
+    assert hung and "executor.run_block" in hung[0]["entry"]
+
+
+def test_legacy_first_compile_exempt_from_deadline(monkeypatch):
+    """Legacy jit path (AOT-ineligible feeds): the FIRST dispatch of a
+    shape compiles lazily inside the call and must be exempt from the
+    deadline (a slow compile is not a hung collective); steady-state
+    dispatches at the same shape stay bounded."""
+    from tensorframes_tpu.ops import executor as ex
+
+    monkeypatch.setattr(ex, "_aot_eligible", lambda feeds: False)
+    df = tfs.frame_from_arrays({"x": np.arange(12.0) + 100.0},
+                               num_blocks=1)
+    program = tfs.compile_program(lambda x: {"y": x - 1.0}, df)
+    tfs.configure(dispatch_deadline_s=0.3)
+    try:
+        # fresh dispatch + injected stall: exempt, must complete
+        with faults.inject("executor.dispatch", faults.Delay(0.6),
+                           max_times=1):
+            out = tfs.map_blocks(program, df).column_values("y")
+        np.testing.assert_array_equal(out, np.arange(12.0) + 99.0)
+        # same shape again (steady state): the watchdog is armed
+        with faults.inject("executor.dispatch", faults.Delay(10.0),
+                           max_times=1):
+            with pytest.raises(fleet.HungDispatchError):
+                tfs.map_blocks(program, df).collect()
+    finally:
+        tfs.configure(dispatch_deadline_s=0.0)
+
+
+def test_hung_handshake_leaves_no_abort_record(tmp_path, monkeypatch):
+    """A handshake timeout is RETRIED — it must not write the
+    coordinated-abort signal (a stale record would kill every rank the
+    moment it enrolled after a successful redial)."""
+    monkeypatch.setenv("TFTPU_FLEET_DIR", str(tmp_path))
+    with pytest.raises(fleet.HungDispatchError):
+        fleet.run_with_deadline(
+            lambda: time.sleep(5), describe="distributed.init",
+            deadline=0.2, signal=False,
+        )
+    assert fleet.abort_requested(str(tmp_path)) is None
+    # the default (a mid-run collective) DOES signal
+    with pytest.raises(fleet.HungDispatchError):
+        fleet.run_with_deadline(
+            lambda: time.sleep(5), describe="executor.run_block",
+            deadline=0.2,
+        )
+    assert fleet.abort_requested(str(tmp_path)) is not None
+
+
+def test_dispatch_without_deadline_is_unbounded_and_unchanged():
+    """Deadline off (the default): the watchdog adds nothing to the
+    dispatch path and results are identical."""
+    df = tfs.frame_from_arrays({"x": np.arange(8.0)}, num_blocks=2)
+    program = tfs.compile_program(lambda x: {"y": x * 3.0}, df)
+    out = tfs.map_blocks(program, df).column_values("y")
+    np.testing.assert_array_equal(out, np.arange(8.0) * 3.0)
+
+
+# ---------------------------------------------------------------------------
+# drop-heartbeat: the silent rank is detected by its peer, which aborts
+# with a postmortem naming it (tentpole acceptance #3)
+# ---------------------------------------------------------------------------
+
+_SILENT_WORKER = """
+import contextlib, time
+from tensorframes_tpu.resilience import faults, fleet
+stack = contextlib.ExitStack()
+# beats 1..3 publish, then every beat is dropped: the process is alive
+# but silent — exactly what a wedged rank looks like from outside
+stack.enter_context(faults.inject("fleet.heartbeat", RuntimeError, after=3))
+fleet.enroll(monitor=False)
+time.sleep(60)
+"""
+
+_WATCHER_WORKER = """
+import sys, time
+from tensorframes_tpu.resilience import fleet
+member = fleet.enroll(abort_on_dead=True)
+assert member is not None
+time.sleep(60)  # the monitor thread aborts us long before this drains
+print("UNDETECTED", flush=True)
+sys.exit(1)
+"""
+
+
+def test_drop_heartbeat_detected_and_peer_aborts(tmp_path):
+    """Rank 0 drops its beats (injection); rank 1's monitor declares it
+    dead within the heartbeat timeout, dumps the postmortem naming rank
+    0, signals the coordinated abort and exits ABORT_EXIT_CODE."""
+    fdir = str(tmp_path / "fleet")
+    bdir = str(tmp_path / "blackbox")
+    env = _fleet_env({
+        "TFTPU_RUN_ID": "droptest",
+        "TFTPU_FLEET_DIR": fdir,
+        "TFTPU_NUM_PROCESSES": "2",
+        "TFTPU_FLIGHT_DIR": bdir,
+    })
+    workers = [_SILENT_WORKER, _WATCHER_WORKER]
+    procs = []
+    for i, src in enumerate(workers):
+        e = dict(env)
+        e["TFTPU_PROCESS_INDEX"] = str(i)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", src], env=e, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    try:
+        t0 = time.monotonic()
+        out1, err1 = procs[1].communicate(timeout=120)
+        elapsed = time.monotonic() - t0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert procs[1].returncode == fleet.ABORT_EXIT_CODE, (
+        f"watcher rc={procs[1].returncode}\nstdout: {out1}\nstderr: {err1}"
+    )
+    assert "UNDETECTED" not in out1
+    assert elapsed < 30  # detected within the (1.5s) timeout + slack
+    ab = fleet.abort_requested(fdir, "droptest")
+    assert ab is not None
+    assert ab["ranks"] == [0]
+    assert "heartbeat" in ab["reason"]
+    # black box: the watcher recorded the loss before aborting
+    records = flight.read_blackbox(bdir)
+    lost = [r for r in records if r.get("kind") == "fleet.heartbeat_lost"]
+    assert lost and lost[0]["rank"] == 0
+    posts = [f for f in os.listdir(bdir)
+             if f.startswith("postmortem_") and "_p1_" in f]
+    assert posts
+    header = json.loads(open(os.path.join(bdir, sorted(posts)[0])).readline())
+    assert header["reason"] == "fleet_abort"
+
+
+# ---------------------------------------------------------------------------
+# in-process units: heartbeat files, classification, abort, barrier
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def member_hygiene():
+    yield
+    fleet._reset_member_for_tests()
+
+
+def test_heartbeat_write_read_roundtrip(tmp_path):
+    d = str(tmp_path)
+    fleet.write_beat(d, seq=1, rank=3)
+    fleet.write_beat(d, seq=2, rank=3)
+    beats = fleet.read_heartbeats(d)
+    assert set(beats) == {3}
+    assert beats[3]["seq"] == 2
+    assert beats[3]["pid"] == os.getpid()
+    assert not beats[3]["stopped"]
+    fleet.write_beat(d, seq=3, rank=3, stopped=True)
+    assert fleet.read_heartbeats(d)[3]["stopped"]
+
+
+def test_fleet_status_classification(tmp_path):
+    d = str(tmp_path)
+    now = time.time()
+    fleet.write_beat(d, rank=0)                      # fresh → alive
+    fleet.write_beat(d, rank=1, stopped=True)        # clean exit
+    fleet.write_beat(d, rank=2)
+    # age rank 2's beat into the straggler band and rank 3's past dead
+    run = json.load(open(os.path.join(
+        d, [f for f in os.listdir(d) if f.startswith("hb_")][0])))["run_id"]
+    for rank, age in ((2, 1.0), (3, 5.0)):
+        rec = {"run_id": run, "process_index": rank, "pid": 1,
+               "seq": 1, "ts": now - age, "interval_s": 0.1,
+               "stopped": False}
+        with open(os.path.join(d, f"hb_{run}_p{rank}.json"), "w") as f:
+            json.dump(rec, f)
+    st = fleet.fleet_status(d, num_processes=5, timeout_s=2.0,
+                            straggler_s=0.5, now=now)
+    assert st.alive == [0]
+    assert st.stopped == [1]
+    assert st.stragglers == [2]
+    assert st.dead == [3]
+    assert st.missing == [4]
+    assert st.unresponsive() == [2, 3, 4]
+
+
+def test_heartbeater_drop_injection_counts_skips(tmp_path, member_hygiene):
+    hb = fleet.Heartbeater(str(tmp_path), interval_s=0.05)
+    with faults.inject("fleet.heartbeat", RuntimeError):
+        assert hb.beat_once() is False
+    assert hb.skipped == 1
+    assert hb.beat_once() is True
+    hb.stop()
+    beats = fleet.read_heartbeats(str(tmp_path))
+    assert beats[hb.rank]["stopped"]  # graceful final beat
+
+
+def test_signal_abort_first_writer_wins(tmp_path):
+    d = str(tmp_path)
+    fleet.signal_abort(d, "first cause", dead_ranks=[1], run_id="r")
+    fleet.signal_abort(d, "cascade", dead_ranks=[0], run_id="r")
+    ab = fleet.abort_requested(d, "r")
+    assert ab["reason"] == "first cause"
+    assert ab["ranks"] == [1]
+
+
+def test_clear_fleet_resets_state(tmp_path):
+    d = str(tmp_path)
+    fleet.write_beat(d, rank=0)
+    fleet.signal_abort(d, "x", run_id=None)
+    assert fleet.clear_fleet(d) >= 2
+    assert fleet.read_heartbeats(d) == {}
+    assert fleet.abort_requested(d) is None
+
+
+def test_monitor_detects_dead_and_straggler(tmp_path):
+    d = str(tmp_path)
+    run = "montest"
+    now = time.time()
+    for rank, age in ((1, 0.8), (2, 3.0)):
+        rec = {"run_id": run, "process_index": rank, "pid": 1,
+               "seq": 1, "ts": now - age, "interval_s": 0.1,
+               "stopped": False}
+        with open(os.path.join(d, f"hb_{run}_p{rank}.json"), "w") as f:
+            json.dump(rec, f)
+    dead, stragglers = [], []
+    mon = fleet.FleetMonitor(
+        d, run_id=run, timeout_s=2.0, straggler_s=0.5, self_rank=0,
+        on_dead=lambda rs, st: dead.extend(rs),
+        on_straggler=lambda rs, st: stragglers.extend(rs),
+    )
+    mon.check_once()
+    mon.check_once()  # second scan must not re-report
+    assert dead == [2]
+    assert stragglers == [1]
+
+
+def test_monitor_declares_never_started_rank_dead_after_grace(tmp_path):
+    """A rank that crashes before its FIRST beat must not stay
+    invisible: once the startup grace lapses, expected-but-silent ranks
+    are dead."""
+    d = str(tmp_path)
+    fleet.write_beat(d, rank=0)
+    run = fleet.read_heartbeats(d)[0]["run_id"]
+    dead = []
+    mon = fleet.FleetMonitor(
+        d, run_id=run, num_processes=3, timeout_s=1.0, self_rank=0,
+        startup_grace_s=0.2,
+        on_dead=lambda rs, st: dead.extend(rs),
+    )
+    mon.check_once()
+    assert dead == []  # inside the grace: not yet judged
+    time.sleep(0.25)
+    mon.check_once()
+    assert dead == [1, 2]
+    mon.check_once()  # reported once
+    assert dead == [1, 2]
+
+
+def test_monitor_never_judges_self(tmp_path):
+    d = str(tmp_path)
+    run = "selftest"
+    rec = {"run_id": run, "process_index": 0, "pid": 1, "seq": 1,
+           "ts": time.time() - 100.0, "interval_s": 0.1, "stopped": False}
+    with open(os.path.join(d, f"hb_{run}_p0.json"), "w") as f:
+        json.dump(rec, f)
+    dead = []
+    mon = fleet.FleetMonitor(
+        d, run_id=run, timeout_s=1.0, self_rank=0,
+        on_dead=lambda rs, st: dead.extend(rs),
+    )
+    mon.check_once()
+    assert dead == []
+
+
+def test_monitor_sees_abort_signal(tmp_path):
+    d = str(tmp_path)
+    aborts = []
+    mon = fleet.FleetMonitor(
+        d, run_id="abtest", self_rank=0, on_abort=aborts.append,
+    )
+    mon.check_once()
+    assert aborts == []
+    fleet.signal_abort(d, "down we go", run_id="abtest")
+    mon.check_once()
+    mon.check_once()  # reported once
+    assert len(aborts) == 1
+    assert aborts[0]["reason"] == "down we go"
+
+
+def test_enroll_noop_without_fleet_dir(monkeypatch, member_hygiene):
+    monkeypatch.delenv("TFTPU_FLEET_DIR", raising=False)
+    assert fleet.enroll() is None
+
+
+def test_enroll_idempotent_and_heartbeats(tmp_path, monkeypatch,
+                                          member_hygiene):
+    monkeypatch.setenv("TFTPU_FLEET_DIR", str(tmp_path))
+    m1 = fleet.enroll(monitor=False, interval_s=0.05)
+    m2 = fleet.enroll(monitor=False)
+    assert m1 is m2
+    assert fleet.current_member() is m1
+    time.sleep(0.25)
+    beats = fleet.read_heartbeats(str(tmp_path))
+    assert beats and beats[m1.heartbeater.rank]["seq"] >= 2
+
+
+def test_barrier_noop_single_process(tmp_path):
+    # no fleet dir, no peers: must return immediately
+    fleet.barrier("lonely", num_processes=1, directory=None)
+    fleet.barrier("lonely", num_processes=4, directory=None)
+
+
+def _write_peer_arrival(d, name, rank, gen=0):
+    """Simulate a peer rank's barrier arrival (one process = one rank,
+    so the in-process generation counter only advances for OUR calls —
+    the peer's file is written straight through the file protocol)."""
+    from tensorframes_tpu.observability import context
+
+    attempt = os.environ.get("TFTPU_FLEET_ATTEMPT", "0")
+    tag = f"barrier_{context.run_id()}_a{attempt}_{name}.g{gen}"
+    with open(os.path.join(d, f"{tag}_p{rank}"), "w") as f:
+        f.write(str(time.time()))
+
+
+def test_barrier_completes_when_all_arrive(tmp_path):
+    d = str(tmp_path)
+    _write_peer_arrival(d, "b1", rank=1, gen=0)
+    fleet.barrier("b1", directory=d, num_processes=2, rank=0,
+                  deadline=10.0)  # must return, not time out
+
+
+def test_barrier_name_reuse_synchronizes_each_use(tmp_path):
+    """Reusing a barrier name must synchronize EVERY use (per-use
+    generations), not silently match the first use's stale arrival
+    files."""
+    d = str(tmp_path)
+    _write_peer_arrival(d, "epoch", rank=1, gen=0)
+    fleet.barrier("epoch", directory=d, num_processes=2, rank=0,
+                  deadline=5.0)
+    # second use: the peer has NOT arrived at generation 1 — a stale
+    # match on g0's files would return instantly; the fix times out
+    with pytest.raises(fleet.HungDispatchError):
+        fleet.barrier("epoch", directory=d, num_processes=2, rank=0,
+                      deadline=0.3)
+    # and once the peer arrives at g2, the third use completes (clear
+    # the abort record the g1 timeout signalled first)
+    fleet.clear_fleet(d)
+    _write_peer_arrival(d, "epoch", rank=1, gen=2)
+    fleet.barrier("epoch", directory=d, num_processes=2, rank=0,
+                  deadline=5.0)
+
+
+def test_barrier_prunes_spent_generations(tmp_path):
+    """Per-epoch barrier reuse must not grow the rendezvous dir without
+    bound: generations <= current-2 are pruned on entry (every rank
+    provably observed them)."""
+    d = str(tmp_path)
+    for gen in range(4):
+        _write_peer_arrival(d, "loop", rank=1, gen=gen)
+        fleet.barrier("loop", directory=d, num_processes=2, rank=0,
+                      deadline=5.0)
+    remaining = sorted(os.listdir(d))
+    # only the last two generations' files survive (g2, g3 × 2 ranks)
+    gens = {f.split(".g")[1].split("_p")[0] for f in remaining
+            if ".g" in f}
+    assert gens == {"2", "3"}, remaining
+
+
+def test_barrier_explicit_zero_deadline_means_default_not_instant(tmp_path):
+    """deadline=0 must follow the module's 0-disables convention
+    (fall back to the default bound), never an instant fleet-wide
+    abort."""
+    d = str(tmp_path)
+    _write_peer_arrival(d, "z0", rank=1, gen=0)
+    before = fleet._HUNG_DISPATCHES.value
+    # peer already arrived: with 0 normalized to the default bound this
+    # completes; an instant-trip bug would abort before the first poll
+    fleet.barrier("z0", directory=d, num_processes=2, rank=0, deadline=0)
+    assert fleet._HUNG_DISPATCHES.value == before
+    assert fleet.abort_requested(d) is None
+
+
+def test_barrier_names_missing_rank_on_deadline(tmp_path):
+    d = str(tmp_path)
+    before = fleet._HUNG_DISPATCHES.value
+    with pytest.raises(fleet.HungDispatchError) as ei:
+        fleet.barrier("b2", directory=d, num_processes=3, rank=0,
+                      deadline=0.3)
+    msg = str(ei.value)
+    assert "[1, 2]" in msg and "b2" in msg
+    assert fleet._HUNG_DISPATCHES.value == before + 1
+    # the hung barrier signalled the coordinated abort for its peers
+    ab = fleet.abort_requested(d)
+    assert ab is not None and ab["ranks"] == [1, 2]
+
+
+def test_barrier_aborts_on_peer_signal(tmp_path):
+    d = str(tmp_path)
+    fleet.signal_abort(d, "peer died elsewhere", run_id=None)
+    with pytest.raises(fleet.CoordinatedAbortError, match="peer died"):
+        fleet.barrier("b3", directory=d, num_processes=2, rank=0,
+                      deadline=5.0)
+
+
+def test_run_with_deadline_passthrough_and_errors():
+    assert fleet.run_with_deadline(lambda: 5, describe="x") == 5
+    assert fleet.run_with_deadline(
+        lambda: 6, describe="x", deadline=2.0) == 6
+    with pytest.raises(ValueError, match="boom"):
+        fleet.run_with_deadline(
+            lambda: (_ for _ in ()).throw(ValueError("boom")),
+            describe="x", deadline=2.0,
+        )
+
+
+def test_run_with_deadline_times_out():
+    before = fleet._HUNG_DISPATCHES.value
+    with pytest.raises(fleet.HungDispatchError, match="0.2s deadline"):
+        fleet.run_with_deadline(
+            lambda: time.sleep(5), describe="wedged", deadline=0.2,
+        )
+    assert fleet._HUNG_DISPATCHES.value == before + 1
+
+
+def test_fleet_metrics_preregistered():
+    """The tftpu_fleet_* family must ride every exposition from import
+    (a run that never lost a rank reads 0 — it does not vanish)."""
+    from tensorframes_tpu.observability.metrics import REGISTRY
+
+    names = {m.name for m in REGISTRY.collect()}
+    for expected in (
+        "tftpu_fleet_heartbeats_total",
+        "tftpu_fleet_heartbeats_skipped_total",
+        "tftpu_fleet_missed_beats_total",
+        "tftpu_fleet_stragglers_total",
+        "tftpu_fleet_dead_ranks_total",
+        "tftpu_fleet_aborts_total",
+        "tftpu_fleet_hung_dispatches_total",
+        "tftpu_fleet_restarts_total",
+        "tftpu_fleet_recovery_seconds",
+        "tftpu_fleet_alive_ranks",
+    ):
+        assert expected in names, expected

@@ -780,3 +780,214 @@ def test_tree_all_finite_sharded_arrays():
     bad = jax.device_put(jnp.full((16,), np.nan, jnp.float32), sh)
     assert tree_all_finite({"w": good})
     assert not tree_all_finite({"w": bad})
+
+
+# ---------------------------------------------------------------------------
+# retry deadline (ISSUE 8 satellite): the total-elapsed cap
+# ---------------------------------------------------------------------------
+
+def test_retry_deadline_caps_total_elapsed():
+    """A huge attempt budget must not stretch past deadline_s: the cap
+    is a wall-clock promise, not an attempt count."""
+    calls = {"n": 0}
+
+    def always_flaky():
+        calls["n"] += 1
+        raise OSError("coordinator not up")
+
+    policy = RetryPolicy(max_attempts=10**6, backoff=0.02, deadline_s=0.4)
+    t0 = time.monotonic()
+    with pytest.raises(RetryError, match="deadline_s=0.4 exceeded"):
+        retry_call(always_flaky, policy=policy, describe="flaky")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 3.0
+    assert calls["n"] >= 2  # it genuinely retried before giving up
+
+
+def test_retry_deadline_bounds_blocked_attempt():
+    """deadline_s arms a watchdog window even when per-attempt timeout
+    is unset: a single blocked attempt cannot eat the whole budget and
+    then some."""
+    policy = RetryPolicy(max_attempts=3, backoff=0.01, deadline_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(RetryError, match="deadline_s"):
+        retry_call(lambda: time.sleep(30), policy=policy, describe="wedged")
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_retry_deadline_validation_and_success_path():
+    with pytest.raises(ValueError, match="deadline_s"):
+        RetryPolicy(deadline_s=0)
+    with pytest.raises(ValueError, match="deadline_s"):
+        RetryPolicy(deadline_s=-1)
+    # a call that succeeds within the deadline is unaffected
+    policy = RetryPolicy(max_attempts=3, backoff=0.01, deadline_s=5.0)
+    assert retry_call(lambda: 17, policy=policy) == 17
+
+
+def test_retry_deadline_attempt_cap_still_wins_when_faster():
+    """max_attempts exhaustion inside the deadline keeps the classic
+    error (the deadline is a cap, not a reclassification)."""
+    policy = RetryPolicy(max_attempts=2, backoff=0.001, deadline_s=30.0)
+    with pytest.raises(RetryError, match="all 2 attempts failed"):
+        retry_call(
+            lambda: (_ for _ in ()).throw(OSError("x")), policy=policy,
+            describe="quick",
+        )
+
+
+def test_init_distributed_flaky_coordinator_bounded_by_deadline(tmp_path):
+    """Subprocess flaky-coordinator drill: every handshake attempt fails
+    (distributed.init fault injection), the retry budget is effectively
+    infinite, and deadline_s must still bound init_distributed to
+    wall-clock seconds."""
+    import subprocess
+    import sys
+
+    script = """
+import time
+from tensorframes_tpu.resilience import RetryError, RetryPolicy, inject
+from tensorframes_tpu.parallel import init_distributed
+
+t0 = time.monotonic()
+with inject("distributed.init", ConnectionError("coordinator down")) as inj:
+    try:
+        init_distributed(
+            coordinator_address="127.0.0.1:1",
+            num_processes=2,
+            process_id=0,
+            retry=RetryPolicy(
+                max_attempts=10**6, backoff=0.05, deadline_s=1.0,
+            ),
+        )
+        raise SystemExit("init unexpectedly succeeded")
+    except RetryError as e:
+        print("BOUNDED", f"{time.monotonic() - t0:.2f}", flush=True)
+        print("ATTEMPTS", inj.fired, flush=True)
+        assert "deadline_s=1" in str(e), e
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [__import__("sys").executable, "-c", script], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+    assert "BOUNDED" in proc.stdout
+    wall = float(proc.stdout.split("BOUNDED")[1].split()[0])
+    assert wall < 10.0  # the deadline held (1.0s + scheduling slack)
+    assert int(proc.stdout.split("ATTEMPTS")[1].split()[0]) >= 2
+
+
+# ---------------------------------------------------------------------------
+# fault-site registry drift guard (ISSUE 8 satellite): every site name
+# instrumented across the package is registered AND documented
+# ---------------------------------------------------------------------------
+
+def test_fault_sites_registered_and_documented():
+    import pathlib
+    import re
+
+    import tensorframes_tpu
+    from tensorframes_tpu.resilience import faults as faults_mod
+
+    registered = set(faults_mod.list_sites())
+    # 1) every literal site name at an instrumentation point in the
+    # package is registered (a new fault_point without register_site is
+    # exactly the silent drift this guard exists to catch)
+    src_root = pathlib.Path(tensorframes_tpu.__file__).parent
+    pat = re.compile(
+        r"(?:fault_point|delay_point|kill_point)\(\s*[\"']([\w.]+)[\"']"
+    )
+    instrumented = set()
+    for path in src_root.rglob("*.py"):
+        instrumented |= set(pat.findall(path.read_text()))
+    missing = instrumented - registered
+    assert not missing, (
+        f"fault sites instrumented but not registered: {sorted(missing)} "
+        "— add faults.register_site(...) next to the instrumentation"
+    )
+    # 2) the classic SITES tuple stays a subset of the registry
+    assert set(faults_mod.SITES) <= registered
+    # 3) every registered site is documented in docs/resilience.md
+    docs = (
+        pathlib.Path(__file__).parent.parent / "docs" / "resilience.md"
+    ).read_text()
+    undocumented = [s for s in sorted(registered) if s not in docs]
+    assert not undocumented, (
+        f"fault sites registered but absent from docs/resilience.md: "
+        f"{undocumented}"
+    )
+
+
+def test_register_site_validates_and_lists_sorted():
+    from tensorframes_tpu.resilience import faults as faults_mod
+
+    with pytest.raises(ValueError):
+        faults_mod.register_site("", "nowhere")
+    sites = faults_mod.list_sites()
+    assert list(sites) == sorted(sites)
+    assert "executor.dispatch" in sites
+    assert "fleet.heartbeat" in sites
+
+
+# ---------------------------------------------------------------------------
+# delay_point / kill_point semantics
+# ---------------------------------------------------------------------------
+
+def test_delay_point_sleeps_instead_of_raising():
+    from tensorframes_tpu.resilience import Delay, delay_point
+
+    t0 = time.monotonic()
+    with inject("unit.delay", Delay(0.15)):
+        delay_point("unit.delay")  # must not raise
+    assert time.monotonic() - t0 >= 0.14
+    # a non-Delay injection still raises through delay_point
+    with inject("unit.delay", RuntimeError("hard fault")):
+        with pytest.raises(RuntimeError, match="hard fault"):
+            delay_point("unit.delay")
+
+
+def test_delay_point_noop_unarmed():
+    from tensorframes_tpu.resilience import delay_point
+
+    t0 = time.monotonic()
+    delay_point("unit.delay")
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_kill_point_sigkills_own_process():
+    """kill_point + KillRank must die by SIGKILL — no exception path, no
+    cleanup (subprocess-verified; in-process it would kill pytest)."""
+    import signal as _signal
+    import subprocess
+
+    script = """
+from tensorframes_tpu.resilience import KillRank, inject, kill_point
+with inject("fleet.rank.kill", KillRank):
+    kill_point()
+print("SURVIVED", flush=True)
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [__import__("sys").executable, "-c", script], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == -_signal.SIGKILL
+    assert "SURVIVED" not in proc.stdout
+
+
+def test_kill_point_noop_unarmed_and_passthrough():
+    from tensorframes_tpu.resilience import kill_point
+
+    kill_point()  # un-armed: a dict check, nothing else
+    with inject("fleet.rank.kill", RuntimeError("not a kill")):
+        with pytest.raises(RuntimeError, match="not a kill"):
+            kill_point()
