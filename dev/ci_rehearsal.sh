@@ -136,6 +136,15 @@ run_step "Observability smoke (telemetry example + artifact check)" bash -c "
   test -f '$WORK/obs/tier1_diagnostics.jsonl'
 "
 
+# ci.yml's serving smoke: a short open-loop load through the continuous
+# batcher — hard-gated on steady_state_compiles=0 — whose metrics JSONL
+# + trace land next to the other observability artifacts
+run_step "Serving smoke (open-loop CPU load, zero steady-state compiles)" bash -c "
+  env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.serving_main()\" &&
+  test -s '$WORK/obs/serving_metrics.jsonl' &&
+  test -s '$WORK/obs/serving_trace.json'
+"
+
 # ci.yml's fleet chaos-drill step: kill-rank + hung-collective +
 # drop-heartbeat on a 2-process CPU fleet, with the flight black box
 # spooled next to the other observability artifacts

@@ -121,6 +121,8 @@ from . import observability  # noqa: E402,F401
 from .observability import StepTelemetry  # noqa: E402,F401
 from . import compilecache  # noqa: E402,F401  (registers tftpu_compilecache_* metrics)
 from .compilecache import WarmupReport, warmup  # noqa: E402,F401
+from . import serving  # noqa: E402,F401  (registers tftpu_serving_* metrics)
+from .serving import Server, ServingConfig, serve_http  # noqa: E402,F401
 
 __version__ = "0.3.0"
 
@@ -151,6 +153,10 @@ __all__ = [
     "explain_plan",
     "lint_plan",
     # aux subsystems
+    "serving",
+    "Server",
+    "ServingConfig",
+    "serve_http",
     "Checkpointer",
     "CheckpointCorruptionError",
     "resilience",

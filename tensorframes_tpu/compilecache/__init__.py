@@ -28,6 +28,7 @@ from .store import CompileCacheStore, active_store, store_for  # noqa: F401
 from .warmup import (  # noqa: F401
     WarmupReport,
     partitioner_row_counts,
+    serving_row_buckets,
     warm_program,
     warmup,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "active_store",
     "partitioner_row_counts",
     "program_fingerprint",
+    "serving_row_buckets",
     "store_for",
     "warm_program",
     "warmup",

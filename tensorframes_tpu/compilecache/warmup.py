@@ -41,6 +41,7 @@ logger = get_logger(__name__)
 
 __all__ = [
     "WarmupReport", "warmup", "warm_program", "partitioner_row_counts",
+    "serving_row_buckets",
 ]
 
 
@@ -132,6 +133,38 @@ def partitioner_row_counts(total: int, num_blocks: int) -> List[int]:
     base = total // num_blocks
     sizes = {base, base + 1} if total % num_blocks else {base}
     return sorted(s for s in sizes if s > 0) or [total]
+
+
+def serving_row_buckets(max_rows: int) -> List[int]:
+    """The power-of-two lead-dim buckets a serving batcher's flushes
+    can land on: every ladder bucket up to ``bucket_rows(max_rows)``
+    (the serving layer caps any single flush at
+    ``ServingConfig.max_batch_rows`` = ``max_rows``). ONE policy,
+    stated once: the batcher pads flushes through
+    :func:`~tensorframes_tpu.ops.executor.bucket_rows`, and
+    ``warm_program(p, rows=serving_row_buckets(m), block=False)``
+    precompiles exactly those keys — which is how a warmed server
+    sustains zero steady-state compiles under any request-size mix."""
+    from ..ops.executor import bucket_rows, bucket_table
+
+    max_rows = int(max_rows)
+    if max_rows < 1:
+        raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+    table = bucket_table()
+    if max_rows > table[-1]:
+        # beyond the ladder bucket_rows falls back to EXACT counts, so
+        # a batcher flushing (table[-1], max_rows] sizes would dispatch
+        # never-warmed shapes — the zero-steady-state-compile contract
+        # cannot hold; refuse instead of warming a false promise
+        raise ValueError(
+            f"max_rows={max_rows} exceeds the bucket ladder's top "
+            f"({table[-1]}): flush sizes above the ladder dispatch at "
+            "exact, unwarmable shapes. Raise TFTPU_MAX_BUCKET_DOUBLINGS"
+            "/configure(max_bucket_doublings=) or lower "
+            "ServingConfig.max_batch_rows"
+        )
+    top = bucket_rows(max_rows)
+    return [b for b in table if b <= top]
 
 
 def _target_row_counts(frame, rows, block: bool) -> List[int]:

@@ -78,6 +78,87 @@ def _spanned(name: str, compute, rows_fn):
     return run
 
 
+# ADVICE r5: crossing config.relational_broadcast_bytes silently flips
+# the multi-process sort_values result LAYOUT — under the budget every
+# process holds the full replicated sorted frame, over it each process
+# holds only its key range. Programs written against the replicated
+# contract must get a runtime signal the first time the switch happens,
+# not discover it from collect()'s row count.
+_SORT_LAYOUT_LOCK = threading.Lock()
+_sort_layout_warned = False
+
+
+def _warn_sort_layout_switch(gbytes: int, budget: int) -> None:
+    """One-time (per process) tripwire for the replicated → range-
+    partitioned sort_values layout switch."""
+    global _sort_layout_warned
+    with _SORT_LAYOUT_LOCK:
+        if _sort_layout_warned:
+            return
+        _sort_layout_warned = True
+    logger.warning(
+        "sort_values: frame (%s bytes global) exceeds "
+        "config.relational_broadcast_bytes (%s) — switching from the "
+        "REPLICATED plan to the range-partitioned exchange: each "
+        "process now holds only ITS key range (O(global/P) rows), not "
+        "the full sorted frame. collect()/num_rows are per-process "
+        "under this layout; concatenating the processes' results in "
+        "process order is the global sort order. Raise the budget "
+        "(TFTPU_RELATIONAL_BROADCAST_MB) to keep the replicated "
+        "contract. (This tripwire fires once per process.)",
+        f"{gbytes:,}", f"{budget:,}",
+    )
+
+
+def _replicated_fleetwide(cols: Dict[str, Union[np.ndarray, list]]) -> bool:
+    """True when EVERY process holds byte-identical local columns (a
+    full-content 128-bit blake2b over every column — values, dtypes,
+    shapes — allgathered and compared; a collision-prone 32-bit CRC
+    would let two different frames silently pass as replicated).
+    Judged on ALL columns, not just keys: a process-local frame whose
+    key column coincides fleet-wide (e.g. b=[7,7] everywhere after a
+    repartition on a) is NOT replicated, and deduping it locally would
+    silently keep cross-process duplicates — the exact r5-review
+    hazard. The branch taken is uniform fleet-wide: every process
+    enters the one allgather, including processes whose local hash
+    failed (their signature marks not-ok instead of skipping the
+    collective). Single-process programs are trivially replicated.
+    Used by drop_duplicates for replicated-in → replicated-out
+    semantics (ADVICE r5)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return True
+    import hashlib
+
+    from jax.experimental import multihost_utils as _mh
+
+    def _hash_col(h, v) -> None:
+        cells = v if isinstance(v, list) else [v]
+        for c in cells:
+            a = np.asarray(c)
+            h.update(str((a.dtype.str, a.shape)).encode())
+            h.update(
+                str(a.tolist()).encode() if a.dtype == object
+                else np.ascontiguousarray(a).tobytes()
+            )
+
+    h, ok = hashlib.blake2b(digest_size=16), 1
+    try:
+        for name in sorted(cols):
+            h.update(name.encode())
+            _hash_col(h, cols[name])
+    except Exception:  # unhashable layout: the exchange is the safe path
+        ok = 0
+    digest = np.frombuffer(h.digest(), dtype="<i8")  # 2 x int64
+    sig = np.asarray([np.int64(ok), digest[0], digest[1]])
+    sigs = np.asarray(_mh.process_allgather(sig)).reshape(-1, 3)
+    return (
+        all(int(r[0]) == 1 for r in sigs)
+        and len({(int(r[1]), int(r[2])) for r in sigs}) == 1
+    )
+
+
 def _gathered_local_or_raise(frame, names, op_name: str):
     """This process's rows of ``names`` with the fleet-wide eligibility
     VOTE (one collective): every process must gather successfully or
@@ -876,6 +957,11 @@ class TensorFrame:
                             "budget, re-enable the exchange, or sort a "
                             "projected/filtered frame"
                         )
+                    # layout-switch tripwire (ADVICE r5): the result
+                    # contract changes here, once, visibly
+                    _warn_sort_layout_switch(
+                        gbytes, cfg.relational_broadcast_bytes
+                    )
                     t_x = time.perf_counter()
                     part = xch.partition_by_range(
                         [local[k] for k in keys],
@@ -1104,7 +1190,29 @@ class TensorFrame:
             # swapped; select() restores the canonical keys + left +
             # right column order. Unmatched-right rows keep pandas'
             # right-row ordering because they ARE the swapped call's
-            # left rows.
+            # left rows. fill_value is validated HERE, before the
+            # delegation, so errors name how='right' and THIS frame's
+            # (the left side's) columns — the swapped call's messages
+            # would blame how='left' and swap the frames (ADVICE r5).
+            if fill_value is None:
+                raise ValueError(
+                    "how='right' needs fill_value (scalar or "
+                    "{column: value}) for unmatched rows' LEFT-side "
+                    "columns — explicit fills instead of NaN, which "
+                    "would retype integer columns"
+                )
+            if isinstance(fill_value, dict):
+                ks_r = [on] if isinstance(on, str) else list(on)
+                left_need = [
+                    c for c in self.schema.names if c not in ks_r
+                ]
+                missing_r = [c for c in left_need if c not in fill_value]
+                if missing_r:
+                    raise ValueError(
+                        f"how='right': fill_value has no entry for "
+                        f"LEFT-side column(s) {missing_r} (unmatched "
+                        "right rows fill the left frame's columns)"
+                    )
             swapped = other.join(
                 self,
                 on=on,
@@ -1342,16 +1450,18 @@ class TensorFrame:
         grouping convention — strings, mixed objects). Lazy; returns
         one block.
 
-        In MULTI-PROCESS programs the exchange runs for EVERY frame
-        layout (sharded, process-local, or replicated — any
-        ``process_count() > 1``): duplicates COLOCATE under the content
-        hash, so each process's local dedup of its partition is the
-        global dedup, regardless of which process originally held which
-        row. Each process keeps its partition's survivors —
-        process-local result, like join. The exchange preserves
-        (process, local row) order, so keep-first still follows global
-        row order. (A REPLICATED frame's P copies collapse to one
-        survivor per key globally — the dedup of the logical frame.)"""
+        In MULTI-PROCESS programs, frames whose local columns are ALL
+        byte-identical on every process (a replicated frame, checked by
+        a full-content blake2b allgather) dedup LOCALLY: replicated in,
+        replicated out — matching how sort_values/filter/group_by
+        treat non-spanning frames (ADVICE r5). Every other layout
+        (sharded, or process-local frames whose rows differ) takes the
+        hash exchange: duplicates COLOCATE under the content hash, so
+        each process's local dedup of its partition is the global
+        dedup, regardless of which process originally held which row —
+        each process keeps its partition's survivors (process-local
+        result, like join). The exchange preserves (process, local
+        row) order, so keep-first still follows global row order."""
         keys = (
             list(self.schema.names)
             if subset is None
@@ -1368,21 +1478,38 @@ class TensorFrame:
 
             from .ops.keys import group_ids
 
-            # exchange in EVERY multi-process program, not just for
-            # sharded frames: a process-local frame deduped on a key
-            # OTHER than its partition key would silently keep
-            # cross-process duplicates on the local path (code-review
-            # r5); a same-layout re-exchange is mostly sends-to-self
+            # multi-process: REPLICATED frames (identical columns
+            # fleet-wide, proven by the blake2b allgather — a uniform
+            # collective, so every process takes the same branch) dedup
+            # locally, keeping replicated-in → replicated-out like
+            # sort_values/filter/group_by (ADVICE r5). Everything else
+            # exchanges: a process-local frame deduped on a key OTHER
+            # than its partition key would silently keep cross-process
+            # duplicates on the local path (code-review r5).
             if jax.process_count() > 1:
                 from .ops import exchange as xch
 
                 local = _gathered_local_or_raise(
                     parent, names, "drop_duplicates"
                 )
-                part = xch.partition_by_hash(
-                    [local[k] for k in keys], jax.process_count()
-                )
-                cols = xch.exchange_rows(local, part)
+                # a SHARDED frame is never replicated, whatever its
+                # bytes say: its global frame is the concatenation of
+                # the shards, so byte-identical shards (symmetric seed
+                # data) still need the exchange to collapse to ONE
+                # global survivor — the layout check is uniform
+                # fleet-wide, so every process takes the same branch
+                if not parent.is_sharded and _replicated_fleetwide(local):
+                    logger.debug(
+                        "drop_duplicates: every process holds "
+                        "identical local columns — deduping locally "
+                        "(replicated in, replicated out)"
+                    )
+                    cols = local
+                else:
+                    part = xch.partition_by_hash(
+                        [local[k] for k in keys], jax.process_count()
+                    )
+                    cols = xch.exchange_rows(local, part)
             else:
                 cols = _merged_global_columns(
                     parent, names, "drop_duplicates"

@@ -11,7 +11,10 @@ injections, checkpoint IO — and, since the fleet-supervision layer, the
 ``fleet.*`` record family: ``fleet.heartbeat_lost``,
 ``fleet.straggler``, ``fleet.abort`` / ``fleet.abort_seen`` /
 ``fleet.self_abort``, ``fleet.hung_dispatch``, ``fleet.rank_dead``,
-``fleet.restart``) and turns it into a redacted JSONL dump at the
+``fleet.restart``; since the serving layer, the ``serving.*`` family:
+``serving.start`` / ``serving.drain`` / ``serving.stop``,
+``serving.flush``, ``serving.reject``, ``serving.deadline``,
+``serving.error``) and turns it into a redacted JSONL dump at the
 moment of death, so ``read_blackbox()`` shows the whole fleet's history
 after a crash.
 

@@ -597,6 +597,39 @@ class CompiledProgram:
     ) -> Dict[str, np.ndarray]:
         return self._run("vmap", feeds, to_numpy, donate)
 
+    def run_rows_bucketed(
+        self,
+        feeds: Dict[str, np.ndarray],
+        to_numpy: bool = True,
+        donate: bool = False,
+    ) -> Dict[str, np.ndarray]:
+        """The serving layer's batched dispatch entry (ISSUE 9): pad
+        the shared lead dim up the power-of-two ladder
+        (:func:`bucket_rows` — the same policy ``compilecache.warmup``
+        precompiles), run the vmapped program, slice back to the true
+        row count. Unlike ``map_rows``' adaptive bucketing this ALWAYS
+        buckets, so a server warmed over the ladder dispatches any
+        admissible row count with zero steady-state compiles — and a
+        row's result is bit-identical however it was coalesced (vmap is
+        row-independent; padding replicates the last row and is sliced
+        off here)."""
+        sizes = {k: int(np.shape(v)[0]) for k, v in feeds.items()}
+        ns = set(sizes.values())
+        if len(ns) != 1:
+            raise ValueError(
+                f"run_rows_bucketed: feeds disagree on the lead dim: "
+                f"{sizes}"
+            )
+        n = ns.pop()
+        if n == 0:
+            raise ValueError("run_rows_bucketed: zero-row dispatch")
+        feeds = pad_lead_dim(feeds, n, bucket_rows(n))
+        outs = self._run("vmap", feeds, to_numpy=False, donate=donate)
+        outs = {k: v[:n] for k, v in outs.items()}
+        if not to_numpy:
+            return outs
+        return {k: np.asarray(v) for k, v in outs.items()}
+
     def cache_sizes(self) -> Dict[str, int]:
         """Honest recompile accounting (SURVEY §7 hard-part 1): how many
         distinct shapes each entrypoint holds an executable for (AOT

@@ -254,6 +254,20 @@ def _pallas_int8_probe_ok() -> bool:
     return _pallas_int8_state["ok"]
 
 
+#: The activation dtypes the once-per-process probe validates (ADVICE
+#: r5): the probe compiles a bf16 kernel, and f32 shares its Mosaic
+#: lowering family. Anything else (f64 under x64, f16, integers) was
+#: never probed and could fail Mosaic INSIDE the outer jit — exactly
+#: the failure the probe-once gate exists to prevent — so it takes the
+#: XLA structural-fusion path instead.
+_PROBED_DTYPES = (jnp.bfloat16, jnp.float32)
+
+
+def _pallas_dtype_ok(dtype) -> bool:
+    """True when ``dtype`` belongs to the probe-validated family."""
+    return any(dtype == jnp.dtype(d) for d in _PROBED_DTYPES)
+
+
 def _pallas_int8_eligible(x, w) -> bool:
     from ..config import get_config
 
@@ -262,7 +276,7 @@ def _pallas_int8_eligible(x, w) -> bool:
         and isinstance(w, QuantizedTensor)
         and w.q.ndim == 2
         and w.scale.shape[:-1] == (1,)
-        and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        and _pallas_dtype_ok(jnp.asarray(x).dtype)
         and jax.default_backend() == "tpu"
         and _pallas_int8_probe_ok()
     )

@@ -1,0 +1,60 @@
+"""Online serving: continuous batching over the verb engine (ISSUE 9).
+
+The first latency-shaped subsystem in a throughput-shaped codebase:
+an async request front (:class:`Server` — ``submit()`` returns
+futures; :func:`serve_http` is the thin HTTP adapter) that admits
+single-row/small-batch requests against registered Programs, coalesces
+them with a continuous batcher into the executor's power-of-two row
+buckets (the SAME ladder ``compilecache.warmup`` precompiles, so every
+flush is an AOT-cache hit), dispatches through the existing executor,
+and scatters per-request results back with padding-row masking.
+
+Guarantees, stated once:
+
+* **bit-identity** — a coalesced request's rows equal its solo
+  dispatch exactly (row-independent vmapped programs; padding rows are
+  sliced off before scatter);
+* **zero steady-state compiles** — a warmed server never hits XLA
+  under any mix of admissible request sizes;
+* **boundedness** — admission past the queue bound sheds with a
+  counted rejection (never a hang), per-request deadlines follow
+  ``RetryPolicy.deadline_s`` total-elapsed semantics, and shutdown
+  drains gracefully;
+* **observability** — ``tftpu_serving_*`` metrics, ``serving.flush`` /
+  ``serving.request`` trace spans, and flight-recorder ``serving.*``
+  records ride the standard registry/tracer/black-box surfaces.
+
+See docs/serving.md for the operating guide.
+"""
+
+from __future__ import annotations
+
+from . import metrics  # noqa: F401  (registers tftpu_serving_* at import)
+from .batcher import (  # noqa: F401
+    ContinuousBatcher,
+    DeadlineExceededError,
+    RejectedError,
+    ResultFuture,
+    ServingError,
+)
+from .http import serve_http  # noqa: F401
+from .server import (  # noqa: F401
+    Endpoint,
+    Server,
+    ServingConfig,
+    UnknownEndpointError,
+)
+
+__all__ = [
+    "Server",
+    "ServingConfig",
+    "Endpoint",
+    "ContinuousBatcher",
+    "ResultFuture",
+    "ServingError",
+    "RejectedError",
+    "DeadlineExceededError",
+    "UnknownEndpointError",
+    "serve_http",
+    "metrics",
+]

@@ -1,0 +1,141 @@
+"""Thin HTTP adapter over :class:`~tensorframes_tpu.serving.Server`.
+
+The in-process future API is the real surface; this adapter exists so a
+sidecar/load-generator can speak to a server without linking Python —
+the same daemon-thread ``ThreadingHTTPServer`` shape as
+``observability.metrics_server`` (one file, stdlib only, no framework).
+
+Routes:
+
+* ``POST /v1/<endpoint>`` — body ``{"inputs": {col: value|nested list},
+  "deadline_s": float?}``; each handler thread blocks on its request's
+  future (the batcher coalesces across concurrent handlers — the
+  threaded server IS the concurrency source). Replies
+  ``{"outputs": {...}, "rows": n, "latency_s": ...}``.
+* ``GET /healthz`` — ``Server.stats()`` (running flag, endpoints,
+  queue depths, admission counters).
+
+Status mapping keeps the failure taxonomy visible to load balancers:
+400 malformed/validation, 404 unknown endpoint, 429 ``queue_full`` /
+``too_large`` (backpressure shed — retry with backoff), 503 ``closed``
+(draining/stopped), 504 deadline expired, 500 dispatch error.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from ..utils import get_logger
+from ..validation import ValidationError
+from .batcher import DeadlineExceededError, RejectedError
+from .server import Server, UnknownEndpointError
+
+logger = get_logger(__name__)
+
+__all__ = ["serve_http"]
+
+
+def serve_http(server: Server, port: int = 0, addr: str = "127.0.0.1",
+               request_timeout_s: Optional[float] = None):
+    """Serve ``server`` over HTTP from a daemon thread. ``port=0``
+    binds an ephemeral port — read it back from
+    ``httpd.server_address[1]``. Returns the ``ThreadingHTTPServer``;
+    call ``.shutdown()`` to stop (drain the :class:`Server` itself
+    separately — the adapter owns no lifecycle)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.split("?")[0] in ("/", "/healthz"):
+                self._reply(200, server.stats())
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802 - http.server API
+            path = self.path.split("?")[0]
+            if not path.startswith("/v1/"):
+                self._reply(404, {"error": "not found"})
+                return
+            endpoint = path[len("/v1/"):]
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise TypeError(
+                        f"body must be a JSON object, got "
+                        f"{type(req).__name__}"
+                    )
+                inputs = req.get("inputs")
+                deadline_s = req.get("deadline_s")
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"malformed request: {e}"})
+                return
+            t0 = time.perf_counter()
+            try:
+                fut = server.submit(endpoint, inputs,
+                                    deadline_s=deadline_s)
+            except UnknownEndpointError as e:
+                self._reply(404, {"error": str(e)})
+                return
+            except ValidationError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            except RejectedError as e:
+                self._reply(
+                    503 if e.reason == "closed" else 429,
+                    {"error": str(e), "reason": e.reason},
+                )
+                return
+            except (ValueError, TypeError) as e:
+                # submit()'s own argument errors (e.g. deadline_s <= 0)
+                # are client faults; a dispatch-time ValueError raised
+                # through fut.result() below is NOT — it takes the 500
+                # path so clients/load balancers see a server error
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                outs = fut.result(request_timeout_s)
+            except RejectedError as e:
+                self._reply(
+                    503 if e.reason == "closed" else 429,
+                    {"error": str(e), "reason": e.reason},
+                )
+                return
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e)})
+                return
+            except Exception as e:  # dispatch failure: the 500 class
+                logger.warning("serving http dispatch error: %s", e)
+                self._reply(
+                    500, {"error": f"{type(e).__name__}: {e}"}
+                )
+                return
+            self._reply(200, {
+                "outputs": {k: v.tolist() for k, v in outs.items()},
+                "rows": next(iter(outs.values())).shape[0] if outs else 0,
+                "latency_s": round(time.perf_counter() - t0, 6),
+            })
+
+        def log_message(self, *args):  # load generators must not spam
+            pass
+
+    import threading
+
+    httpd = ThreadingHTTPServer((addr, port), Handler)
+    t = threading.Thread(
+        target=httpd.serve_forever, daemon=True, name="tfs-serving-http"
+    )
+    t.start()
+    return httpd

@@ -1,0 +1,113 @@
+"""Serving-layer instruments (``tftpu_serving_*``), registered at import.
+
+The admission-control loop is only tunable if its behavior is a graph:
+how deep the queue runs, why flushes fire (bucket full vs latency timer
+vs drain), how much padding the bucket ladder costs, and where request
+wall-clock goes (queue wait vs dispatch). Every instrument here
+pre-registers at import — including every ``reason=`` label series the
+batcher can emit — so an exposition always carries the full catalog
+(a server that never shed load still exports ``rejected_total{...}=0``).
+
+Label conventions follow the repo rule (TFL003): label VALUE sets are
+closed and enumerated here; per-endpoint cardinality stays out of the
+registry (endpoints ride flight records and trace args instead).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from ..observability.latency import LATENCY_BUCKETS
+from ..observability.metrics import Counter
+from ..observability.metrics import counter as _counter
+from ..observability.metrics import gauge as _gauge
+from ..observability.metrics import histogram as _histogram
+
+__all__ = [
+    "REQUESTS", "ROWS", "REJECTED", "REJECT_REASONS", "QUEUE_DEPTH",
+    "FLUSHES", "FLUSH_REASONS", "BATCH_ROWS", "PADDING_ROWS",
+    "REQUEST_LATENCY", "QUEUE_WAIT", "DISPATCH_SECONDS",
+    "DEADLINE_EXPIRED", "DISPATCH_ERRORS", "rejected",
+]
+
+#: Why an admission was refused (closed set — every series pre-registered).
+REJECT_REASONS: Tuple[str, ...] = ("queue_full", "closed", "too_large")
+
+#: Why a batch left the queue (closed set).
+FLUSH_REASONS: Tuple[str, ...] = ("full", "timer", "drain")
+
+#: Rows-per-flush buckets: the power-of-two ladder serving pads into.
+_BATCH_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+)
+
+REQUESTS = _counter(
+    "tftpu_serving_requests_total",
+    "Requests admitted into the serving queue",
+)
+ROWS = _counter(
+    "tftpu_serving_rows_total",
+    "Rows admitted into the serving queue",
+)
+REJECTED: Dict[str, Counter] = {
+    r: _counter(
+        "tftpu_serving_rejected_total",
+        "Requests refused at admission, by reason (queue_full = "
+        "backpressure shed, closed = server stopped/draining, "
+        "too_large = request exceeds max_batch_rows)",
+        labels={"reason": r},
+    )
+    for r in REJECT_REASONS
+}
+QUEUE_DEPTH = _gauge(
+    "tftpu_serving_queue_depth_rows",
+    "Rows currently waiting in serving queues (all endpoints)",
+)
+FLUSHES: Dict[str, Counter] = {
+    r: _counter(
+        "tftpu_serving_flushes_total",
+        "Coalesced batches dispatched, by flush reason (full = bucket "
+        "target reached, timer = max-latency flush, drain = shutdown)",
+        labels={"reason": r},
+    )
+    for r in FLUSH_REASONS
+}
+BATCH_ROWS = _histogram(
+    "tftpu_serving_batch_rows",
+    "Rows per coalesced flush (pre-padding)",
+    buckets=_BATCH_BUCKETS,
+)
+PADDING_ROWS = _counter(
+    "tftpu_serving_padding_rows_total",
+    "Rows added padding flushes up to the power-of-two bucket ladder",
+)
+REQUEST_LATENCY = _histogram(
+    "tftpu_serving_request_latency_seconds",
+    "Request wall-clock from submit to result ready (queue wait + "
+    "dispatch) — the p50/p99 the bench serving target reports",
+    buckets=LATENCY_BUCKETS,
+)
+QUEUE_WAIT = _histogram(
+    "tftpu_serving_queue_wait_seconds",
+    "Request wall-clock from submit to its flush leaving the queue",
+    buckets=LATENCY_BUCKETS,
+)
+DISPATCH_SECONDS = _histogram(
+    "tftpu_serving_dispatch_seconds",
+    "Wall-clock of one coalesced flush's executor dispatch",
+    buckets=LATENCY_BUCKETS,
+)
+DEADLINE_EXPIRED = _counter(
+    "tftpu_serving_deadline_expired_total",
+    "Requests failed because their deadline passed while queued",
+)
+DISPATCH_ERRORS = _counter(
+    "tftpu_serving_dispatch_errors_total",
+    "Coalesced flushes whose dispatch raised (every member request "
+    "fails with the same error)",
+)
+
+
+def rejected(reason: str) -> Counter:
+    """The pre-registered rejection counter for ``reason``."""
+    return REJECTED[reason]

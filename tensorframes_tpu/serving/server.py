@@ -1,0 +1,461 @@
+"""The in-process async serving front: ``Server.submit()`` → futures.
+
+The stack below this module is batch-shaped: verbs dispatch whole
+frames, the AOT store + ``warmup()`` make cold starts free, and fused
+Programs run an entire pipeline per dispatch (PRs 5/7). This module is
+the latency-shaped consumer the ROADMAP's north star needs: admit
+single-row/small-batch requests against a registered Program (or verb
+chain), coalesce them with the continuous batcher, dispatch through the
+EXISTING executor (one ``run_rows_bucketed`` per flush — the same
+per-shape AOT executables every verb uses), and scatter per-request
+results back with padding-row masking.
+
+Zero-steady-state-compile contract: ``start()`` warms every endpoint
+over :func:`~tensorframes_tpu.compilecache.serving_row_buckets`
+(the power-of-two ladder ``ServingConfig.max_batch_rows`` bounds —
+the SAME policy the batcher pads flushes into), so every flush lands on
+a warmed AOT key: with a persistent store armed, a fresh process
+serves its first request without a single XLA compile.
+
+Lifecycle: ``start()`` (warm + spin batchers) → ``submit()``/``call()``
+→ ``stop(drain=True)`` (admission closes with counted rejections,
+queued work completes, workers join). ``Server`` is also a context
+manager; per-request ``deadline_s`` follows ``RetryPolicy.deadline_s``
+semantics (total elapsed wall-clock — resilience/retry.py), and an
+optional server-wide :class:`~tensorframes_tpu.resilience.RetryPolicy`
+retries transient dispatch failures (XLA programs are pure, hence
+idempotent — the safe case for retry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import flight as _flight
+from ..program import Program
+from ..resilience.retry import RetryPolicy, retry_call
+from ..shape import Unknown
+from ..utils import get_logger
+from ..validation import ValidationError
+from .batcher import (
+    ContinuousBatcher,
+    DeadlineExceededError,
+    RejectedError,
+    ResultFuture,
+    ServingError,
+)
+from . import metrics as m
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "ServingConfig", "Endpoint", "Server",
+    "ServingError", "RejectedError", "DeadlineExceededError",
+    "UnknownEndpointError",
+]
+
+
+class UnknownEndpointError(ValidationError):
+    """``submit()`` to an endpoint name that was never registered.
+
+    A distinct type (not a message substring) so the HTTP adapter can
+    map it to 404 without misclassifying a feed-validation error whose
+    message happens to mention an endpoint."""
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Admission/coalescing knobs, per server.
+
+    ``max_batch_rows`` — flush when pending rows reach this (also the
+    largest admissible single request and the top of the warmed bucket
+    ladder). ``max_latency_s`` — the flush timer: the oldest queued
+    request never waits longer than this before its batch dispatches.
+    ``max_queue_rows`` — admission bound; past it ``submit`` raises
+    :class:`RejectedError` (``reason=queue_full``) instead of queueing
+    unboundedly. ``default_deadline_s`` — deadline applied when a
+    request does not carry its own (None = no deadline).
+    ``warmup`` — precompile the bucket ladder at ``start()``.
+    """
+
+    max_batch_rows: int = 64
+    max_latency_s: float = 0.005
+    max_queue_rows: int = 4096
+    default_deadline_s: Optional[float] = None
+    donate: bool = False
+    warmup: bool = True
+
+
+class Endpoint:
+    """One registered program: feed validation + the coalesced dispatch
+    the batcher calls. Inputs are CELL-shaped (the map_rows convention):
+    a request's feeds carry a leading request-rows dim on every column
+    (a bare cell is accepted as one row)."""
+
+    def __init__(self, name: str, program: Program, donate: bool,
+                 retry: Optional[RetryPolicy]):
+        self.name = name
+        self.program = program
+        self.compiled = program.compiled()
+        self._donate = donate
+        self._retry = retry
+
+    def validate_feeds(self, feeds) -> Dict[str, np.ndarray]:
+        """Normalize one request's feeds: name set must match the
+        program's inputs exactly, dtypes cast to the input specs (the
+        same boundary cast ``gather_feeds`` applies), cell dims checked
+        against the spec, bare cells promoted to one row. Returns dense
+        arrays sharing one lead dim."""
+        if not isinstance(feeds, dict) or not feeds:
+            raise ValidationError(
+                f"endpoint {self.name!r}: feeds must be a non-empty "
+                "dict of column name -> array"
+            )
+        want = set(self.program.input_names)
+        got = set(feeds)
+        if got != want:
+            missing = sorted(want - got)
+            extra = sorted(got - want)
+            raise ValidationError(
+                f"endpoint {self.name!r}: feeds {sorted(got)} do not "
+                f"match program inputs {sorted(want)}"
+                + (f"; missing {missing}" if missing else "")
+                + (f"; unexpected {extra}" if extra else "")
+            )
+        out: Dict[str, np.ndarray] = {}
+        lead: Optional[int] = None
+        lead_of: Optional[str] = None
+        for spec in self.program.inputs:
+            try:
+                arr = np.asarray(feeds[spec.name],
+                                 dtype=spec.dtype.np_dtype)
+            except (TypeError, ValueError) as e:
+                raise ValidationError(
+                    f"endpoint {self.name!r}: feed {spec.name!r} does "
+                    f"not convert to {spec.dtype.name}: {e}"
+                ) from None
+            cell = list(spec.shape.dims)
+            if arr.ndim == len(cell):
+                arr = arr[None]  # bare cell = one row
+            if arr.ndim != len(cell) + 1:
+                raise ValidationError(
+                    f"endpoint {self.name!r}: feed {spec.name!r} has "
+                    f"rank {arr.ndim}, expected cell rank {len(cell)} "
+                    f"(one row) or {len(cell) + 1} (rows-leading batch)"
+                )
+            for got_d, want_d in zip(arr.shape[1:], cell):
+                if want_d != Unknown and int(got_d) != int(want_d):
+                    raise ValidationError(
+                        f"endpoint {self.name!r}: feed {spec.name!r} "
+                        f"cell shape {tuple(arr.shape[1:])} does not "
+                        f"match spec {tuple(cell)}"
+                    )
+            if lead is None:
+                lead, lead_of = int(arr.shape[0]), spec.name
+            elif int(arr.shape[0]) != lead:
+                raise ValidationError(
+                    f"endpoint {self.name!r}: feed {spec.name!r} has "
+                    f"{arr.shape[0]} rows but {lead_of!r} has {lead} — "
+                    "every column of one request must share the lead dim"
+                )
+            out[spec.name] = arr
+        if lead == 0:
+            raise ValidationError(
+                f"endpoint {self.name!r}: zero-row request"
+            )
+        return out
+
+    def dispatch(self, feeds: Dict[str, np.ndarray],
+                 rows: int) -> Dict[str, np.ndarray]:
+        """One coalesced flush through the executor's bucket-ladder
+        entry, under the server's retry policy (pure program ⇒
+        idempotent ⇒ safe to retry)."""
+        return retry_call(
+            self.compiled.run_rows_bucketed, feeds,
+            donate=self._donate,
+            policy=self._retry,
+            describe=f"serving.dispatch[{self.name}]",
+        )
+
+
+class Server:
+    """The serving front: register endpoints, ``start()``, ``submit()``.
+
+    ``register()`` accepts an analyzed :class:`Program` (cell-shaped
+    inputs — what ``tfs.compile_program(fetches, frame, block=False)``
+    returns), or any map_rows-style fetches (DSL nodes / a python
+    function) plus a frame/schema to normalize against.
+    """
+
+    def __init__(self, config: Optional[ServingConfig] = None,
+                 retry: Optional[RetryPolicy] = None):
+        from ..compilecache import serving_row_buckets
+
+        self.config = config or ServingConfig()
+        # checked for warmup=False servers too: flushes above the
+        # ladder dispatch at exact shapes no warmup can ever cover, so
+        # the zero-steady-state-compile contract silently breaks.
+        # serving_row_buckets owns the refusal (ONE bucket policy,
+        # stated once) — the result is discarded, only the bound check
+        # matters here
+        serving_row_buckets(self.config.max_batch_rows)
+        self._retry = retry
+        self._endpoints: Dict[str, Endpoint] = {}
+        self._batchers: Dict[str, ContinuousBatcher] = {}
+        self._lock = threading.Lock()
+        self._running = False
+        self._starting = False
+        self._stop_requested = False
+        self.warmup_reports: Dict[str, object] = {}
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, name: str, fetches, frame_or_schema=None,
+                 feed_dict=None) -> Endpoint:
+        """Register ``fetches`` as endpoint ``name``. Non-Program
+        fetches need ``frame_or_schema`` (a TensorFrame or Schema) to
+        resolve column dtypes/cell shapes, exactly like ``map_rows``."""
+        from ..ops.verbs import _apply_feed_dict, _normalize_program
+
+        if not name or "/" in name:
+            raise ValueError(
+                f"endpoint name must be non-empty and '/'-free, "
+                f"got {name!r}"
+            )
+        schema = getattr(frame_or_schema, "schema", frame_or_schema)
+        if not (isinstance(fetches, Program) and fetches.outputs) \
+                and schema is None:
+            raise ValueError(
+                "register() needs frame_or_schema to normalize "
+                "non-Program fetches (or pass a compile_program result)"
+            )
+        program, _ = _normalize_program(
+            fetches, schema, block=False, feed_dict=feed_dict
+        )
+        program = _apply_feed_dict(program, feed_dict)
+        for spec in program.inputs:
+            if any(d == Unknown for d in spec.shape.dims):
+                # a non-lead Unknown cell dim breaks both serving
+                # contracts at once: two admissible requests with
+                # different concrete extents poison each other's
+                # np.concatenate at flush time, and even homogeneous
+                # flushes dispatch at shapes no warmup ladder covers
+                raise ValueError(
+                    f"endpoint {name!r}: input {spec.name!r} has "
+                    f"cell shape {tuple(spec.shape.dims)} with an "
+                    "Unknown dim — serving endpoints need concrete "
+                    "cell shapes (only the row/lead dim may vary); "
+                    "pad or split the column to a fixed extent"
+                )
+        ep = Endpoint(name, program, self.config.donate, self._retry)
+        with self._lock:
+            if name in self._endpoints:
+                raise ValueError(f"endpoint {name!r} already registered")
+            self._endpoints[name] = ep
+            batcher = ContinuousBatcher(
+                name, ep.dispatch,
+                max_batch_rows=self.config.max_batch_rows,
+                max_latency_s=self.config.max_latency_s,
+                max_queue_rows=self.config.max_queue_rows,
+            )
+            self._batchers[name] = batcher
+            # _starting counts as live: a register racing start()'s
+            # warm loop must warm its own endpoint (start() snapshotted
+            # the endpoint list before warming, but its final loop
+            # starts EVERY batcher — an unwarmed one would silently
+            # break the zero-steady-state-compile contract)
+            live = self._running or self._starting
+        if live:
+            # late registration on a live server: warm OUTSIDE the lock
+            # (a multi-second compile must not block submissions), then
+            # start the batcher only if no concurrent stop() won
+            if self.config.warmup:
+                try:
+                    self.warmup_reports[name] = self._warm(ep)
+                except BaseException:
+                    # a failed warm must not leave a zombie behind: its
+                    # batcher would never start (every submit sheds as
+                    # 'closed') and the name could never be
+                    # re-registered with a fixed program
+                    with self._lock:
+                        self._endpoints.pop(name, None)
+                        self._batchers.pop(name, None)
+                    # start()'s final loop may have started this
+                    # batcher while we warmed (register during
+                    # _starting): stop it so its worker/expirer threads
+                    # don't outlive the rollback (no-op if never
+                    # started; queued futures fail loudly)
+                    batcher.stop(drain=False)
+                    raise
+            with self._lock:
+                if self._running:
+                    batcher.start()
+        return ep
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return sorted(self._endpoints)
+
+    def _warm(self, ep: Endpoint):
+        """Precompile (or disk-load) the endpoint's bucket ladder so the
+        first flush is already a jit-cache hit — warmup-from-serving-
+        config, sharing the batcher's exact bucket policy."""
+        from ..compilecache import serving_row_buckets, warm_program
+
+        report = warm_program(
+            ep.program,
+            rows=serving_row_buckets(self.config.max_batch_rows),
+            block=False,
+            donate=self.config.donate,
+        )
+        logger.info("serving warmup[%s]: %s", ep.name, report.counts())
+        return report
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Server":
+        with self._lock:
+            if self._running or self._starting:
+                return self
+            self._starting = True
+            eps = list(self._endpoints.values())
+        t0 = time.perf_counter()
+        try:
+            if self.config.warmup:
+                for ep in eps:
+                    self.warmup_reports[ep.name] = self._warm(ep)
+        finally:
+            with self._lock:
+                self._starting = False
+        with self._lock:
+            if self._stop_requested:
+                # a stop() arrived mid-warmup: it wins. Leave admission
+                # closed — opening the batchers here would silently
+                # undo a shutdown the caller believes already happened
+                self._stop_requested = False
+                _flight.record(
+                    "serving.start_aborted",
+                    endpoints=sorted(self._endpoints),
+                    warmup_s=round(time.perf_counter() - t0, 6),
+                )
+                return self
+            # batchers open BEFORE the running flag flips: healthz must
+            # never say running=true while submits would shed as
+            # 'closed' — during warmup the server honestly reports
+            # running=false, so load balancers keep traffic away until
+            # admission is actually open
+            for b in self._batchers.values():
+                b.start()
+            self._running = True
+        _flight.record(
+            "serving.start", endpoints=self.endpoints(),
+            warmup_s=round(time.perf_counter() - t0, 6),
+            max_batch_rows=self.config.max_batch_rows,
+            max_latency_s=self.config.max_latency_s,
+        )
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Close admission and shut the batchers down. ``drain=True``
+        (the graceful default) completes every queued request first;
+        ``drain=False`` fails them with :class:`ServingError`. New
+        submissions during and after shutdown get a COUNTED rejection
+        (``reason=closed``), never a hang."""
+        with self._lock:
+            if self._starting:
+                # stop() during start()'s warm loop: record the request
+                # so start() leaves admission closed instead of opening
+                # the batchers after this stop() has returned
+                self._stop_requested = True
+            if not self._running and not self._batchers:
+                return
+            self._running = False
+            batchers = list(self._batchers.values())
+        pending = sum(b.queued_rows for b in batchers)
+        _flight.record(
+            "serving.drain" if drain else "serving.stop",
+            endpoints=self.endpoints(), queued_rows=pending,
+        )
+        for b in batchers:
+            b.stop(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=True)
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, endpoint: str, feeds,
+               deadline_s: Optional[float] = None) -> ResultFuture:
+        """Admit one request; returns a :class:`ResultFuture` resolving
+        to this request's rows of every program output. Raises
+        :class:`RejectedError` on backpressure/closed/oversize (never
+        blocks admission), :class:`ValidationError` on malformed feeds."""
+        try:
+            ep = self._endpoints[endpoint]
+        except KeyError:
+            raise UnknownEndpointError(
+                f"unknown endpoint {endpoint!r}; registered: "
+                f"{self.endpoints()}"
+            ) from None
+        arrs = ep.validate_feeds(feeds)
+        rows = int(next(iter(arrs.values())).shape[0])
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 (got {deadline_s}) — the same "
+                "contract as RetryPolicy.deadline_s"
+            )
+        return self._batchers[endpoint].offer(arrs, rows, deadline_s)
+
+    def call(self, endpoint: str, feeds,
+             deadline_s: Optional[float] = None,
+             timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Synchronous convenience: ``submit(...).result(...)``."""
+        return self.submit(endpoint, feeds, deadline_s).result(timeout)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """Queue depths + THIS server's admission counters, for health
+        endpoints. Summed from the per-batcher counters — the registry's
+        ``tftpu_serving_*`` series are process-wide, so a fresh server
+        (or one of several in a process) must not report a sibling's
+        traffic as its own."""
+        with self._lock:
+            batchers = dict(self._batchers)
+            running = self._running
+        queues: Dict[str, int] = {}
+        totals = {
+            "admitted_requests": 0,
+            "admitted_rows": 0,
+            "rejected": {r: 0 for r in m.REJECT_REASONS},
+            "deadline_expired": 0,
+        }
+        for name, b in batchers.items():
+            snap = b.counters()
+            queues[name] = snap["queued_rows"]
+            totals["admitted_requests"] += snap["admitted_requests"]
+            totals["admitted_rows"] += snap["admitted_rows"]
+            for r, c in snap["rejected"].items():
+                totals["rejected"][r] += c
+            totals["deadline_expired"] += snap["deadline_expired"]
+        return {
+            "running": running,
+            "endpoints": sorted(queues),
+            "queued_rows": queues,
+            **totals,
+        }

@@ -274,6 +274,37 @@ sblen = np.asarray(
     mhx.process_allgather(np.asarray([len(sb)], np.int64))
 ).reshape(-1)
 assert int(sblen.sum()) == 1, sblen  # one global survivor, not one/proc
+# replicated-in → replicated-out (ADVICE r5): a frame built IDENTICALLY
+# on every process (all columns byte-equal fleet-wide) dedups LOCALLY —
+# every process keeps every unique row, instead of being converted into
+# per-process hash partitions like the process-local frames above
+repf = tfs.frame_from_arrays(
+    {{"k": np.asarray([1, 2, 1, 3], np.int64),
+      "v": np.asarray([1.0, 2.0, 3.0, 4.0])}})
+rsurv = repf.drop_duplicates(subset="k").collect()
+assert [int(r["k"]) for r in rsurv] == [1, 2, 3], rsurv
+assert [float(r["v"]) for r in rsurv] == [1.0, 2.0, 4.0], rsurv
+rlen = np.asarray(
+    mhx.process_allgather(np.asarray([len(rsurv)], np.int64))
+).reshape(-1)
+assert (rlen == 3).all(), rlen  # replicated result on every process
+# ...but a SHARDED frame whose per-process shards happen to be
+# byte-identical (symmetric seed data) is NOT replicated — its global
+# frame is the concatenation of the shards, so dedup must still
+# exchange and collapse to ONE global survivor; the content hash alone
+# would misclassify this (review r9: the layout check precedes it)
+sym = frame_from_process_local(
+    {{"k": np.asarray([5, 5], np.int64)}}, mesh=mesh, axis="dp",
+)
+ssurv = sym.drop_duplicates(subset="k").collect()
+sslen = np.asarray(
+    mhx.process_allgather(np.asarray([len(ssurv)], np.int64))
+).reshape(-1)
+assert int(sslen.sum()) == 1, sslen  # one GLOBAL survivor, not one/proc
+# ... and the sort_values layout-switch tripwire (ADVICE r5) fired when
+# the over-budget sort above took the range exchange (budget was 64B)
+from tensorframes_tpu import frame as _frame_mod
+assert _frame_mod._sort_layout_warned  # one-time warning happened
 # exchange observability: the shuffle plans record their own spans
 from tensorframes_tpu.utils import profiling as _prof
 _rep = _prof.report()
