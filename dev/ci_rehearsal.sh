@@ -123,6 +123,14 @@ print('compilecache smoke: disk hits =', int(hits))
 \"
 "
 
+# ci.yml's sharded compile-cache smoke (ISSUE 10): the
+# tests/test_distributed.py cache worker runs twice in fresh
+# subprocesses sharing one TFTPU_COMPILE_CACHE; run 2 must report
+# tftpu_compilecache_hits_total > 0 and ZERO XLA compiles from its
+# metrics JSONL, with bit-identical sharded results across the runs
+run_step "Sharded compile-cache round-trip smoke (unified AOT dispatch)" \
+  python -m pytest tests/test_distributed.py::test_sharded_cache_roundtrip_across_processes -q
+
 # ci.yml's observability smoke: the telemetry example must produce all
 # three artifacts (Chrome trace, metrics JSONL, step log) and the tier-1
 # run above must have exported its own pair

@@ -93,6 +93,8 @@ def lint_program(
     block_row_counts: Optional[Tuple[int, ...]] = None,
     hbm_budget_bytes: Optional[int] = None,
     subject: str = "",
+    mesh=None,
+    shardings=None,
 ) -> DiagnosticReport:
     """Statically lint a :class:`~tensorframes_tpu.program.Program`.
 
@@ -101,9 +103,19 @@ def lint_program(
     ``hbm_budget_bytes`` overrides the device budget for TFG106 (by
     default the first device's reported ``bytes_limit``; the rule is
     silent when the backend reports none, as XLA:CPU does).
+    ``mesh``/``shardings`` lint a program as its SHARDED dispatches
+    run: TFG108's stability probes re-trace under the mesh context
+    with the per-input shardings in the probed cache key (still zero
+    compiles, zero device transfers) — ``analyze_frame`` fills both
+    from a sharded frame automatically.
     """
+    from ..parallel._shard_map import mesh_context
+
     eff = _effective_program(program)
-    closed, in_names, in_avals, out_names, out_avals, err = _trace(eff, probe)
+    with mesh_context(mesh):
+        closed, in_names, in_avals, out_names, out_avals, err = _trace(
+            eff, probe
+        )
     ctx = RuleContext(
         program=eff,
         probe=probe,
@@ -116,6 +128,8 @@ def lint_program(
         block_row_counts=block_row_counts,
         hbm_budget_bytes=hbm_budget_bytes,
         trace_error=err,
+        mesh=mesh,
+        shardings=shardings,
     )
     diags = run_rules(ctx, codes=rules)
     return DiagnosticReport(
@@ -140,20 +154,51 @@ def analyze_frame(
     plain functions / Programs, feed_dict renames, x64 demotion), then
     lint with frame context: block-shape distribution feeds the
     TFG101 storm check **only when the frame is already materialized**
-    — analysis never forces a lazy frame's pending computation.
+    — analysis never forces a lazy frame's pending computation. A
+    sharded frame lints under its mesh context (normalization and the
+    TFG108 stability probes alike): programs using sharding
+    constraints/collectives analyze exactly as the executor traces
+    them, with zero device transfers.
     """
     from ..ops.verbs import _apply_feed_dict, _normalize_program
+    from ..parallel._shard_map import mesh_context
 
-    program, _ = _normalize_program(
-        fetches, frame.schema, block=block, reduce_mode=reduce_mode,
-        feed_dict=feed_dict,
-    )
+    with mesh_context(frame.mesh if frame.is_sharded else None):
+        program, _ = _normalize_program(
+            fetches, frame.schema, block=block, reduce_mode=reduce_mode,
+            feed_dict=feed_dict,
+        )
     program = _apply_feed_dict(program, feed_dict)
     counts: Optional[Tuple[int, ...]] = None
     if frame.is_materialized:
         from ..frame import _block_num_rows
 
         counts = tuple(_block_num_rows(b) for b in frame.blocks())
+    mesh = None
+    shardings = None
+    if frame.is_sharded:
+        # lint the program as its sharded dispatches will key: the
+        # executor feeds each device column as a global array under the
+        # frame's batch sharding, and those layout axes are part of the
+        # persistent-store fingerprint (ISSUE 10)
+        from ..config import get_config
+        from ..parallel.mesh import batch_sharding
+
+        mesh = frame.mesh
+        axis = getattr(frame, "_axis", None) or get_config().batch_axis
+        shardings = {}
+        for spec in program.inputs:
+            try:
+                col = frame.schema[spec.name]
+                if not col.is_device:
+                    continue
+            except KeyError:
+                # not a frame column (feed_dict host array): the real
+                # dispatch feeds it placement-free — probing it sharded
+                # would fingerprint a key the executor never computes
+                continue
+            rank = max(1, len(spec.shape.dims))
+            shardings[spec.name] = batch_sharding(mesh, rank, axis)
     return lint_program(
         program,
         probe=probe,
@@ -162,6 +207,8 @@ def analyze_frame(
         block_row_counts=counts,
         hbm_budget_bytes=hbm_budget_bytes,
         subject=f"fetches×frame({', '.join(frame.schema.names)})",
+        mesh=mesh,
+        shardings=shardings,
     )
 
 

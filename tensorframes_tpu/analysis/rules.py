@@ -83,6 +83,16 @@ class RuleContext:
     #: reason (lint_plan only): dicts with ``verb``, ``reason`` —
     #: recorded by plan.ir.mark_unfused, read by TFG109.
     unfused_epilogues: Optional[Sequence[dict]] = None
+    #: Ambient mesh for sharded programs (``analyze_frame`` passes the
+    #: frame's mesh): TFG108's stability probes re-trace under it, so
+    #: programs using collectives/sharding constraints lint instead of
+    #: silently skipping. Tracing stays abstract — no device transfers.
+    mesh: object = None
+    #: Input-name → sharding the executor will dispatch with (sharded
+    #: frames: the batch sharding per device column). Part of the
+    #: probed cache key — the fingerprint must be stable WITH the
+    #: layout axes in it, exactly as the store keys executables.
+    shardings: Optional[Dict[str, object]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -628,34 +638,125 @@ def _rule_unfused_aggregate(ctx: RuleContext) -> List[Diagnostic]:
 # TFG108 — cache-fingerprint-unstable (persistent-cache miss storm)
 # ---------------------------------------------------------------------------
 
+def _unstable_axis_evidence(ctx: RuleContext) -> str:
+    """Name the sharding axis implicated in a jaxpr-component
+    instability: re-trace under the mesh until a pair of rebuilds
+    differs (the instability is by definition non-deterministic, so a
+    single pair can coincide), diff the scrubbed jaxpr texts
+    line-by-line, and compare the ``PartitionSpec(...)`` annotations on
+    the first differing line — a sharding constraint that flips axes
+    between rebuilds prints its spec into the jaxpr. Empty when no
+    differing pair was seen or the diff names no spec."""
+    import re as _re
+
+    import jax
+
+    from ..compilecache.fingerprint import _scrub
+    from ..parallel._shard_map import mesh_context
+    from ..program import _abstract_inputs
+
+    spec_re = _re.compile(r"PartitionSpec\([^)]*\)")
+    try:
+        abstract = _abstract_inputs(ctx.program.inputs, ctx.probe)
+
+        def trace_text() -> str:
+            def rebuilt(feeds):
+                return ctx.program.fn(feeds)
+
+            with mesh_context(ctx.mesh):
+                return _scrub(str(jax.make_jaxpr(rebuilt)(abstract).jaxpr))
+
+        first = trace_text()
+        second = first
+        for _ in range(4):
+            second = trace_text()
+            if second != first:
+                break
+        if second == first:
+            return ""
+        for la, lb in zip(first.splitlines(), second.splitlines()):
+            if la == lb:
+                continue
+            sa, sb = spec_re.findall(la), spec_re.findall(lb)
+            if sa != sb and (sa or sb):
+                axes = sorted(
+                    set(_re.findall(r"'([^']+)'", " ".join(sa)))
+                    ^ set(_re.findall(r"'([^']+)'", " ".join(sb)))
+                )
+                named = f" (unstable axis: {'/'.join(axes)})" if axes \
+                    else ""
+                return (" — a sharding annotation flips between "
+                        f"rebuilds: {' '.join(sa) or '<none>'} vs "
+                        f"{' '.join(sb) or '<none>'}{named}")
+            return (f" — first differing trace line: {la.strip()!r} vs "
+                    f"{lb.strip()!r}")
+    except Exception:  # pragma: no cover - evidence is best-effort
+        pass
+    return ""
+
+
 def _rule_fingerprint_unstable(ctx: RuleContext) -> List[Diagnostic]:
     """The persistent compile cache (tensorframes_tpu/compilecache)
-    keys executables by a content hash of the traced program. A program
-    whose fingerprint differs across two *identical* rebuilds — e.g. a
-    captured constant produced by unseeded randomness at trace time, or
-    any capture that serializes non-deterministically — can never hit
-    the store: every process start recompiles everything it ships (a
-    miss storm). Two independent traces here; still zero compiles."""
+    keys executables by a content hash of the traced program — since
+    the unified AOT dispatch (ISSUE 10), with the mesh/sharding/
+    topology axes in the key. A program whose fingerprint differs
+    across two *identical* rebuilds — a captured constant produced by
+    unseeded randomness at trace time, any capture that serializes
+    non-deterministically, or a sharding annotation whose axes flip
+    between rebuilds — can never hit the store: every process start
+    (every RANK of every restart, for a fleet) recompiles everything
+    it ships — a miss storm. Two independent traces here, run under
+    the program's mesh context for sharded programs, with zero
+    compiles and zero device transfers (``value_policy='host_only'``
+    keeps device-resident captures out of the value hash — the one
+    blind spot: a PLAIN-form program whose device-resident capture
+    VALUES differ per process start misses the store without tripping
+    this rule; hoist or seed such captures);
+    :func:`~tensorframes_tpu.compilecache.fingerprint.fingerprint_components`
+    names the component that moved instead of an opaque hash."""
     if ctx.program is None or ctx.closed is None:
         return []
     from ..compilecache.fingerprint import program_fingerprint
 
-    a = program_fingerprint(ctx.program, probe=ctx.probe)
-    b = program_fingerprint(ctx.program, probe=ctx.probe)
+    kw = dict(probe=ctx.probe, mesh=ctx.mesh, shardings=ctx.shardings)
+    a = program_fingerprint(ctx.program, components=True, **kw)
+    b = program_fingerprint(ctx.program, components=True, **kw)
     if a is None or b is None or a == b:
         return []
+    moved = [k for k in ("jaxpr", "consts", "avals", "outs", "env")
+             if a.get(k) != b.get(k)]
+    sh_moved = sorted(
+        n for n in set(a.get("shardings", {})) | set(b.get("shardings", {}))
+        if a.get("shardings", {}).get(n) != b.get("shardings", {}).get(n)
+    )
+    moved += [f"shardings[{n}]" for n in sh_moved]
+    evidence = ""
+    if ctx.mesh is not None and "jaxpr" in moved:
+        evidence = _unstable_axis_evidence(ctx)
+    what = {
+        "jaxpr": "the traced jaxpr itself (trace-time control flow or "
+                 "annotations differ between rebuilds)",
+        "consts": "a captured constant serializes non-deterministically",
+        "avals": "the abstract input signature",
+        "outs": "the fetch order",
+        "env": "the environment component",
+    }
+    detail = "; ".join(what.get(m, m) for m in moved)
     return [Diagnostic(
         "TFG108", "warn",
         "cache fingerprint differs across two identical rebuilds of "
-        "this program: a captured constant serializes "
-        "non-deterministically, so the persistent compile cache "
+        f"this program (unstable component(s): {', '.join(moved)} — "
+        f"{detail}{evidence}): the persistent compile cache "
         "(TFTPU_COMPILE_CACHE) misses on every process start — a "
-        "miss storm that recompiles from scratch each launch",
+        "miss storm that recompiles from scratch each launch, on "
+        "every rank of a sharded fleet",
         subject="program",
         fix="make trace-time captures deterministic (seed the RNG that "
             "builds captured arrays, avoid set/dict-order-dependent "
-            "constructions); closure values must be a pure function of "
-            "the program definition for the cache key to be stable",
+            "constructions, pick sharding/partition axes from a fixed "
+            "list rather than an unordered collection); closure values "
+            "and sharding annotations must be a pure function of the "
+            "program definition for the cache key to be stable",
     )]
 
 

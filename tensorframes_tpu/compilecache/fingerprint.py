@@ -15,15 +15,27 @@ artifact:
   arguments, so different weights share one cached executable;
 * the **feed-shape bucket**: sorted (name, shape, dtype) of the
   abstract inputs the executable was specialized to;
+* the **input shardings**: per-argument sharding descriptors
+  (mesh axis names + shape + device assignment + per-dim partition
+  spec — :func:`~tensorframes_tpu.parallel.mesh.sharding_descriptor`),
+  because an AOT executable is layout-specialized and XLA compiles a
+  different collective schedule per layout;
 * the **dtype policy** (x64 flag + demotion mode) and the fetch order;
-* the **environment**: backend, device kind, device/process count,
-  ``XLA_FLAGS``, jax version, entry kind (block/vmap), donation and
-  hoist flags, and the store format version.
+* the **environment**: backend, device kind, device/process count, the
+  process-index-independent **fleet topology** (device → process map,
+  :func:`~tensorframes_tpu.parallel.distributed.process_topology` —
+  every rank of an SPMD fleet computes the same key, so one rank's
+  published executable is every rank's hit; resizing the fleet misses
+  cleanly), ``XLA_FLAGS``, jax version, entry kind (block/vmap/fn),
+  donation and hoist flags, and the store format version.
 
 ``TFG108`` (analysis/rules.py) calls :func:`program_fingerprint` twice
 with independent traces: a program whose fingerprint differs across
 identical rebuilds (non-deterministically serialized captures) would
 miss the persistent store on every process start — a miss storm.
+:func:`fingerprint_components` exposes the per-component digests so the
+rule can *name* the unstable component (including which input's
+sharding) instead of reporting an opaque hash mismatch.
 """
 
 from __future__ import annotations
@@ -37,11 +49,13 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 #: Bumped whenever the entry layout or key composition changes: old
-#: entries simply miss (never mis-deserialize).
-FORMAT_VERSION = 1
+#: entries simply miss (never mis-deserialize). v2: sharding/topology
+#: axes joined the key (unified sharded/multi-process AOT dispatch).
+FORMAT_VERSION = 2
 
 __all__ = [
     "FORMAT_VERSION",
+    "fingerprint_components",
     "fingerprint_from_closed",
     "program_fingerprint",
 ]
@@ -87,6 +101,7 @@ def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
     import jax
 
     from ..config import get_config
+    from ..parallel.distributed import process_topology
 
     cfg = get_config()
     dev = jax.devices()[0]
@@ -96,7 +111,10 @@ def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
         "backend": jax.default_backend(),
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "n_devices": jax.device_count(),
-        "n_processes": jax.process_count(),
+        # the full device→process topology, not just counts: one rank's
+        # published executable must be every peer's hit, and a resized
+        # or reshaped fleet must miss cleanly
+        "topology": process_topology(),
         "x64": bool(jax.config.jax_enable_x64),
         "demote_x64": str(cfg.demote_x64_on_tpu),
         "xla_flags": os.environ.get("XLA_FLAGS", ""),
@@ -104,6 +122,100 @@ def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
         "donate": bool(donate),
         "form": "hoisted" if hoisted else "plain",
     }
+
+
+def _sharding_parts(avals, shardings) -> Dict[str, object]:
+    """Per-input sharding descriptors keyed by input name. ``shardings``
+    maps input name → sharding (or is None); descriptors normalize the
+    trivial placement to None so unsharded keys are layout-free."""
+    from ..parallel.mesh import sharding_descriptor
+
+    out: Dict[str, object] = {}
+    if not shardings:
+        return out
+    for (name, _, _) in avals:
+        desc = sharding_descriptor(shardings.get(name))
+        if desc is not None:
+            out[str(name)] = desc
+    return out
+
+
+def _key_slots(
+    closed,
+    avals: Sequence[Tuple[str, Tuple[int, ...], str]],
+    out_names: Sequence[str],
+    *,
+    kind: str,
+    donate: bool,
+    hoisted: bool,
+    value_policy: str,
+    shardings: Optional[Dict[str, object]],
+    extra: Optional[Dict[str, object]],
+) -> Dict[str, bytes]:
+    """Every slot of the cache key, serialized ONCE. The composed hash
+    (:func:`fingerprint_from_closed`) and the per-component digests
+    (:func:`fingerprint_components`) both derive from this dict, so a
+    slot added to one pipeline can never silently miss the other —
+    TFG108 would otherwise report a program stable while the real store
+    key moved."""
+    ch = hashlib.sha256(b"consts:%d|" % len(closed.consts))
+    for c in closed.consts:
+        _const_digest(ch, c, include_values=not hoisted,
+                      value_policy=value_policy)
+    return {
+        "jaxpr": _scrub(str(closed.jaxpr)).encode(),
+        "consts": ch.digest(),
+        "avals": json.dumps(
+            [(n, list(s), d) for (n, s, d) in avals], sort_keys=True
+        ).encode(),
+        "outs": json.dumps(list(out_names)).encode(),
+        "shardings": json.dumps(
+            _sharding_parts(avals, shardings), sort_keys=True
+        ).encode(),
+        "env": json.dumps(
+            _env_parts(kind, donate, hoisted), sort_keys=True
+        ).encode(),
+        "extra": json.dumps(extra or {}, sort_keys=True).encode(),
+    }
+
+
+def fingerprint_components(
+    closed,
+    avals: Iterable[Tuple[str, Tuple[int, ...], str]],
+    out_names: Sequence[str],
+    *,
+    kind: str = "block",
+    donate: bool = False,
+    hoisted: bool = False,
+    value_policy: str = "all",
+    shardings: Optional[Dict[str, object]] = None,
+    extra: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    """The fingerprint's per-component digests: ``jaxpr``, ``consts``,
+    ``avals``, ``outs``, ``env``, ``extra`` (each a short hex digest)
+    plus ``shardings`` (a dict input-name → per-input descriptor
+    digest). Two traces of a stable program agree on every component;
+    TFG108 diffs the dicts to name exactly what moved."""
+    avals = list(avals)
+    slots = _key_slots(
+        closed, avals, out_names, kind=kind, donate=donate,
+        hoisted=hoisted, value_policy=value_policy,
+        shardings=shardings, extra=extra,
+    )
+    out: Dict[str, object] = {
+        name: hashlib.sha256(payload).hexdigest()[:16]
+        for name, payload in slots.items()
+        if name != "shardings"
+    }
+    # shardings stay per-input so TFG108 can name WHICH input's layout
+    # moved (same _sharding_parts the composed slot serializes)
+    out["shardings"] = {
+        name: hashlib.sha256(
+            json.dumps(desc, sort_keys=True).encode()
+        ).hexdigest()[:16]
+        for name, desc in _sharding_parts(avals, shardings).items()
+    }
+    return out
 
 
 def fingerprint_from_closed(
@@ -115,26 +227,32 @@ def fingerprint_from_closed(
     donate: bool = False,
     hoisted: bool = False,
     value_policy: str = "all",
+    shardings: Optional[Dict[str, object]] = None,
+    extra: Optional[Dict[str, object]] = None,
 ) -> str:
     """Fingerprint an already-traced program.
 
     ``closed`` is the ``ClosedJaxpr`` of the (possibly vmapped) entry
     function; ``avals`` the sorted (name, shape, dtype-str) triples of
     the feed the executable is specialized to; ``out_names`` the fetch
-    order. Hoisted form excludes const *values* from the key (they are
-    runtime arguments of the cached executable).
+    order; ``shardings`` an optional input-name → sharding map (only
+    non-trivial placements enter the key). Hoisted form excludes const
+    *values* from the key (they are runtime arguments of the cached
+    executable). ``extra`` is a JSON-able dict folded into the key for
+    entry-specific identity the other slots don't carry (``aot_jit``
+    puts its declared in/out sharding trees, label, and weak-type
+    flags here).
     """
+    slots = _key_slots(
+        closed, list(avals), out_names, kind=kind, donate=donate,
+        hoisted=hoisted, value_policy=value_policy,
+        shardings=shardings, extra=extra,
+    )
     h = hashlib.sha256()
-    h.update(_scrub(str(closed.jaxpr)).encode())
-    h.update(b"|consts:%d|" % len(closed.consts))
-    for c in closed.consts:
-        _const_digest(h, c, include_values=not hoisted,
-                      value_policy=value_policy)
-    h.update(json.dumps({
-        "avals": [(n, list(s), d) for (n, s, d) in avals],
-        "outs": list(out_names),
-        "env": _env_parts(kind, donate, hoisted),
-    }, sort_keys=True).encode())
+    for name in sorted(slots):
+        h.update(name.encode() + b":")
+        h.update(slots[name])
+        h.update(b"|")
     return h.hexdigest()[:40]
 
 
@@ -146,14 +264,24 @@ def program_fingerprint(
     donate: bool = False,
     hoisted: bool = False,
     value_policy: str = "host_only",
-) -> Optional[str]:
+    mesh=None,
+    shardings: Optional[Dict[str, object]] = None,
+    components: bool = False,
+):
     """Trace ``program`` fresh and fingerprint it (plain form by
     default — const values in the key, exactly what the executor uses
     when constant hoisting is off). Each call re-traces, so two calls
-    on one program probe rebuild stability (TFG108). Returns None when
-    the program cannot be traced."""
+    on one program probe rebuild stability (TFG108). ``mesh`` installs
+    the ambient mesh context for the trace (a sharded program must be
+    probed exactly as the executor traces it — still zero device
+    transfers: tracing is abstract and ``value_policy='host_only'``
+    keeps device-resident captures out of the value hash).
+    ``components=True`` returns the per-component digest dict
+    (:func:`fingerprint_components`) instead of the composed hash.
+    Returns None when the program cannot be traced."""
     import jax
 
+    from ..parallel._shard_map import mesh_context
     from ..program import _abstract_inputs
 
     abstract = _abstract_inputs(program.inputs, probe)
@@ -166,7 +294,8 @@ def program_fingerprint(
         return program.fn(feeds)
 
     try:
-        closed = jax.make_jaxpr(rebuilt)(abstract)
+        with mesh_context(mesh):
+            closed = jax.make_jaxpr(rebuilt)(abstract)
     except Exception:
         return None
     avals = sorted(
@@ -174,7 +303,8 @@ def program_fingerprint(
         for name, a in abstract.items()
     )
     outs = list(program.fetch_order or [o.name for o in program.outputs])
-    return fingerprint_from_closed(
+    fn = fingerprint_components if components else fingerprint_from_closed
+    return fn(
         closed, avals, outs, kind=kind, donate=donate, hoisted=hoisted,
-        value_policy=value_policy,
+        value_policy=value_policy, shardings=shardings,
     )

@@ -90,9 +90,20 @@ _FALLBACKS = {
     )
     for reason in (
         "corrupt", "deserialize", "store_error", "tree_unsupported",
-        "unavailable",
+        "unavailable", "unfingerprintable",
     )
 }
+
+
+def note_unfingerprintable() -> None:
+    """Count a dispatch that skipped the store because its program
+    could not be fingerprinted — e.g. a plain-form baked const whose
+    values cannot be hashed (a non-addressable multi-process global
+    capture). The dispatch still AOT-compiles in-process; it just never
+    publishes or hits, which on a fleet means every rank of every
+    restart recompiles — this counter is how that shows up instead of
+    staying a debug-level log line."""
+    _FALLBACKS["unfingerprintable"].inc()
 
 _STORE_LOCK = threading.Lock()
 _STORES: Dict[Tuple[str, int], Optional["CompileCacheStore"]] = {}
@@ -104,13 +115,26 @@ _STORES: Dict[Tuple[str, int], Optional["CompileCacheStore"]] = {}
 
 def _encode_skeleton(obj) -> object:
     """Pytree container skeleton → JSON-able form. Leaves become the
-    marker 0; only dict (str keys) / list / tuple / None containers are
-    supported — anything else raises and the entry is not stored."""
+    marker 0; dict (str keys) / list / tuple / namedtuple / None
+    containers are supported — anything else raises and the entry is
+    not stored. Namedtuples (optax optimizer states — the generic
+    ``aot_jit`` entry serializes whole train steps) record their
+    importable class path and are reconstructed at load; a class that
+    no longer imports degrades to a fresh compile like any other
+    defect."""
     if isinstance(obj, dict):
         if not all(isinstance(k, str) for k in obj):
             raise TypeError("non-string dict keys in pytree")
         return {"t": "d", "k": sorted(obj),
                 "v": [_encode_skeleton(obj[k]) for k in sorted(obj)]}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        cls = type(obj)
+        if cls.__module__ in (None, "__main__"):
+            raise TypeError(
+                f"namedtuple {cls.__name__} is not importable cross-process"
+            )
+        return {"t": "nt", "c": f"{cls.__module__}:{cls.__qualname__}",
+                "v": [_encode_skeleton(x) for x in obj]}
     if isinstance(obj, tuple):
         return {"t": "t", "v": [_encode_skeleton(x) for x in obj]}
     if isinstance(obj, list):
@@ -120,12 +144,28 @@ def _encode_skeleton(obj) -> object:
     return 0  # leaf
 
 
+def _resolve_namedtuple(path: str):
+    import importlib
+
+    mod_name, _, qual = path.partition(":")
+    obj = importlib.import_module(mod_name)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    if not (isinstance(obj, type) and issubclass(obj, tuple)
+            and hasattr(obj, "_fields")):
+        raise TypeError(f"{path} is not a namedtuple class")
+    return obj
+
+
 def _decode_skeleton(enc) -> object:
     if enc == 0:
         return 0
     t = enc["t"]
     if t == "d":
         return {k: _decode_skeleton(v) for k, v in zip(enc["k"], enc["v"])}
+    if t == "nt":
+        cls = _resolve_namedtuple(enc["c"])
+        return cls(*(_decode_skeleton(v) for v in enc["v"]))
     if t == "t":
         return tuple(_decode_skeleton(v) for v in enc["v"])
     if t == "l":
@@ -163,6 +203,13 @@ class CompileCacheStore:
         self.manifest_path = os.path.join(root, "manifest.jsonl")
         self._manifest_seen: set = set()
         self._lock = threading.Lock()
+        # fingerprints whose SHARED entry failed to deserialize on this
+        # rank (multi-process only): the recompile publishes under a
+        # rank-scoped key instead, and later lookups prefer it — the
+        # "per-rank disambiguation only where XLA partitions differ"
+        # escape hatch. Fleets whose ranks load each other's entries
+        # (the SPMD norm: one global module) never populate this.
+        self._rank_incompatible: set = set()
         os.makedirs(root, exist_ok=True)
 
     # -- paths --------------------------------------------------------------
@@ -211,13 +258,39 @@ class CompileCacheStore:
             raise ValueError("payload CRC mismatch")
         return header, payload
 
-    def get(self, fp: str):
+    @staticmethod
+    def _rank_fp(fp: str, rank: int) -> str:
+        return f"{fp}_r{int(rank)}"
+
+    def get(self, fp: str, rank: Optional[int] = None):
         """Load and deserialize the executable for ``fp``. Returns the
         loaded callable or None (miss / any defect — defects are
-        counted, quarantined, and never raised)."""
+        counted, quarantined, and never raised).
+
+        ``rank`` (multi-process fleets pass their process index) arms
+        per-rank disambiguation: a rank-scoped entry ``<fp>_r<rank>``
+        is preferred when present, and a SHARED entry that fails to
+        deserialize on this rank is left in place for the peers that
+        CAN load it (quarantining would thrash the fleet) — this rank
+        remembers the incompatibility and republishes rank-scoped."""
+        if rank is not None:
+            scoped = self._load_one(self._rank_fp(fp, rank), shared=False,
+                                    count_miss=False)
+            if scoped is not None:
+                return scoped
+        loaded = self._load_one(fp, shared=rank is not None)
+        if loaded is None and rank is not None and os.path.exists(
+            self._path(fp)
+        ):
+            with self._lock:
+                self._rank_incompatible.add(fp)
+        return loaded
+
+    def _load_one(self, fp: str, shared: bool, count_miss: bool = True):
         path = self._path(fp)
         if not os.path.exists(path):
-            _MISSES.inc()
+            if count_miss:
+                _MISSES.inc()
             return None
         t0 = time.perf_counter()
         try:
@@ -241,13 +314,16 @@ class CompileCacheStore:
             )
         except Exception as e:
             # structurally sound but not loadable here (runtime drift,
-            # incompatible executable): fall back, drop the entry so a
-            # fresh compile re-publishes a loadable one
+            # incompatible executable): fall back. Single-process drops
+            # the entry so a fresh compile re-publishes a loadable one;
+            # a fleet rank leaves the shared entry for its peers and
+            # goes rank-scoped instead (see get()).
             logger.warning("compile cache entry %s failed to "
                            "deserialize (%s); falling back to compile",
                            os.path.basename(path), e)
             _FALLBACKS["deserialize"].inc()
-            self._quarantine(path)
+            if not shared:
+                self._quarantine(path)
             return None
         _HITS.inc()
         _LOAD_SECONDS.observe(time.perf_counter() - t0)
@@ -265,9 +341,19 @@ class CompileCacheStore:
 
     # -- write --------------------------------------------------------------
 
-    def put(self, fp: str, compiled, meta: Optional[dict] = None) -> bool:
+    def put(self, fp: str, compiled, meta: Optional[dict] = None,
+            rank: Optional[int] = None) -> bool:
         """Serialize + publish one executable. Best-effort: returns
-        False (and counts the reason) instead of raising."""
+        False (and counts the reason) instead of raising. With ``rank``
+        given and ``fp`` previously observed rank-incompatible (a peer's
+        shared entry would not deserialize here — see :meth:`get`), the
+        entry publishes under the rank-scoped key so this rank's restart
+        hits without disturbing the peers' shared entry."""
+        if rank is not None:
+            with self._lock:
+                scoped = fp in self._rank_incompatible
+            if scoped:
+                fp = self._rank_fp(fp, rank)
         try:
             from jax.experimental.serialize_executable import serialize
 
@@ -345,14 +431,19 @@ class CompileCacheStore:
 
     def record_miss(self, kind: str,
                     inputs: Sequence[Tuple[str, Tuple[int, ...], str]],
-                    donate: bool) -> None:
+                    donate: bool, sharded: bool = False) -> None:
         """Append one feed-shape record for warmup replay (deduped per
-        process; best-effort — manifest problems never surface)."""
+        process; best-effort — manifest problems never surface).
+        ``sharded`` marks feeds carrying non-trivial placements: warmup
+        replay skips those rows unless it can reconstruct the mesh (the
+        shapes alone under-specify the executable's layout)."""
         row = {
             "kind": kind,
             "inputs": sorted([n, list(s), d] for (n, s, d) in inputs),
             "donate": bool(donate),
         }
+        if sharded:
+            row["sharded"] = True
         key = json.dumps(row, sort_keys=True)
         with self._lock:
             if key in self._manifest_seen:

@@ -308,6 +308,16 @@ def _warm_from_manifest(programs, manifest_rows, report: WarmupReport,
         for row in manifest_rows:
             if not _manifest_row_matches(program, row):
                 continue
+            if row.get("sharded"):
+                # record_miss(sharded=True) marks feeds with non-trivial
+                # placements: shapes alone under-specify the executable's
+                # layout, so replaying would compile (and publish) an
+                # UNSHARDED key the real sharded dispatch never hits —
+                # warm those via warmup(frame.to_device(mesh), ...)
+                report.add(subject, row.get("kind", "block"), None,
+                           "skipped", "sharded manifest row (warm via a "
+                           "sharded frame instead)")
+                continue
             try:
                 feeds = {
                     n: jax.ShapeDtypeStruct(

@@ -281,10 +281,15 @@ def make_ep_train_step(
         return params, opt_state, loss
 
     init_opt = jax.jit(tx.init, in_shardings=(shardings,))
-    jitted = jax.jit(
+    # unified AOT dispatch (ISSUE 10): the ep train step keys by its
+    # mesh/sharding topology and restarts warm from the persistent store
+    from ..ops.executor import aot_jit
+
+    jitted = aot_jit(
         step,
         in_shardings=(shardings, None, data_sharding, data_sharding),
         out_shardings=(shardings, None, NamedSharding(mesh, P())),
+        label="moe.ep_train_step",
     )
     return jitted, data_sharding, shardings, init_opt
 

@@ -355,11 +355,18 @@ def make_sharded_train_step(
     init_opt_state = jax.jit(tx.init, in_shardings=(shardings,))
 
     # opt_state in/out shardings are inferred from the (already sharded)
-    # state arrays produced by init_opt_state.
-    jitted = jax.jit(
+    # state arrays produced by init_opt_state. The step dispatches through
+    # the executor's unified AOT pipeline (aot_jit): its executable is
+    # compiled explicitly, keyed by the mesh/sharding/process topology,
+    # and served from the persistent store on restart — the MULTICHIP
+    # dryrun's second run must not pay XLA again.
+    from ..ops.executor import aot_jit
+
+    jitted = aot_jit(
         step,
         in_shardings=(shardings, None, data_sharding, data_sharding),
         out_shardings=(shardings, None, NamedSharding(mesh, P())),
+        label="transformer.sharded_train_step",
     )
     return jitted, data_sharding, shardings, init_opt_state
 

@@ -50,7 +50,7 @@ _METRIC_LINE = re.compile(
 _LATENCY_LINE = re.compile(r"^#\s*latency\s*\|\s*(\S+)\s+(.*)$")
 _KV = re.compile(r"([A-Za-z0-9_]+)=([-0-9.eE+]+)s?")
 
-_HIGHER_BETTER = ("_per_sec", "per_sec_", "_per_chip")
+_HIGHER_BETTER = ("_per_sec", "per_sec_", "_per_chip", "_speedup")
 _LOWER_BETTER_SUFFIX = ("_s", "_seconds", "_ms", "_us")
 _LOWER_BETTER_SUBSTR = ("wall_s", "_p50", "_p95", "_p99",
                         ".p50", ".p95", ".p99", ".mean", "compile_s")

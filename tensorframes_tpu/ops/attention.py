@@ -192,6 +192,19 @@ def _pallas_flash_usable() -> bool:
 # Ring attention (sequence parallelism)
 # ---------------------------------------------------------------------------
 
+def _axis_size(axis_name: str) -> int:
+    """Static size of a named mapped axis inside a shard_map body —
+    ``jax.lax.axis_size`` where it exists (jax >= 0.6), the axis-env
+    lookup on older releases. Always a Python int (the ring's permute
+    schedule and scan length are build-time constants)."""
+    lax_size = getattr(jax.lax, "axis_size", None)
+    if lax_size is not None:
+        return int(lax_size(axis_name))
+    from jax._src import core as _core
+
+    return int(_core.get_axis_env().axis_size(axis_name))
+
+
 def _ring_attention_local(
     q: jnp.ndarray,  # [b, h, s_loc, d] — local sequence shard
     k: jnp.ndarray,
@@ -201,7 +214,7 @@ def _ring_attention_local(
 ) -> jnp.ndarray:
     """shard_map body: rotate k/v shards around the ring while accumulating
     the online softmax for the local queries."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     scale = float(1.0 / np.sqrt(d))  # weak-typed: no f64 promotion under x64

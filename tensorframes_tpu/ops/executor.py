@@ -16,10 +16,24 @@ powers of two (:func:`bucket_rows`) so externally-built frames with
 arbitrary block sizes — and ragged blocks grouped by cell shape — keep
 the compile count O(log n); ``cache_sizes`` gives the honest recompile
 accounting SURVEY.md §7 hard-part 1 calls for.
+
+Dispatch is ONE pipeline (ISSUE 10): every feed — host blocks,
+multi-device sharded columns, multi-process SPMD frames, callback
+programs — keys by (entry kind, feed shapes/dtypes, input placements)
+and builds a per-key executable by explicit ``lower().compile()``,
+consulting the persistent store (:mod:`tensorframes_tpu.compilecache`)
+first. That is the Julia-to-TPU thesis (arXiv 1810.09868) applied at
+the executor: whole programs compiled ahead-of-time for the actual
+target topology, never per-process lazy jit. The old jax.jit path
+survives only as :meth:`CompiledProgram._fallback_call` — an
+explicitly-counted last resort for programs whose AOT build raises —
+and :func:`aot_jit` offers the same pipeline for arbitrary pytree
+functions (the model train steps the MULTICHIP dryruns compile).
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -52,31 +66,39 @@ register_site(
 # Registered at import so the exposition always carries the executor
 # family (a cold cache reads hits=0, it does not vanish). "Hit" means
 # this CompiledProgram has already dispatched this exact feed-shape key;
-# A miss's cost is split honestly (ISSUE 5 satellite): trace + XLA
-# compile lands in compile-seconds (skipped entirely when the
-# persistent store serves the executable — compare against
-# tftpu_compilecache_load_seconds), the first execution in
-# first-run-seconds. Only the legacy jit fallback (AOT-ineligible
-# feeds) still lumps compile+run into compile-seconds. This is the
-# honest recompile accounting SURVEY §7 hard-part 1 asks for.
+# A miss's cost is split honestly (ISSUE 5 satellite, completed by the
+# ISSUE 10 unification): trace + XLA compile lands in compile-seconds
+# (skipped entirely when the persistent store serves the executable —
+# compare against tftpu_compilecache_load_seconds), the first execution
+# in first-run-seconds — on EVERY dispatch path, sharded and
+# multi-process included. The old "legacy fallback lumps compile+run"
+# caveat is gone with the legacy path: the last-resort jit fallback is
+# separately counted and observes neither histogram. This is the honest
+# recompile accounting SURVEY §7 hard-part 1 asks for.
 _JIT_HITS = _counter(
     "tftpu_executor_jit_cache_hits_total",
-    "Block/row dispatches whose feed-shape key was already compiled",
+    "Dispatches whose feed-shape/placement key was already compiled",
 )
 _JIT_MISSES = _counter(
     "tftpu_executor_jit_cache_misses_total",
-    "Block/row dispatches that required a fresh executable (compiled "
-    "or loaded from the persistent store)",
+    "Dispatches that required a fresh executable (compiled or loaded "
+    "from the persistent store)",
 )
 _COMPILE_SECONDS = _histogram(
     "tftpu_executor_compile_seconds",
     "Trace + XLA-compile wall-clock per feed-shape key (persistent-"
-    "store hits skip it; the legacy jit fallback includes the first run)",
+    "store hits skip it; run time is never included)",
 )
 _FIRST_RUN_SECONDS = _histogram(
     "tftpu_executor_first_run_seconds",
     "Wall-clock of the first execution per feed-shape key, compile "
-    "excluded (AOT dispatch path only)",
+    "excluded",
+)
+_FALLBACK_DISPATCHES = _counter(
+    "tftpu_executor_fallback_dispatch_total",
+    "Dispatches that could not build an AOT executable and fell back "
+    "to lazy jax.jit (last resort; the failure reason is logged once "
+    "per key)",
 )
 _PADDING_WASTE = _counter(
     "tftpu_executor_padding_waste_rows_total",
@@ -154,46 +176,155 @@ def pad_lead_dim(
     return out
 
 
+def _sharding_token(sh) -> Optional[str]:
+    """Canonical JSON of a sharding's descriptor, memoized per
+    (sharding, current default device) — jax shardings are hashable and
+    reused across dispatches, and rebuilding the descriptor walks
+    mesh.devices per feed per call, per-step overhead the replaced raw
+    jax.jit dispatch never paid. The default device is part of the memo
+    key because the descriptor normalizes the default placement to the
+    trivial token: a mid-process ``jax_default_device`` change must not
+    serve stale Nones. None for the trivial placement."""
+    from ..parallel.mesh import default_device
+
+    return _sharding_token_cached(sh, default_device())
+
+
+@functools.lru_cache(maxsize=256)
+def _sharding_token_cached(sh, _default_dev) -> Optional[str]:
+    import json as _json
+
+    from ..parallel.mesh import sharding_descriptor
+
+    desc = sharding_descriptor(sh)
+    return None if desc is None else _json.dumps(desc, sort_keys=True)
+
+
+def _feed_sharding(v):
+    """The feed's sharding when it is a NON-TRIVIAL placement (sharded
+    over a mesh, or committed to a non-default device), else None —
+    host arrays and default-device feeds keep a placement-free identity
+    so warmed shapes match them regardless of how the data arrives."""
+    try:
+        sh = getattr(v, "sharding", None)
+        if sh is None:
+            return None
+        return sh if _sharding_token(sh) is not None else None
+    except Exception:  # pragma: no cover - defensive: never block dispatch
+        return None
+
+
+def _placement_token(v) -> Optional[str]:
+    """Hashable dispatch-key component for a feed's placement (the
+    canonical JSON of its sharding descriptor; None for the trivial
+    placement). An AOT executable is layout-specialized — calling it
+    with differently-sharded arguments raises — so the placement is
+    part of the dispatch identity exactly like shape and dtype."""
+    try:
+        sh = getattr(v, "sharding", None)
+        return None if sh is None else _sharding_token(sh)
+    except Exception:  # pragma: no cover - defensive: never block dispatch
+        return None
+
+
+class _KeyedBuildCache:
+    """Double-checked per-key build memoization shared by the two AOT
+    builders (CompiledProgram executables and _AotJit entries): an
+    outer lock guards the maps, builds serialize on a PER-KEY lock so
+    distinct keys compile concurrently, and a key whose build raised is
+    memoized as failed — callers fall back to lazy jit. ONE copy of the
+    protocol, so a lock-ordering or accounting fix cannot silently skip
+    one builder."""
+
+    def __init__(self):
+        self.built: Dict[Tuple, object] = {}
+        self.failed: set = set()
+        self._lock = threading.Lock()
+        self._key_locks: Dict[Tuple, threading.Lock] = {}
+
+    def peek(self, key):
+        """Lock-free read for the dispatch fast path (dict.get is
+        GIL-atomic); None when unbuilt or failed."""
+        return self.built.get(key)
+
+    def get_or_build(self, key: Tuple, build: Callable,
+                     describe: str) -> Tuple[object, str]:
+        """Return ``(value, how)`` — ``('cached')`` when already built,
+        the builder's own ``(value, how)`` on a fresh build, or
+        ``(None, 'failed')`` when this (or an earlier) build of ``key``
+        raised."""
+        with self._lock:
+            if key in self.built:
+                return self.built[key], "cached"
+            if key in self.failed:
+                return None, "failed"
+            key_lock = self._key_locks.setdefault(key, threading.Lock())
+        with key_lock:
+            with self._lock:  # lost the race: another thread built it
+                if key in self.built:
+                    return self.built[key], "cached"
+                if key in self.failed:
+                    return None, "failed"
+            try:
+                value, how = build()
+            except Exception as e:
+                logger.debug("AOT path unavailable for %s (%s); using "
+                             "jit dispatch", describe, e)
+                with self._lock:
+                    self.failed.add(key)
+                return None, "failed"
+            with self._lock:
+                self.built[key] = value
+            return value, how
+
+
+def _store_meta(kind: str, form: str, donate: bool, inputs,
+                shardings: Dict, multiprocess: bool,
+                rank: Optional[int], label: Optional[str] = None) -> Dict:
+    """The ONE store-entry meta schema, shared by both AOT builders
+    (CompiledProgram and _AotJit) so an accounting or schema change
+    cannot silently diverge between the two dispatch entries."""
+    meta = {
+        "kind": kind,
+        "form": form,
+        "donate": donate,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "jax": jax.__version__,
+        "inputs": inputs,
+    }
+    if label is not None:
+        meta["label"] = label
+    if shardings:
+        from ..parallel.mesh import sharding_descriptor
+
+        meta["shardings"] = {
+            k: sharding_descriptor(sh)
+            for k, sh in sorted(shardings.items())
+        }
+    if multiprocess:
+        meta["n_processes"] = jax.process_count()
+        meta["published_by_rank"] = rank
+    return meta
+
+
 def _hoisted_for(fn, feeds: Dict[str, jnp.ndarray]):
     """Build a :class:`HoistedProgram` (program.py — weights as runtime
-    arguments, device-committed once) at these feeds' shapes."""
+    arguments, device-committed once) at these feeds' shapes — and
+    placements: sharded feeds trace (and later lower) with their
+    shardings attached, so the hoisted executable is specialized to the
+    same layout the dispatch will call it with."""
     from ..program import HoistedProgram
 
-    abstract = {
-        k: jax.ShapeDtypeStruct(np.shape(v), v.dtype) for k, v in feeds.items()
-    }
+    abstract = {}
+    for k, v in feeds.items():
+        sh = _feed_sharding(v)
+        abstract[k] = (
+            jax.ShapeDtypeStruct(np.shape(v), v.dtype, sharding=sh)
+            if sh is not None
+            else jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+        )
     return HoistedProgram(fn, abstract)
-
-
-def _aot_globally_eligible() -> bool:
-    """Multi-process runs keep the jax.jit path everywhere: the AOT
-    lowering here does not encode cross-process shardings. warm()
-    checks this too, so it never builds (and never marks dispatched)
-    executables the real dispatch would bypass."""
-    try:
-        return jax.process_count() <= 1
-    except Exception:  # pragma: no cover - defensive: never block dispatch
-        return False
-
-
-def _aot_eligible(feeds: Dict[str, object]) -> bool:
-    """True when these raw (pre-``jnp.asarray``) feeds can dispatch
-    through a per-shape AOT executable: host arrays or single-device
-    arrays on the default device. Multi-device/sharded inputs and
-    multi-process runs keep the jax.jit path, which re-specializes on
-    argument shardings the AOT lowering here does not encode."""
-    if not _aot_globally_eligible():
-        return False
-    try:
-        default = jax.devices()[0]
-        for v in feeds.values():
-            if isinstance(v, jax.Array):
-                devs = v.sharding.device_set
-                if len(devs) != 1 or next(iter(devs)) != default:
-                    return False
-    except Exception:  # pragma: no cover - defensive: never block dispatch
-        return False
-    return True
 
 
 class CompiledProgram:
@@ -225,21 +356,16 @@ class CompiledProgram:
         # built by explicit lower().compile() — or deserialized from
         # the persistent store (compilecache) — so compile time and
         # run time are separately measurable, and a warm store can
-        # skip XLA entirely. Keys include the donate variant; a key in
-        # _aot_failed permanently uses the legacy jit path instead.
-        self._aot: Dict[Tuple, Callable] = {}
-        self._aot_failed: set = set()
-        # _aot_lock guards the maps only; builds serialize on a PER-KEY
-        # lock so two shapes of one program can compile concurrently
-        # (the jax.jit path never imposed program-wide serialization)
-        self._aot_lock = threading.Lock()
-        self._aot_key_locks: Dict[Tuple, threading.Lock] = {}
+        # skip XLA entirely. Keys include the donate variant; a failed
+        # key permanently uses the legacy jit path instead.
+        self._aot = _KeyedBuildCache()
 
     @staticmethod
     def _feeds_key(kind: str, feeds) -> Tuple:
         return (kind,) + tuple(
             sorted(
-                (k, tuple(int(d) for d in np.shape(v)), str(v.dtype))
+                (k, tuple(int(d) for d in np.shape(v)), str(v.dtype),
+                 _placement_token(v))
                 for k, v in feeds.items()
             )
         )
@@ -278,14 +404,19 @@ class CompiledProgram:
 
     def _fingerprint(self, kind: str, abstract: Dict, donate: bool,
                      entry) -> Optional[str]:
-        """Persistent-store key for this (program, feed-shape, variant).
-        None when the program cannot be fingerprinted (no store use)."""
+        """Persistent-store key for this (program, feed-shape, variant,
+        placement). None when the program cannot be fingerprinted (no
+        store use)."""
         from ..compilecache.fingerprint import fingerprint_from_closed
 
         avals = sorted(
             (k, tuple(int(d) for d in v.shape), str(v.dtype))
             for k, v in abstract.items()
         )
+        shardings = {
+            k: sh for k, v in abstract.items()
+            if (sh := _feed_sharding(v)) is not None
+        }
         outs = list(
             self.program.fetch_order
             or [o.name for o in self.program.outputs]
@@ -299,10 +430,13 @@ class CompiledProgram:
                 hoisted = False
             return fingerprint_from_closed(
                 closed, avals, outs, kind=kind, donate=donate,
-                hoisted=hoisted,
+                hoisted=hoisted, shardings=shardings,
             )
         except Exception as e:
+            from ..compilecache.store import note_unfingerprintable
+
             logger.debug("program not fingerprintable: %s", e)
+            note_unfingerprintable()
             return None
 
     def _build_aot(self, kind: str, akey: Tuple, feeds: Dict,
@@ -313,58 +447,52 @@ class CompiledProgram:
         store. Returns (callable, 'disk'|'compiled'), or None when this
         key must use the legacy jit path. ``feeds`` may be concrete
         arrays or ShapeDtypeStructs (warmup compiles without data)."""
-        with self._aot_lock:
-            call = self._aot.get(akey)
-            if call is not None:
-                return call, "cached"
-            if akey in self._aot_failed:
-                return None
-            key_lock = self._aot_key_locks.setdefault(
-                akey, threading.Lock()
-            )
-        with key_lock:
-            with self._aot_lock:  # lost the race: another thread built it
-                call = self._aot.get(akey)
-                if call is not None:
-                    return call, "cached"
-                if akey in self._aot_failed:
-                    return None
-            try:
-                call, how = self._build_aot_impl(kind, akey, feeds, donate)
-            except Exception as e:
-                logger.debug("AOT path unavailable for %s (%s); using "
-                             "jit dispatch", akey[0], e)
-                with self._aot_lock:
-                    self._aot_failed.add(akey)
-                return None
-            with self._aot_lock:
-                self._aot[akey] = call
-            return call, how
+        call, how = self._aot.get_or_build(
+            akey,
+            lambda: self._build_aot_impl(kind, akey, feeds, donate),
+            describe=str(akey[0]),
+        )
+        return None if call is None else (call, how)
 
     def _build_aot_impl(self, kind, akey, feeds, donate):
         from ..compilecache import store as cc_store
 
         base = akey[:-1] if akey and akey[-1] == "donate" else akey
-        abstract = {
-            k: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
-            for k, v in feeds.items()
-        }
+        abstract = {}
+        shardings = {}
+        for k, v in feeds.items():
+            sh = _feed_sharding(v)
+            if sh is not None:
+                shardings[k] = sh
+                abstract[k] = jax.ShapeDtypeStruct(
+                    np.shape(v), v.dtype, sharding=sh
+                )
+            else:
+                abstract[k] = jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+        multiprocess = jax.process_count() > 1
         t0 = time.perf_counter()
+        # multi-process fleets keep the plain (closure-capture) form:
+        # hoisted consts are committed to THIS rank's local device, so
+        # a hoisted executable bakes a per-rank device assignment into
+        # its input layout and could never be shared across the fleet's
+        # store — baked consts compile identically on every rank
         entry = (
             self._entry(base, self._kind_fn(kind), feeds)
-            if self.hoist else None
+            if self.hoist and not multiprocess else None
         )
         trace_s = time.perf_counter() - t0
 
         store = None
         fp = None
+        rank = jax.process_index() if multiprocess else None
         from ..plan.ir import program_has_callback
 
         if not program_has_callback(self.program):
             # callback programs bind process-local host functions — an
             # executable serialized from one process cannot call back
             # into another's registry, so they never touch the store
-            # (in-process AOT still applies)
+            # (in-process AOT still applies, through this same pipeline,
+            # so the hit/compile/first-run accounting stays uniform)
             store = cc_store.active_store()
         if store is not None:
             fp = self._fingerprint(kind, abstract, donate, entry)
@@ -372,13 +500,14 @@ class CompiledProgram:
             (k, list(v.shape), str(v.dtype)) for k, v in abstract.items()
         )
         if fp is not None:
-            loaded = store.get(fp)
+            loaded = store.get(fp, rank=rank)
             if loaded is not None:
                 return self._wrap_executable(entry, loaded), "disk"
             store.record_miss(
                 kind,
                 [(n, tuple(s), d) for (n, s, d) in meta_inputs],
                 donate,
+                sharded=bool(shardings),
             )
 
         t1 = time.perf_counter()
@@ -398,17 +527,11 @@ class CompiledProgram:
             compiled = jitted.lower(abstract).compile()
         _COMPILE_SECONDS.observe(trace_s + (time.perf_counter() - t1))
         if fp is not None:
-            store.put(fp, compiled, meta={
-                "kind": kind,
-                "form": "hoisted" if entry else "plain",
-                "donate": donate,
-                "backend": jax.default_backend(),
-                "device_kind": getattr(
-                    jax.devices()[0], "device_kind", "unknown"
-                ),
-                "jax": jax.__version__,
-                "inputs": meta_inputs,
-            })
+            meta = _store_meta(
+                kind, "hoisted" if entry else "plain", donate,
+                meta_inputs, shardings, multiprocess, rank,
+            )
+            store.put(fp, compiled, meta=meta, rank=rank)
         return self._wrap_executable(entry, compiled), "compiled"
 
     @staticmethod
@@ -434,15 +557,14 @@ class CompiledProgram:
              donate: bool = False) -> str:
         """Precompile (or disk-load) the executable for one feed-shape
         key WITHOUT executing it — ``abstract`` maps input names to
-        ShapeDtypeStructs. The key is marked dispatched, so the first
+        ShapeDtypeStructs (attach a ``sharding`` to warm a sharded
+        placement's key). The key is marked dispatched, so the first
         real dispatch at this shape counts as a jit-cache hit (no
-        compile happens there). Returns 'cached' | 'disk' | 'compiled'
-        | 'failed' | 'ineligible'."""
-        if not _aot_globally_eligible():
-            # the real dispatch would take the legacy jit path here —
-            # building (and marking dispatched) would waste a compile
-            # AND make the later legacy compile masquerade as a hit
-            return "ineligible"
+        compile happens there). Multi-process fleets warm like anything
+        else — every dispatch rides the unified AOT path, so the old
+        refusal (warming keys the legacy jit path would bypass) has
+        nothing left to refuse. Returns 'cached' | 'disk' | 'compiled'
+        | 'failed'."""
         donate = donate and donation_supported()
         key = self._feeds_key(kind, abstract)
         akey = key + ("donate",) if donate else key
@@ -477,7 +599,6 @@ class CompiledProgram:
                 f"executor.run_{'block' if kind == 'block' else 'rows'}"
             )
             donate = donate and donation_supported()
-            aot_ok = _aot_eligible(feeds)
             feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
             key = self._feeds_key(kind, feeds)
             # NOTE: the hoisted entry is keyed WITHOUT donate (one
@@ -486,30 +607,35 @@ class CompiledProgram:
             # (donate variants are separate executables)
             akey = key + ("donate",) if donate else key
             fresh = self._note_dispatch(key, donate)
-            call = None
-            if aot_ok:
-                call = self._aot.get(akey)
-                if call is None:
-                    built = self._build_aot(kind, akey, feeds, donate)
-                    if built is not None:
-                        call = built[0]
+            call = self._aot.peek(akey)
+            if call is None:
+                built = self._build_aot(kind, akey, feeds, donate)
+                if built is not None:
+                    call = built[0]
             deadline = _fleet.dispatch_deadline_s()
             if deadline and call is None and fresh:
-                # legacy jit path, first dispatch at this shape: the XLA
-                # compile happens lazily INSIDE the call (the AOT path
-                # compiles outside the watchdog, above). A 20-40s TPU
-                # compile is not a hung collective — and under
-                # supervise() a deterministic compile > deadline would
-                # burn the whole restart budget without any rank ever
-                # being hung. First-compile dispatches are therefore
-                # exempt; warmed/steady-state dispatches stay bounded.
+                # last-resort jit fallback, first dispatch at this
+                # shape: the XLA compile happens lazily INSIDE the call
+                # (the unified AOT path compiles outside the watchdog,
+                # above — so a store-hit or freshly-AOT-compiled first
+                # dispatch stays bounded). A 20-40s TPU compile is not
+                # a hung collective — and under supervise() a
+                # deterministic compile > deadline would burn the whole
+                # restart budget without any rank ever being hung.
+                # Genuine cache-miss lazy compiles are therefore the
+                # ONLY exempt dispatches (counted, so an exemption in
+                # steady state is visible); everything else stays
+                # bounded.
+                _fleet.note_deadline_exemption(
+                    f"executor.run_{'block' if kind == 'block' else 'rows'}"
+                )
                 deadline = 0.0
 
             def _invoke():
                 delay_point("executor.dispatch")
                 r = (
                     call(feeds) if call is not None
-                    else self._legacy_call(kind, key, feeds, donate)
+                    else self._fallback_call(kind, key, feeds, donate)
                 )
                 if deadline:
                     # deadline mode synchronizes: a collective wedged on
@@ -545,8 +671,11 @@ class CompiledProgram:
         if fresh:
             if call is not None:
                 _FIRST_RUN_SECONDS.observe(dt)
-            else:
-                _COMPILE_SECONDS.observe(dt)  # legacy lump: compile+run
+            # the jit fallback's lazy compile+run is deliberately NOT
+            # observed into compile-seconds: that histogram times pure
+            # trace+XLA-compile on every path now, and the fallback has
+            # its own counter (lumping would resurrect the pre-unification
+            # accounting caveat)
         if _events.TRACER.enabled:
             _events.TRACER.emit_complete(
                 f"executor.run_{'block' if kind == 'block' else 'rows'}",
@@ -556,9 +685,14 @@ class CompiledProgram:
             return out  # stay in HBM: sharded frames chain without transfers
         return {k: np.asarray(v) for k, v in out.items()}
 
-    def _legacy_call(self, kind: str, key: Tuple, feeds, donate: bool):
-        """The pre-AOT jit dispatch path: multi-device/sharded feeds,
-        and programs whose AOT build failed."""
+    def _fallback_call(self, kind: str, key: Tuple, feeds, donate: bool):
+        """Last-resort lazy jax.jit dispatch, reachable ONLY when the
+        unified AOT build raised (``_aot.failed``) — every normal feed
+        class (host, sharded, multi-process, callback) rides the AOT
+        pipeline. Explicitly counted so a fleet quietly living on this
+        path is visible in the exposition; the build failure itself is
+        logged by :meth:`_build_aot`."""
+        _FALLBACK_DISPATCHES.inc()
         entry = (
             self._entry(key, self._kind_fn(kind), feeds)
             if self.hoist else None
@@ -644,7 +778,8 @@ class CompiledProgram:
                 return -1
 
         aot_bases = {
-            (k[:-1] if k and k[-1] == "donate" else k) for k in self._aot
+            (k[:-1] if k and k[-1] == "donate" else k)
+            for k in self._aot.built
         }
 
         def count(kind: str) -> int:
@@ -659,6 +794,250 @@ class CompiledProgram:
             "block": size(self.jit_block) + count("block"),
             "vmap": size(self.jit_vmap) + count("vmap"),
         }
+
+
+# ---------------------------------------------------------------------------
+# aot_jit — the unified pipeline for arbitrary pytree functions
+# ---------------------------------------------------------------------------
+
+def _shardings_tree_token(tree) -> object:
+    """JSON-able identity of a declared in/out_shardings pytree (None
+    passes through; sharding leaves become their descriptors). Folded
+    into the fingerprint's ``extra`` slot: two aot_jit entries tracing
+    to the same jaxpr but declaring different output layouts compile
+    different collective schedules and must key apart."""
+    if tree is None:
+        return None
+    Sharding = jax.sharding.Sharding
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Sharding)
+    )
+    from ..parallel.mesh import sharding_descriptor
+
+    return {
+        "tree": str(treedef),
+        "leaves": [
+            sharding_descriptor(leaf) if isinstance(leaf, Sharding)
+            else (None if leaf is None else str(leaf))
+            for leaf in leaves
+        ],
+    }
+
+
+class _AotJit:
+    """``jax.jit``-shaped callable whose dispatch rides the executor's
+    unified AOT pipeline: per-argument-shape/placement keys, explicit
+    ``lower().compile()`` timed into ``tftpu_executor_compile_seconds``,
+    the persistent store consulted first (topology-fingerprinted, so a
+    fleet restart loads instead of recompiling), and the lazy-jit
+    fallback explicitly counted. See :func:`aot_jit`."""
+
+    def __init__(self, fn, in_shardings=None, out_shardings=None,
+                 label: Optional[str] = None):
+        kw = {}
+        if in_shardings is not None:
+            kw["in_shardings"] = in_shardings
+        if out_shardings is not None:
+            kw["out_shardings"] = out_shardings
+        self._fn = fn
+        self._jitted = jax.jit(fn, **kw)
+        self._label = label or getattr(fn, "__qualname__", None) \
+            or type(fn).__name__
+        self._decl = {
+            "in_shardings": _shardings_tree_token(in_shardings),
+            "out_shardings": _shardings_tree_token(out_shardings),
+        }
+        self._builds = _KeyedBuildCache()
+        self._dispatched: set = set()
+
+    def _key(self, leaves, treedef) -> Optional[Tuple]:
+        if any(
+            not hasattr(v, "dtype") or not hasattr(v, "shape")
+            for v in leaves
+        ):
+            # a Python-scalar leaf traces weakly-typed under jit; an AOT
+            # executable is strongly typed — this entry stays lazy-jit
+            return None
+        # weak_type is part of the identity: a weak leaf promotes
+        # differently (int8 + weak int stays int8), so a weak and a
+        # strong feed of the same dtype must not share an executable.
+        # The treedef enters as the OBJECT (hashable, eq-comparable) —
+        # stringifying a transformer's param tree repr per step is
+        # dispatch overhead the jax.jit C++ fast path never paid.
+        return (treedef,) + tuple(
+            (tuple(int(d) for d in v.shape), str(v.dtype),
+             bool(getattr(v, "weak_type", False)), _placement_token(v))
+            for v in leaves
+        )
+
+    def _build(self, key: Tuple, args) -> Optional[Callable]:
+        call, _ = self._builds.get_or_build(
+            key,
+            lambda: (self._build_impl(args), "built"),
+            describe=f"aot_jit({self._label})",
+        )
+        return call
+
+    def _build_impl(self, args) -> Callable:
+        from ..compilecache import store as cc_store
+        from ..compilecache.fingerprint import fingerprint_from_closed
+
+        def abstract_of(v):
+            # weak_type must survive into the trace: dropping it would
+            # promote int8 + weak-int to the weak leaf's dtype, a result
+            # the jax.jit this wraps never produces
+            weak = bool(getattr(v, "weak_type", False))
+            sh = _feed_sharding(v)
+            if sh is not None:
+                return jax.ShapeDtypeStruct(np.shape(v), v.dtype,
+                                            sharding=sh, weak_type=weak)
+            return jax.ShapeDtypeStruct(np.shape(v), v.dtype,
+                                        weak_type=weak)
+
+        abstract = jax.tree_util.tree_map(abstract_of, args)
+        multiprocess = jax.process_count() > 1
+        rank = jax.process_index() if multiprocess else None
+
+        t0 = time.perf_counter()
+        closed = jax.make_jaxpr(self._fn)(*abstract)
+        trace_s = time.perf_counter() - t0
+
+        from ..analysis.rules import _iter_eqns
+
+        has_callback = any(
+            "callback" in eqn.primitive.name
+            for eqn in _iter_eqns(closed.jaxpr)
+        )
+        leaves = jax.tree_util.tree_leaves(abstract)
+        avals = [
+            (f"a{i}", tuple(int(d) for d in v.shape), str(v.dtype))
+            for i, v in enumerate(leaves)
+        ]
+        shardings = {
+            f"a{i}": sh for i, v in enumerate(leaves)
+            if (sh := getattr(v, "sharding", None)) is not None
+        }
+        store = None if has_callback else cc_store.active_store()
+        fp = None
+        if store is not None:
+            # weak_type must reach the PERSISTENT key too: the jaxpr
+            # text renders weak and strong avals identically, so without
+            # this a strong-compiled store entry would be served to a
+            # weak-typed feed of the same shape/dtype (the in-process
+            # key already splits them)
+            extra = dict(self._decl)
+            weak = [
+                bool(getattr(v, "weak_type", False)) for v in leaves
+            ]
+            if any(weak):
+                extra["weak"] = weak
+            try:
+                fp = fingerprint_from_closed(
+                    closed, avals, [self._label], kind="fn",
+                    shardings=shardings, extra=extra,
+                )
+            except Exception as e:
+                logger.debug("aot_jit(%s) not fingerprintable: %s",
+                             self._label, e)
+                cc_store.note_unfingerprintable()
+        if fp is not None:
+            loaded = store.get(fp, rank=rank)
+            if loaded is not None:
+                return lambda *a: loaded(*a)
+            store.record_miss(
+                "fn", [(n, tuple(s), d) for (n, s, d) in avals],
+                False, sharded=bool(shardings),
+            )
+        t1 = time.perf_counter()
+        compiled = self._jitted.lower(*abstract).compile()
+        _COMPILE_SECONDS.observe(trace_s + (time.perf_counter() - t1))
+        if fp is not None:
+            meta = _store_meta(
+                "fn", "plain", False,
+                sorted((n, list(s), d) for (n, s, d) in avals),
+                shardings, multiprocess, rank, label=self._label,
+            )
+            store.put(fp, compiled, meta=meta, rank=rank)
+        return lambda *a: compiled(*a)
+
+    def __call__(self, *args):
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        key = self._key(leaves, treedef)
+        call = None
+        if key is not None:
+            fresh = key not in self._dispatched
+            if fresh:
+                self._dispatched.add(key)
+                _JIT_MISSES.inc()
+            else:
+                _JIT_HITS.inc()
+            call = self._build(key, args)
+        else:
+            # keyless (lazy-jit-only) entries still scope the deadline
+            # exemption to the FIRST dispatch of each signature jax's
+            # own trace cache would compile for — weak-typed Python
+            # scalars key by type, not value. Without this, `fresh`
+            # would hold on every call and permanently blind the fleet
+            # watchdog to steady-state hangs of this entry.
+            lazy_key = ("lazy", treedef) + tuple(
+                (tuple(int(d) for d in v.shape), str(v.dtype),
+                 _placement_token(v))
+                if hasattr(v, "shape") and hasattr(v, "dtype")
+                else (type(v).__name__,)
+                for v in leaves
+            )
+            fresh = lazy_key not in self._dispatched
+            if fresh:
+                self._dispatched.add(lazy_key)
+        deadline = _fleet.dispatch_deadline_s()
+        if deadline and call is None and fresh:
+            # same scoping as CompiledProgram._run: only a genuine
+            # cache-miss lazy compile (the counted fallback) is exempt
+            # from the dispatch deadline — AOT/store-served first
+            # dispatches compiled above, outside the watchdog scope
+            _fleet.note_deadline_exemption(f"aot_jit[{self._label}]")
+            deadline = 0.0
+        if call is None:
+            _FALLBACK_DISPATCHES.inc()
+
+        def _invoke():
+            r = call(*args) if call is not None else self._jitted(*args)
+            if deadline:
+                r = jax.block_until_ready(r)
+            return r
+
+        t0 = time.perf_counter()
+        if deadline:
+            out = _fleet.run_with_deadline(
+                _invoke, describe=f"aot_jit[{self._label}]",
+                deadline=deadline,
+            )
+        else:
+            out = _invoke()
+        if fresh and call is not None:
+            _FIRST_RUN_SECONDS.observe(time.perf_counter() - t0)
+        return out
+
+
+def aot_jit(fn, *, in_shardings=None, out_shardings=None,
+            label: Optional[str] = None) -> Callable:
+    """Drop-in replacement for ``jax.jit(fn, in_shardings=...,
+    out_shardings=...)`` that dispatches through the executor's unified
+    AOT pipeline (ISSUE 10): explicit ``lower().compile()`` per
+    argument-shape/placement key with the compile timed into
+    ``tftpu_executor_compile_seconds``, the persistent store
+    (``TFTPU_COMPILE_CACHE``) consulted before XLA — keyed by the
+    topology-fingerprinted content hash, so sharded and multi-process
+    programs restart warm — and lazy jit surviving only as the counted
+    last-resort fallback. The model train-step factories (transformer
+    dp/tp/sp, MoE ep, pipeline pp) build their steps through this, which
+    is what lets the MULTICHIP dryruns hit the store on a second run.
+
+    Positional array arguments only (pytrees fine); a call with a
+    Python-scalar leaf stays on the lazy-jit path for that key (an AOT
+    executable is strongly typed; jit traces scalars weakly)."""
+    return _AotJit(fn, in_shardings=in_shardings,
+                   out_shardings=out_shardings, label=label)
 
 
 def gather_feeds(

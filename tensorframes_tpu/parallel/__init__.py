@@ -4,8 +4,17 @@ from .distributed import (
     init_distributed,
     is_multiprocess,
     process_index,
+    process_topology,
 )
-from .mesh import BATCH_AXIS, batch_sharding, device_count, make_mesh, replicated
+from .mesh import (
+    BATCH_AXIS,
+    batch_sharding,
+    device_count,
+    make_mesh,
+    mesh_descriptor,
+    replicated,
+    sharding_descriptor,
+)
 from .pipeline import make_pp_train_step, pipeline_apply
 
 __all__ = [
@@ -19,6 +28,9 @@ __all__ = [
     "is_multiprocess",
     "frame_from_process_local",
     "make_mesh",
+    "mesh_descriptor",
     "process_index",
+    "process_topology",
     "replicated",
+    "sharding_descriptor",
 ]

@@ -150,6 +150,49 @@ def process_index() -> int:
     return jax.process_index()
 
 
+# lint: guarded (single-tuple read/replace is atomic under the GIL;
+# worst case two threads compute the same value once)
+_TOPOLOGY_MEMO = None
+
+
+def process_topology() -> dict:
+    """Process-index-INDEPENDENT identity of the fleet's device
+    topology, for compile-cache keys (``compilecache/fingerprint.py``):
+    every rank of an SPMD fleet computes the same value, so executables
+    published by one rank are looked up by all — while a resized fleet
+    (2 processes → 4) or a reshaped slice keys differently and misses
+    cleanly instead of loading an executable compiled for the wrong
+    collective schedule.
+
+    Covers: process count, and per GLOBAL device its id, platform,
+    device kind, and owning process index (the device→process map is
+    what XLA's cross-host collectives are scheduled against; it is the
+    same list on every rank — ``jax.devices()`` enumerates globally).
+
+    Memoized: the device set is fixed for a backend's lifetime, and this
+    runs on every fingerprint (every new feed-shape key, twice per
+    TFG108 probe) — an O(n_devices) walk per call on a large fleet. The
+    only in-process transition is pre- vs post-``init_distributed``,
+    which changes the (process, device) counts the memo is keyed on.
+    Callers must treat the returned dict as immutable."""
+    global _TOPOLOGY_MEMO
+    key = (int(jax.process_count()), int(jax.device_count()))
+    memo = _TOPOLOGY_MEMO
+    if memo is not None and memo[0] == key:
+        return memo[1]
+    devices = []
+    for d in jax.devices():
+        devices.append([
+            int(d.id),
+            str(getattr(d, "platform", "?")),
+            str(getattr(d, "device_kind", "?")),
+            int(getattr(d, "process_index", 0)),
+        ])
+    out = {"n_processes": key[0], "devices": devices}
+    _TOPOLOGY_MEMO = (key, out)
+    return out
+
+
 def frame_from_process_local(data, mesh=None, axis: Optional[str] = None):
     """Build a GLOBAL sharded frame from each process's local rows.
 

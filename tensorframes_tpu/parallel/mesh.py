@@ -28,6 +28,94 @@ from ..config import get_config
 BATCH_AXIS = "dp"
 
 
+def _scrubbed(text: str) -> str:
+    import re
+
+    return re.sub(r"0x[0-9a-fA-F]+", "0x", text)
+
+
+def mesh_descriptor(mesh: Mesh) -> Dict[str, object]:
+    """JSON-able identity of a mesh for compile-cache keys: axis names +
+    sizes and the global device assignment (ids are GLOBAL and agree on
+    every process of a fleet, so the descriptor is process-index-
+    independent by construction)."""
+    return {
+        "axes": [[str(n), int(s)] for n, s in
+                 zip(mesh.axis_names, mesh.devices.shape)],
+        "devices": [int(d.id) for d in mesh.devices.flat],
+    }
+
+
+def spec_descriptor(spec) -> list:
+    """PartitionSpec → JSON-able form: one entry per dim, each None, an
+    axis name, or a list of axis names."""
+    out = []
+    for part in tuple(spec):
+        if part is None:
+            out.append(None)
+        elif isinstance(part, (tuple, list)):
+            out.append([str(p) for p in part])
+        else:
+            out.append(str(part))
+    return out
+
+
+def sharding_descriptor(sharding) -> Optional[Dict[str, object]]:
+    """Stable JSON-able identity of an input sharding for dispatch keys
+    and persistent-cache fingerprints — None for the trivial placement
+    (single default device, or no sharding at all), so host-fed and
+    plain single-device dispatches keep their unsharded identity.
+
+    An AOT executable is specialized to its input shardings (calling it
+    with differently-laid-out arguments raises), so everything that
+    changes the layout must be in the key: mesh axis names + shape +
+    device assignment and the per-dim partition spec for
+    ``NamedSharding``; the concrete device for an off-default
+    ``SingleDeviceSharding``; a scrubbed repr for exotic sharding types.
+    """
+    if sharding is None:
+        return None
+    SDS = getattr(jax.sharding, "SingleDeviceSharding", ())
+    if isinstance(sharding, SDS):
+        try:
+            (dev,) = sharding.device_set
+        except (ValueError, TypeError):  # pragma: no cover - defensive
+            return {"type": "single", "repr": _scrubbed(repr(sharding))}
+        # the default placement — where a fresh host transfer lands on
+        # THIS process — keys identically to host feeds. That device is
+        # the process-LOCAL default (jax.devices()[0] only equals it on
+        # rank 0): comparing against the global device 0 would give every
+        # other rank a device-bearing token for plain host feeds, so no
+        # rank would ever share a store entry or match a warmed key.
+        if dev == default_device():
+            return None
+        return {"type": "single", "device": int(dev.id)}
+    if isinstance(sharding, NamedSharding):
+        desc = {
+            "type": "named",
+            "mesh": mesh_descriptor(sharding.mesh),
+            "spec": spec_descriptor(sharding.spec),
+        }
+        mk = getattr(sharding, "memory_kind", None)
+        if mk is not None:
+            desc["memory_kind"] = str(mk)
+        return desc
+    return {
+        "type": type(sharding).__name__,
+        "repr": _scrubbed(repr(sharding)),
+        "devices": sorted(int(d.id) for d in sharding.device_set),
+    }
+
+
+def default_device():
+    """Where an uncommitted host transfer lands on THIS process: the
+    configured ``jax_default_device``, else the first process-local
+    device. Descriptor/token caches key on it so a mid-process
+    ``jax.config.update('jax_default_device', ...)`` is honored."""
+    dd = getattr(jax.config, "jax_default_device", None)
+    return dd if dd is not None else jax.local_devices()[0]
+
+
 def device_count() -> int:
     return len(jax.devices())
 
@@ -64,11 +152,25 @@ def make_mesh(
         )
     # Auto axis types: XLA's SPMD partitioner solves intermediate shardings
     # (explicit sharding-in-types would demand out_sharding annotations on
-    # ambiguous ops like embedding gathers).
-    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
-    return jax.make_mesh(
-        tuple(sizes), tuple(names), axis_types, devices=devices
-    )
+    # ambiguous ops like embedding gathers). Version-tolerant: AxisType
+    # (and make_mesh's axis_types parameter) only exist on newer jax —
+    # older releases are Auto-only, so falling back to the 2-argument
+    # form (or the raw Mesh constructor) is semantically identical.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is not None:
+        try:
+            return jax.make_mesh(
+                tuple(sizes), tuple(names),
+                (axis_type.Auto,) * len(names), devices=devices,
+            )
+        except TypeError:  # make_mesh predates the axis_types parameter
+            pass
+    try:
+        return jax.make_mesh(tuple(sizes), tuple(names), devices=devices)
+    except (AttributeError, TypeError):  # very old jax: no make_mesh
+        import numpy as np
+
+        return Mesh(np.asarray(devices).reshape(tuple(sizes)), tuple(names))
 
 
 def batch_sharding(mesh: Mesh, rank: int, axis: Optional[str] = None) -> NamedSharding:

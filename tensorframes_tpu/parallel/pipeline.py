@@ -168,10 +168,15 @@ def make_pp_train_step(
     def jit_for(stage_params):
         sh = param_shardings(stage_params)
         init_opt = jax.jit(tx.init, in_shardings=(sh,))
-        jitted = jax.jit(
+        # unified AOT dispatch (ISSUE 10): the pp train step keys by its
+        # mesh/sharding topology and restarts warm from the store
+        from ..ops.executor import aot_jit
+
+        jitted = aot_jit(
             step,
             in_shardings=(sh, None, data_sharding, data_sharding),
             out_shardings=(sh, None, NamedSharding(mesh, P())),
+            label="pipeline.pp_train_step",
         )
         return jitted, init_opt, sh
 

@@ -1006,15 +1006,24 @@ def lower_reduce(
     ``(per_block_partials, input_rows)`` for the verbs' unchanged
     combine step (the row count rides along so the caller's profiling
     span never forces the still-lazy frame), or None when the chain is
-    ineligible (no plan, barriers, sharded/multi-process feeds) — the
-    caller then takes the eager path, which forces the frame through
-    the ordinary plan lowering."""
+    ineligible (no plan, barriers, multi-process feeds — sharded
+    single-process chains ARE eligible since ISSUE 10) — the caller
+    then takes the eager path, which forces the frame through the
+    ordinary plan lowering."""
     import jax
 
     if getattr(frame, "_plan", None) is None or not ir.fusion_enabled():
         return None
-    if frame.is_sharded or frame.is_materialized:
+    if frame.is_materialized:
         return None
+    # Sharded chains fuse too (ISSUE 10): the fused per-block Program
+    # dispatches through the unified AOT path, so a sharded feed is an
+    # ordinary dispatch — XLA SPMD computes the reduce across the mesh
+    # and the partial that reaches the host combine is block-sized.
+    # Multi-process fleets still take the eager path: the combine step
+    # below host-gathers per-block partials, and a rank cannot asarray
+    # a non-addressable global partial (data-plane limit, not dispatch
+    # eligibility — ROADMAP #4's out-of-core combine owns it).
     if jax.process_count() > 1:
         return None
     # record the epilogue on the IR (branch bookkeeping included: a

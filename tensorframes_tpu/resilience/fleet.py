@@ -129,6 +129,14 @@ _HUNG_DISPATCHES = _counter(
     "Dispatches/barriers that exceeded the dispatch deadline and were "
     "aborted by the watchdog",
 )
+_DEADLINE_EXEMPTIONS = _counter(
+    "tftpu_fleet_deadline_exemptions_total",
+    "First dispatches that ran unbounded because their XLA compile "
+    "happens lazily inside the call (the executor's counted lazy-jit "
+    "fallback — the ONLY exempt class since the unified AOT dispatch; "
+    "a nonzero rate in steady state means programs are living on the "
+    "fallback path)",
+)
 RESTARTS = _counter(
     "tftpu_fleet_restarts_total",
     "Full-fleet restarts performed by supervise() after a rank failure",
@@ -781,6 +789,21 @@ def dispatch_deadline_s() -> float:
         return float(get_config().dispatch_deadline_s or 0.0)
     except (TypeError, ValueError):
         return 0.0
+
+
+def note_deadline_exemption(describe: str) -> None:
+    """Record that one dispatch ran UNBOUNDED by the watchdog because
+    its XLA compile happens lazily inside the call (a deterministic
+    20-40s TPU compile is not a hung collective, and under supervise()
+    it would burn the restart budget with no rank hung). Since the
+    unified AOT dispatch (ISSUE 10) the only such dispatches are the
+    executor's counted lazy-jit fallback on a genuine cache miss —
+    store hits and fresh AOT builds compile OUTSIDE the watchdog scope
+    and stay bounded — so the exemption is counted and flight-recorded:
+    a fleet quietly exempting dispatches in steady state is a fleet
+    living on the fallback path."""
+    _DEADLINE_EXEMPTIONS.inc()
+    _flight.record("fleet.deadline_exemption", entry=describe)
 
 
 def _hung(

@@ -359,6 +359,34 @@ def test_warmup_manifest_requires_matching_dtype_and_cells(store_dir):
     assert not report.entries
 
 
+def test_warmup_manifest_skips_sharded_rows(store_dir):
+    """record_miss(sharded=True) rows under-specify the executable's
+    layout (shapes alone carry no mesh): replaying them would burn an
+    XLA compile on an UNSHARDED key the real sharded dispatch never
+    hits — the replay must skip them, reported, zero compiles."""
+    frame = tfs.frame_from_arrays({"x": np.arange(12.0)}, num_blocks=2)
+
+    def fn(x):
+        return {"y": x + 100.0}
+
+    tfs.map_blocks(tfs.compile_program(fn, frame), frame).blocks()
+    manifest = os.path.join(store_dir, "aot", "manifest.jsonl")
+    rows = [json.loads(ln) for ln in open(manifest)]
+    with open(manifest, "w") as f:
+        for row in rows:
+            row["sharded"] = True
+            f.write(json.dumps(row) + "\n")
+
+    fresh = tfs.compile_program(fn, frame)
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    report = warmup(None, fresh, manifest=manifest)
+    assert _hist_count("tftpu_executor_compile_seconds") == c0
+    assert report.entries and all(
+        e["status"] == "skipped" and "sharded" in e["detail"]
+        for e in report.entries
+    )
+
+
 def test_warmup_manifest_true_without_store_raises():
     tfs.configure(compilation_cache_dir="")
     frame = tfs.frame_from_arrays({"x": np.arange(4.0)})
@@ -403,6 +431,208 @@ def test_fingerprint_donate_and_kind_in_key():
     base = program_fingerprint(p, probe=8)
     assert program_fingerprint(p, probe=8, donate=True) != base
     assert program_fingerprint(p, probe=8, kind="vmap") != base
+
+
+# ---------------------------------------------------------------------------
+# topology-fingerprinted keys (ISSUE 10 tentpole)
+# ---------------------------------------------------------------------------
+
+def _mesh_or_skip(axes=None):
+    from tensorframes_tpu.parallel import device_count, make_mesh
+
+    if device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return make_mesh(axes)
+
+
+def test_fingerprint_sharding_axes_in_key():
+    """Per-input shardings key separate executables: an AOT executable
+    is layout-specialized, so mesh axis names, mesh shape, and the
+    per-dim partition spec must all invalidate — while the TRIVIAL
+    placement (host feeds, default device) keys exactly like no
+    sharding at all (warmed host shapes must match however data
+    arrives)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_or_skip()
+    frame = tfs.frame_from_arrays({"x": np.arange(64.0)})
+    p = tfs.compile_program(lambda x: {"y": x * 5.0}, frame)
+    base = program_fingerprint(p, probe=64)
+    sharded = program_fingerprint(
+        p, probe=64, shardings={"x": NamedSharding(mesh, P("dp"))}
+    )
+    assert sharded != base  # layout in the key
+    # replicated-over-the-mesh is a different layout than dp-sharded
+    repl = program_fingerprint(
+        p, probe=64, shardings={"x": NamedSharding(mesh, P())}
+    )
+    assert repl not in (base, sharded)
+    # axis NAMES are identity: same shape, renamed axis → different key
+    mesh2 = _mesh_or_skip({"data": 8})
+    renamed = program_fingerprint(
+        p, probe=64, shardings={"x": NamedSharding(mesh2, P("data"))}
+    )
+    assert renamed not in (base, sharded, repl)
+    # mesh SHAPE is identity: dp=2 x tp=4 keys differently from dp=8
+    mesh3 = _mesh_or_skip({"dp": 2, "tp": 4})
+    reshaped = program_fingerprint(
+        p, probe=64, shardings={"x": NamedSharding(mesh3, P("dp"))}
+    )
+    assert reshaped not in (base, sharded, repl, renamed)
+    # an explicit None / trivial sharding is the SAME key as no map
+    assert program_fingerprint(p, probe=64, shardings={}) == base
+    assert program_fingerprint(p, probe=64, shardings={"x": None}) == base
+
+
+def test_fingerprint_process_topology_in_key(monkeypatch):
+    """The fleet topology (device→process map) is in the env component:
+    a resized fleet must miss cleanly instead of loading an executable
+    compiled for the wrong collective schedule — while the key is
+    process-INDEX-independent (every rank computes the same key, so one
+    rank's published executable is every peer's hit)."""
+    from tensorframes_tpu.compilecache import fingerprint as fp_mod
+    from tensorframes_tpu.parallel import process_topology
+
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)})
+    p = tfs.compile_program(lambda x: {"y": x + 2.0}, frame)
+    base = program_fingerprint(p, probe=8)
+    real = process_topology()
+    assert real["n_processes"] == 1  # single-process test env
+
+    resized = dict(real, n_processes=4)
+    monkeypatch.setattr(
+        fp_mod, "_env_parts",
+        _patched_env_parts(fp_mod._env_parts, resized),
+    )
+    assert program_fingerprint(p, probe=8) != base  # resize → clean miss
+
+
+def _patched_env_parts(orig, topology):
+    def env_parts(kind, donate, hoisted):
+        parts = orig(kind, donate, hoisted)
+        parts["topology"] = topology
+        return parts
+
+    return env_parts
+
+
+def test_sharded_dispatch_roundtrip_bit_identical(store_dir):
+    """A sharded frame's dispatch publishes its executable; a FRESH
+    program instance over the same computation loads it from disk (hit
+    counter, zero compile delta) and the cached result is bit-identical
+    to cache-off dispatch."""
+    _mesh_or_skip()
+
+    def build():
+        df = tfs.frame_from_arrays(
+            {"x": np.arange(128.0, dtype=np.float32)}
+        ).to_device()
+        assert df.is_sharded
+        return df, tfs.compile_program(
+            lambda x: {"y": x * 1.5 + x.sum()}, df
+        )
+
+    # reference: cache OFF
+    tfs.configure(compilation_cache_dir="")
+    df, p = build()
+    want = np.asarray(tfs.map_blocks(p, df).column_values("y"))
+
+    tfs.configure(compilation_cache_dir=store_dir)
+    df, p = build()
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    got_cold = np.asarray(tfs.map_blocks(p, df).column_values("y"))
+    assert _hist_count("tftpu_executor_compile_seconds") > c0  # published
+    assert _entries(store_dir)  # the sharded executable is durable
+
+    df, p = build()  # fresh Program: its in-memory jit cache is empty
+    h0 = _counter_val("tftpu_compilecache_hits_total")
+    c1 = _hist_count("tftpu_executor_compile_seconds")
+    got_warm = np.asarray(tfs.map_blocks(p, df).column_values("y"))
+    assert _counter_val("tftpu_compilecache_hits_total") > h0
+    assert _hist_count("tftpu_executor_compile_seconds") == c1  # ZERO
+    np.testing.assert_array_equal(got_warm, got_cold)
+    np.testing.assert_array_equal(got_warm, want)
+
+
+def test_warm_sharded_key_makes_first_dispatch_a_hit(store_dir):
+    """warm() with sharding-annotated abstract feeds precompiles the
+    SHARDED placement's key: the first real sharded dispatch is a
+    jit-cache hit with zero compile (the multi-process refusal is gone
+    — every dispatch rides the unified AOT path the warm targets)."""
+    import jax
+
+    _mesh_or_skip()
+    df = tfs.frame_from_arrays(
+        {"x": np.arange(128.0, dtype=np.float32)}
+    ).to_device()
+    p = tfs.compile_program(lambda x: {"y": x - 2.0}, df)
+    col = df.blocks()[0]["x"]
+    abstract = {
+        "x": jax.ShapeDtypeStruct(col.shape, col.dtype,
+                                  sharding=col.sharding),
+    }
+    status = p.compiled().warm("block", abstract)
+    assert status in ("compiled", "disk")
+    h0 = _counter_val("tftpu_executor_jit_cache_hits_total")
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    out = tfs.map_blocks(p, df).column_values("y")
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(128.0, dtype=np.float32) - 2.0
+    )
+    assert _counter_val("tftpu_executor_jit_cache_hits_total") > h0
+    assert _hist_count("tftpu_executor_compile_seconds") == c0
+
+
+def test_aot_jit_sharded_store_roundtrip(store_dir):
+    """aot_jit (the unified pipeline for arbitrary pytree functions —
+    what the MULTICHIP train steps dispatch through) publishes sharded
+    executables a fresh instance loads from disk, bit-identically."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorframes_tpu.ops.executor import aot_jit
+
+    mesh = _mesh_or_skip()
+    sh = NamedSharding(mesh, P("dp"))
+    x = jax.device_put(np.arange(64.0, dtype=np.float32), sh)
+
+    def f(a):
+        return a * 2.0 + a.sum()
+
+    c0 = _hist_count("tftpu_executor_compile_seconds")
+    cold = np.asarray(aot_jit(f, label="t")(x))
+    assert _hist_count("tftpu_executor_compile_seconds") == c0 + 1
+
+    h0 = _counter_val("tftpu_compilecache_hits_total")
+    warm = np.asarray(aot_jit(f, label="t")(x))  # fresh instance
+    assert _counter_val("tftpu_compilecache_hits_total") > h0
+    assert _hist_count("tftpu_executor_compile_seconds") == c0 + 1
+    np.testing.assert_array_equal(cold, warm)
+
+
+def test_aot_jit_weak_type_keys_apart_and_promotes_like_jit():
+    """A weak-typed 0-d array leaf (jnp.asarray(python_scalar)) must
+    trace with weak_type preserved — dropping it promotes int8 + weak
+    int to the weak leaf's dtype, a result the wrapped jax.jit never
+    produces — and must not share an executable with a strong-typed
+    leaf of the same dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.executor import aot_jit
+
+    xi = jnp.ones((3,), jnp.int8)
+    weak = jnp.asarray(1)
+    strong = jnp.array(1, weak.dtype)
+    assert weak.weak_type and not strong.weak_type
+
+    f = aot_jit(lambda a, b: a + b, label="weak")
+    ref = jax.jit(lambda a, b: a + b)
+    assert f(xi, weak).dtype == ref(xi, weak).dtype == jnp.int8
+    assert f(xi, strong).dtype == ref(xi, strong).dtype == weak.dtype
+    # both variants rode the AOT path under DISTINCT keys — neither
+    # fell back nor reused the other's strongly-typed executable
+    assert len(f._builds.built) == 2 and not f._builds.failed
 
 
 # ---------------------------------------------------------------------------

@@ -348,6 +348,91 @@ print(f"proc {{sys.argv[1]}} OK total={{float(total)}} frame_sum={{float(s)}}", 
 """
 
 
+# ---------------------------------------------------------------------------
+# sharded compile-cache round trip (ISSUE 10): the same worker runs twice
+# against ONE persistent store; its sharded dispatches ride the unified
+# AOT path, so run 2 must load every executable from disk — zero XLA
+# compiles — and produce bit-identical results. The metrics JSONL the
+# worker writes is the same artifact shape CI asserts on
+# (tftpu_compilecache_hits_total / tftpu_executor_compile_seconds).
+# ---------------------------------------------------------------------------
+
+_CACHE_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, {repo!r})
+import json
+import numpy as np
+import tensorframes_tpu as tfs
+from tensorframes_tpu.observability import REGISTRY
+
+df = tfs.frame_from_arrays(
+    {{"x": np.arange(640, dtype=np.float32)}}
+).to_device()
+assert df.is_sharded, "worker needs the 8-device virtual mesh"
+program = tfs.compile_program(
+    lambda x: {{"y": x * 3.0 + 1.0, "z": x.sum() + x}}, df
+)
+out = tfs.map_blocks(program, df)
+y = np.asarray(out.column_values("y"))
+z = np.asarray(out.column_values("z"))
+np.save(sys.argv[2], np.stack([y, z]))
+REGISTRY.write_jsonl(sys.argv[1])
+print("CACHE WORKER OK", flush=True)
+"""
+
+
+def _metric(path, name, field="value"):
+    import json as _json
+
+    total = 0.0
+    for line in open(path):
+        d = _json.loads(line)
+        if d["name"] == name:
+            total += d.get(field) or 0
+    return total
+
+
+def test_sharded_cache_roundtrip_across_processes(tmp_path):
+    """Two fresh subprocesses share one TFTPU_COMPILE_CACHE: the second
+    performs ZERO XLA compiles (all sharded executables load from the
+    store) and its results are bit-identical to the first's — the
+    tentpole acceptance, in-suite."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "cache_worker.py"
+    script.write_text(_CACHE_WORKER.format(repo=repo))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["TFTPU_COMPILE_CACHE"] = str(tmp_path / "store")
+    outs = []
+    for run in (1, 2):
+        metrics = tmp_path / f"metrics_{run}.jsonl"
+        results = tmp_path / f"results_{run}.npy"
+        r = subprocess.run(
+            [sys.executable, str(script), str(metrics), str(results)],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        outs.append((metrics, results))
+    import numpy as np
+
+    m1, r1 = outs[0]
+    m2, r2 = outs[1]
+    # run 1 is the cold publisher: it compiled, and anything it read
+    # from the store was published by... nobody (fresh dir)
+    assert _metric(m1, "tftpu_executor_compile_seconds", "count") > 0
+    # run 2 is the warm loader: disk hits, ZERO XLA compiles, and the
+    # dispatch never fell back to lazy jit
+    assert _metric(m2, "tftpu_compilecache_hits_total") > 0
+    assert _metric(m2, "tftpu_executor_compile_seconds", "count") == 0
+    assert _metric(m2, "tftpu_executor_fallback_dispatch_total") == 0
+    # sharded cached results are bit-identical across the round trip
+    np.testing.assert_array_equal(np.load(r1), np.load(r2))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -15,6 +15,7 @@ import subprocess
 import sys
 import time
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -350,29 +351,75 @@ def test_dispatch_deadline_trips_on_delayed_executor_dispatch(tmp_path):
     assert hung and "executor.run_block" in hung[0]["entry"]
 
 
-def test_legacy_first_compile_exempt_from_deadline(monkeypatch):
-    """Legacy jit path (AOT-ineligible feeds): the FIRST dispatch of a
-    shape compiles lazily inside the call and must be exempt from the
-    deadline (a slow compile is not a hung collective); steady-state
-    dispatches at the same shape stay bounded."""
-    from tensorframes_tpu.ops import executor as ex
+def test_deadline_exemption_scoped_to_fallback_compiles(monkeypatch):
+    """ISSUE 10 regression: since the unified AOT dispatch, the ONLY
+    deadline-exempt dispatches are genuine cache-miss lazy compiles on
+    the counted jit fallback (AOT build raised — the XLA compile runs
+    lazily INSIDE the call). A normal first dispatch compiles/loads
+    OUTSIDE the watchdog scope and stays bounded — the old blanket
+    first-dispatch exemption must be gone."""
+    from tensorframes_tpu.ops.executor import CompiledProgram
 
-    monkeypatch.setattr(ex, "_aot_eligible", lambda feeds: False)
     df = tfs.frame_from_arrays({"x": np.arange(12.0) + 100.0},
                                num_blocks=1)
-    program = tfs.compile_program(lambda x: {"y": x - 1.0}, df)
     tfs.configure(dispatch_deadline_s=0.3)
     try:
-        # fresh dispatch + injected stall: exempt, must complete
-        with faults.inject("executor.dispatch", faults.Delay(0.6),
-                           max_times=1):
-            out = tfs.map_blocks(program, df).column_values("y")
-        np.testing.assert_array_equal(out, np.arange(12.0) + 99.0)
-        # same shape again (steady state): the watchdog is armed
+        # --- AOT path, first dispatch: NOT exempt. The injected stall
+        # wedges the dispatch body (post-build), so the watchdog fires.
+        program = tfs.compile_program(lambda x: {"y": x + 1.0}, df)
+        exempt_before = fleet._DEADLINE_EXEMPTIONS.value
         with faults.inject("executor.dispatch", faults.Delay(10.0),
                            max_times=1):
             with pytest.raises(fleet.HungDispatchError):
                 tfs.map_blocks(program, df).collect()
+        assert fleet._DEADLINE_EXEMPTIONS.value == exempt_before
+
+        # --- fallback path (AOT build raises): first dispatch is the
+        # lazy compile — exempt, counted, and it must complete.
+        monkeypatch.setattr(
+            CompiledProgram, "_build_aot_impl",
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                RuntimeError("forced AOT build failure")
+            ),
+        )
+        program2 = tfs.compile_program(lambda x: {"y": x - 1.0}, df)
+        fb = tfs.map_blocks(program2, df)
+        with faults.inject("executor.dispatch", faults.Delay(0.6),
+                           max_times=1):
+            out = fb.column_values("y")
+        np.testing.assert_array_equal(out, np.arange(12.0) + 99.0)
+        assert fleet._DEADLINE_EXEMPTIONS.value == exempt_before + 1
+
+        # --- fallback steady state (same shape again): the compile is
+        # done, so the watchdog is armed — no second exemption.
+        with faults.inject("executor.dispatch", faults.Delay(10.0),
+                           max_times=1):
+            with pytest.raises(fleet.HungDispatchError):
+                tfs.map_blocks(program2, df).collect()
+        assert fleet._DEADLINE_EXEMPTIONS.value == exempt_before + 1
+    finally:
+        tfs.configure(dispatch_deadline_s=0.0)
+
+
+def test_aot_jit_scalar_leaf_exemption_is_first_dispatch_only():
+    """A Python-scalar leaf keeps an aot_jit entry on the lazy-jit path
+    (no AOT key) — but the deadline exemption must still cover only the
+    FIRST dispatch of each trace-cache signature, never every call: a
+    steady-state hang of a scalar-carrying train step must stay visible
+    to the fleet watchdog."""
+    from tensorframes_tpu.ops.executor import aot_jit
+
+    tfs.configure(dispatch_deadline_s=30.0)
+    try:
+        exempt_before = fleet._DEADLINE_EXEMPTIONS.value
+        f = aot_jit(lambda x, s: x * s, label="scalar-exempt")
+        for _ in range(3):
+            f(jnp.ones((4,)), 2.5)  # same lazy signature every call
+        assert fleet._DEADLINE_EXEMPTIONS.value == exempt_before + 1
+        f(jnp.ones((8,)), 2.5)  # new shape: one more genuine lazy compile
+        assert fleet._DEADLINE_EXEMPTIONS.value == exempt_before + 2
+        f(jnp.ones((8,)), 7.5)  # new VALUE only: same trace, no exemption
+        assert fleet._DEADLINE_EXEMPTIONS.value == exempt_before + 2
     finally:
         tfs.configure(dispatch_deadline_s=0.0)
 

@@ -349,6 +349,70 @@ def test_executor_cache_hit_miss_counters():
             - compiles0 == d_compile)
 
 
+def test_sharded_dispatch_compile_accounting_split():
+    """ISSUE 10: with the legacy path folded into the unified AOT
+    pipeline, the compile/first-run split holds on EVERY dispatch —
+    sharded feeds included (they used to ride the lazy-jit path, where
+    the first call lumped compile+run into compile-seconds). A sharded
+    dispatch's compile-seconds observation must be trace+XLA only,
+    with the first execution timed separately."""
+    from tensorframes_tpu.compilecache import active_store
+    from tensorframes_tpu.parallel import device_count
+
+    if device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    df = tfs.frame_from_arrays(
+        {"x": np.arange(64.0, dtype=np.float32)}
+    ).to_device()
+    assert df.is_sharded
+    program = tfs.compile_program(
+        lambda x: {"y": x * 2.0 + 1.0}, df
+    )
+    s0 = _snap()
+    fb0 = s0[("tftpu_executor_fallback_dispatch_total", ())]["value"]
+    out = tfs.map_blocks(program, df).column_values("y")
+    np.testing.assert_allclose(out, np.arange(64.0) * 2.0 + 1.0)
+    s1 = _snap()
+    d_miss = (s1[("tftpu_executor_jit_cache_misses_total", ())]["value"]
+              - s0[("tftpu_executor_jit_cache_misses_total", ())]["value"])
+    d_compile = (s1[("tftpu_executor_compile_seconds", ())]["count"]
+                 - s0[("tftpu_executor_compile_seconds", ())]["count"])
+    d_first = (s1[("tftpu_executor_first_run_seconds", ())]["count"]
+               - s0[("tftpu_executor_first_run_seconds", ())]["count"])
+    assert d_miss >= 1
+    # the sharded dispatch rode the unified AOT path, not the fallback
+    assert s1[("tftpu_executor_fallback_dispatch_total", ())]["value"] == fb0
+    if active_store() is None:  # a live store may serve misses from disk
+        assert d_compile == d_miss
+    assert d_first == d_miss  # first run timed on the sharded path too
+
+
+def test_fallback_dispatch_observes_neither_histogram(monkeypatch):
+    """The counted lazy-jit fallback (AOT build raised) must not lump
+    its compile+run into either histogram — that would resurrect the
+    pre-unification accounting caveat the docs no longer carry."""
+    from tensorframes_tpu.ops.executor import CompiledProgram
+
+    monkeypatch.setattr(
+        CompiledProgram, "_build_aot_impl",
+        lambda self, *a, **k: (_ for _ in ()).throw(
+            RuntimeError("forced AOT build failure")
+        ),
+    )
+    df = tfs.frame_from_arrays({"x": np.arange(8.0)}, num_blocks=1)
+    program = tfs.compile_program(lambda x: {"y": x - 3.0}, df)
+    s0 = _snap()
+    out = tfs.map_blocks(program, df).column_values("y")
+    np.testing.assert_array_equal(out, np.arange(8.0) - 3.0)
+    s1 = _snap()
+    assert (s1[("tftpu_executor_fallback_dispatch_total", ())]["value"]
+            - s0[("tftpu_executor_fallback_dispatch_total", ())]["value"]) == 1
+    assert (s1[("tftpu_executor_compile_seconds", ())]["count"]
+            == s0[("tftpu_executor_compile_seconds", ())]["count"])
+    assert (s1[("tftpu_executor_first_run_seconds", ())]["count"]
+            == s0[("tftpu_executor_first_run_seconds", ())]["count"])
+
+
 def test_padding_waste_counter():
     from tensorframes_tpu.ops.executor import pad_lead_dim
 
