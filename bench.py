@@ -712,6 +712,97 @@ def _bench_serving_decode(n_requests: int = 6, new_tokens: int = 8,
         srv.stop(drain=True, timeout=120)
 
 
+def _bench_decode_engine(n_requests: int = 12, new_tokens: int = 8,
+                         max_prompt_len: int = 16, max_slots: int = 8,
+                         rate_rps: float = 60.0):
+    """Open-loop ITERATIVE decode (ISSUE 11 acceptance): unlike
+    ``_bench_serving_decode`` (whole sequences coalesced per flush),
+    this drives the token-level engine — mixed-length prompts arrive on
+    a fixed schedule and join/leave the running batch every step over
+    the paged int8 KV pool. Reported: generated tokens/sec over the
+    window, time-to-first-token p50/p99 (timed window only), and the
+    steady-state XLA compile count. Hard gates (raise, so the smoke
+    exits nonzero): every request completes, a warmed engine performs
+    ZERO steady-state compiles, and each request's batched output is
+    BIT-IDENTICAL to the same prompt decoded solo afterwards."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+    from tensorframes_tpu.ops.executor import _JIT_MISSES
+    from tensorframes_tpu.serving import metrics as smet
+
+    cfg = gen.gpt_tiny()
+    params = tr.quantize_params(tr.init_params(cfg, seed=0))
+    srv = tfs.Server(tfs.ServingConfig(max_batch_rows=8))
+    srv.register_decode(
+        "decode", cfg, params,
+        tfs.DecodeConfig(
+            max_slots=max_slots, page_size=8,
+            max_prompt_len=max_prompt_len, max_new_tokens=new_tokens,
+        ),
+    )
+    srv.start()
+    try:
+        rng = np.random.default_rng(0)
+        prompts = [
+            rng.integers(
+                0, cfg.vocab_size,
+                (int(rng.integers(4, max_prompt_len + 1)),),
+            ).astype(np.int32)
+            for _ in range(n_requests)
+        ]
+        # pipeline warm through every phase, discarded
+        srv.call("decode", {"prompt": prompts[0]}, timeout=600)
+        miss0 = _JIT_MISSES.value
+        pre0 = smet.DECODE_PREEMPTIONS.value
+        ttft_before = smet.DECODE_TTFT.cumulative()
+        period = 1.0 / rate_rps
+        futs = []
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            target = t0 + i * period
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            futs.append(srv.submit("decode", {"prompt": p}))
+        outs = [f.result(600)["tokens"] for f in futs]
+        elapsed = time.perf_counter() - t0
+        steady = int(_JIT_MISSES.value - miss0)
+        assert len(outs) == n_requests, (
+            f"lost requests: {len(outs)}/{n_requests} completed"
+        )
+        assert steady == 0, (
+            f"warmed decode engine compiled {steady}x in steady state"
+        )
+        # TTFT quantiles over the open-loop window ONLY — the solo
+        # gate calls below also observe DECODE_TTFT and would dilute
+        # the gated p50/p99 with idle-queue joins
+        q = _hist_delta_quantiles(smet.DECODE_TTFT, ttft_before)
+        # bit-identity hard gate: solo decode of each prompt through
+        # the SAME warmed engine must reproduce the batched output
+        for i, p in enumerate(prompts):
+            solo = srv.call("decode", {"prompt": p}, timeout=600)
+            assert np.array_equal(outs[i], solo["tokens"]), (
+                f"request {i}: batched iterative decode != solo decode "
+                "(bit-identity gate)"
+            )
+        tokens = sum(int(o.shape[1]) for o in outs)
+        return {
+            "tokens_per_sec": tokens / elapsed,
+            "ttft_p50_s": q["p50"] or 0.0,
+            "ttft_p99_s": q["p99"] or 0.0,
+            "steady_state_compiles": steady,
+            "requests": n_requests,
+            "completed": len(outs),
+            # window delta; structurally 0 here (the auto-sized pool
+            # holds every slot's horizon) — preemption pressure is
+            # exercised by tests, this bench measures clean throughput
+            "preemptions": int(smet.DECODE_PREEMPTIONS.value - pre0),
+        }
+    finally:
+        srv.stop(drain=True, timeout=300)
+
+
 def _bench_read_csv(n_rows: int = 1_000_000):
     """CSV → frame ingestion (native C++ single-pass parser), s/call."""
     import os
@@ -1846,6 +1937,17 @@ def main():
         "serving_decode", _bench_serving_decode, 0.0,
         metric_keys=("serving_gpt_tiny_int8kv_decode_tokens_per_sec",),
     )
+    # iterative decode engine (ISSUE 11): token-level continuous
+    # batching over the paged int8 KV pool — tokens/sec + TTFT ride the
+    # snapshot schema so `observability diff` gates regressions
+    decode_res = _try(
+        "serving_decode_engine", _bench_decode_engine, {},
+        metric_keys=(
+            "serving_decode_tokens_per_sec",
+            "serving_decode_ttft_p50_s",
+            "serving_decode_ttft_p99_s",
+        ),
+    ) or {}
     if serving_res:
         print(
             "# serving | open_loop rows_per_sec={:.0f} p50={:.6f}s "
@@ -1861,6 +1963,18 @@ def main():
         print(
             f"# serving | decode_int8kv gpt_tiny coalesced "
             f"tokens_per_sec={serving_dec_tps:.1f}"
+        )
+    if decode_res:
+        print(
+            "# serving | decode_engine tokens_per_sec={:.1f} "
+            "ttft_p50={:.6f}s ttft_p99={:.6f}s steady_state_compiles={} "
+            "requests={} preemptions={} (gates: 0 steady compiles, "
+            "batched==solo bit-identical, none lost)".format(
+                decode_res["tokens_per_sec"], decode_res["ttft_p50_s"],
+                decode_res["ttft_p99_s"],
+                decode_res["steady_state_compiles"],
+                decode_res["requests"], decode_res["preemptions"],
+            )
         )
 
     from tensorframes_tpu import native
@@ -1921,6 +2035,15 @@ def main():
         ),
         "serving_gpt_tiny_int8kv_decode_tokens_per_sec": round(
             serving_dec_tps or 0.0, 1
+        ),
+        "serving_decode_tokens_per_sec": round(
+            decode_res.get("tokens_per_sec", 0.0), 1
+        ),
+        "serving_decode_ttft_p50_s": round(
+            decode_res.get("ttft_p50_s", 0.0), 6
+        ),
+        "serving_decode_ttft_p99_s": round(
+            decode_res.get("ttft_p99_s", 0.0), 6
         ),
     }
     print(f"# chips={n_chips} devices={jax.devices()}")
@@ -2165,10 +2288,69 @@ def serving_main():
         sys.exit(1)
 
 
+def serving_decode_main():
+    """``python bench.py serving-decode`` — the CI iterative-decode
+    smoke: a short open-loop mixed-length prompt load through the
+    token-level engine, tracing ON. Exits nonzero if a warmed engine
+    compiled in steady state, lost a request, or a batched result
+    diverged from solo decode (the in-bench hard gates raise). Writes
+    ``serving_decode_metrics.jsonl`` (the ``tftpu_decode_*`` family
+    rides it) + ``serving_decode_trace.json`` into ``TFTPU_OBS_EXPORT``
+    and prints one JSON line for scripting."""
+    import os
+    import sys
+
+    from tensorframes_tpu.observability import events as ev
+
+    ev.enable()
+    res = _try(
+        "serving_decode_engine", _bench_decode_engine, {}
+    ) or {}
+    if res:
+        print(
+            "# serving-decode | tokens_per_sec={:.1f} ttft_p50={:.6f}s "
+            "ttft_p99={:.6f}s steady_state_compiles={} requests={} "
+            "completed={} preemptions={}".format(
+                res["tokens_per_sec"], res["ttft_p50_s"],
+                res["ttft_p99_s"], res["steady_state_compiles"],
+                res["requests"], res["completed"], res["preemptions"],
+            )
+        )
+    out_dir = os.environ.get("TFTPU_OBS_EXPORT")
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        from tensorframes_tpu.observability.metrics import REGISTRY
+
+        REGISTRY.write_jsonl(
+            os.path.join(out_dir, "serving_decode_metrics.jsonl")
+        )
+        ev.save(os.path.join(out_dir, "serving_decode_trace.json"))
+        print(f"# serving-decode | artifacts -> {out_dir}")
+    print(json.dumps({
+        "metric": "serving iterative decode tokens/sec",
+        "value": round(res.get("tokens_per_sec", 0.0), 1),
+        "unit": "tokens/s",
+        "ttft_p50_s": res.get("ttft_p50_s"),
+        "ttft_p99_s": res.get("ttft_p99_s"),
+        "steady_state_compiles": res.get("steady_state_compiles"),
+        "requests": res.get("requests"),
+        "completed": res.get("completed"),
+    }))
+    if not res or res.get("steady_state_compiles", 1) != 0 \
+            or res.get("completed") != res.get("requests"):
+        print(
+            "# serving-decode | FAILED: steady-state compiles != 0, "
+            "lost requests, or a hard gate raised"
+        )
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     import sys as _sys
 
     if len(_sys.argv) > 1 and _sys.argv[1] == "serving":
         serving_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "serving-decode":
+        serving_decode_main()
     else:
         main()

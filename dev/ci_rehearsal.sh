@@ -153,6 +153,17 @@ run_step "Serving smoke (open-loop CPU load, zero steady-state compiles)" bash -
   test -s '$WORK/obs/serving_trace.json'
 "
 
+# ci.yml's iterative-decode smoke (ISSUE 11): open-loop mixed-length
+# prompts through the token-level decode engine + paged KV pool —
+# exits nonzero on steady-state compiles, lost requests, or a
+# batched-vs-solo bit-identity divergence; the tftpu_decode_* metrics
+# JSONL rides the observability artifacts
+run_step "Serving decode smoke (iterative decode engine, paged KV pool)" bash -c "
+  env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.serving_decode_main()\" &&
+  test -s '$WORK/obs/serving_decode_metrics.jsonl' &&
+  test -s '$WORK/obs/serving_decode_trace.json'
+"
+
 # ci.yml's fleet chaos-drill step: kill-rank + hung-collective +
 # drop-heartbeat on a 2-process CPU fleet, with the flight black box
 # spooled next to the other observability artifacts

@@ -122,7 +122,13 @@ from .observability import StepTelemetry  # noqa: E402,F401
 from . import compilecache  # noqa: E402,F401  (registers tftpu_compilecache_* metrics)
 from .compilecache import WarmupReport, warmup  # noqa: E402,F401
 from . import serving  # noqa: E402,F401  (registers tftpu_serving_* metrics)
-from .serving import Server, ServingConfig, serve_http  # noqa: E402,F401
+from .serving import (  # noqa: E402,F401
+    DecodeConfig,
+    DecodeEngine,
+    Server,
+    ServingConfig,
+    serve_http,
+)
 
 __version__ = "0.3.0"
 
@@ -156,6 +162,8 @@ __all__ = [
     "serving",
     "Server",
     "ServingConfig",
+    "DecodeConfig",
+    "DecodeEngine",
     "serve_http",
     "Checkpointer",
     "CheckpointCorruptionError",

@@ -27,6 +27,8 @@ from .fingerprint import FORMAT_VERSION, program_fingerprint  # noqa: F401
 from .store import CompileCacheStore, active_store, store_for  # noqa: F401
 from .warmup import (  # noqa: F401
     WarmupReport,
+    decode_slot_buckets,
+    decode_warmup_grid,
     partitioner_row_counts,
     serving_row_buckets,
     warm_program,
@@ -38,6 +40,8 @@ __all__ = [
     "CompileCacheStore",
     "WarmupReport",
     "active_store",
+    "decode_slot_buckets",
+    "decode_warmup_grid",
     "partitioner_row_counts",
     "program_fingerprint",
     "serving_row_buckets",

@@ -41,7 +41,7 @@ logger = get_logger(__name__)
 
 __all__ = [
     "WarmupReport", "warmup", "warm_program", "partitioner_row_counts",
-    "serving_row_buckets",
+    "serving_row_buckets", "decode_slot_buckets", "decode_warmup_grid",
 ]
 
 
@@ -165,6 +165,53 @@ def serving_row_buckets(max_rows: int) -> List[int]:
         )
     top = bucket_rows(max_rows)
     return [b for b in table if b <= top]
+
+
+def decode_slot_buckets(max_slots: int) -> List[int]:
+    """The slot-count buckets the iterative decode engine's batched
+    step can dispatch at — BY CONSTRUCTION the same power-of-two ladder
+    as :func:`serving_row_buckets`, because a decode slot count is a
+    vmapped lead dim like any flush's row count. ONE bucket policy,
+    stated once, shared by three consumers that must never drift:
+
+    * the flush batcher pads coalesced rows through
+      ``ops.executor.bucket_rows``;
+    * ``Server.start()`` warms ``serving_row_buckets(max_batch_rows)``;
+    * the decode engine pads its running slot count through THIS ladder
+      and warms every (phase × bucket) pair at start
+      (:func:`decode_warmup_grid`).
+
+    The delegation (not a reimplementation) is the drift guard: any
+    change to the ladder — ``min_bucket``, ``max_bucket_doublings``,
+    the beyond-ladder refusal — applies to rows and slots identically.
+    Asserted against ``bucket_rows`` below so a future fork of either
+    policy fails loudly here rather than as a steady-state compile."""
+    from ..ops.executor import bucket_rows
+
+    buckets = serving_row_buckets(max_slots)
+    for n in range(1, int(max_slots) + 1):
+        if bucket_rows(n) not in buckets:
+            raise AssertionError(
+                f"bucket policy drift: bucket_rows({n}) = "
+                f"{bucket_rows(n)} is not in the warmed ladder "
+                f"{buckets} — serving_row_buckets and bucket_rows no "
+                "longer agree; fix the shared ladder, do not fork it"
+            )
+    return buckets
+
+
+def decode_warmup_grid(max_slots: int,
+                       max_prompt_len: int) -> Dict[str, List[int]]:
+    """The slot-count × phase bucket grid a decode engine must warm for
+    zero steady-state compiles: one decode-step executable per slot
+    bucket, one prefill executable per prompt-length bucket (prompt
+    lengths pad through the SAME ladder — a prefill chunk's token dim
+    is a vmapped lead dim too). The engine's ``start()`` walks exactly
+    this grid; tests assert no dispatch ever lands off it."""
+    return {
+        "decode": decode_slot_buckets(max_slots),
+        "prefill": serving_row_buckets(max_prompt_len),
+    }
 
 
 def _target_row_counts(frame, rows, block: bool) -> List[int]:

@@ -278,6 +278,205 @@ def generate_naive(
     return toks[:, prompts.shape[1]:].astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Paged KV: the pool layout + step functions the serving decode engine
+# (serving/decode.py) runs through aot_jit. Same int8-KV scheme as
+# init_kv_cache(quant=True) — int8 k/v with one f32 scale per cache slot
+# — but laid out page-major so a pool of fixed-size pages can be shared
+# by many sequences through per-sequence page tables (vLLM-style paged
+# attention, ISSUE 11).
+# ---------------------------------------------------------------------------
+
+def init_paged_kv(
+    cfg: TransformerConfig, num_pages: int, page_size: int
+) -> Dict[str, jnp.ndarray]:
+    """The paged int8 KV pool as columnar state: page-major arrays
+    ``[num_pages, layers, heads, page_size, head_dim]`` (int8 k/v, f32
+    per-slot scales) — each array is one frame column with pages as
+    rows (``serving.kvpool.PagedKVPool.as_frame``). Page 0 is the
+    reserved NULL page: padding slots and masked prefill positions
+    write there, and attention masks guarantee it is never read
+    unmasked, so its garbage contents cannot reach any output."""
+    if num_pages < 2:
+        raise ValueError(
+            f"num_pages must be >= 2 (page 0 is the reserved null "
+            f"page), got {num_pages}"
+        )
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    shape = (num_pages, cfg.num_layers, cfg.num_heads, page_size,
+             cfg.head_dim)
+    sshape = shape[:-1] + (1,)
+    return {
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.ones(sshape, jnp.float32),
+        "v_scale": jnp.ones(sshape, jnp.float32),
+    }
+
+
+def paged_kv_nbytes(pool: Dict[str, jnp.ndarray]) -> int:
+    """Pool HBM footprint in bytes (the budget eviction exists to honor)."""
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a in pool.values())
+
+
+def paged_prefill_fn(cfg: TransformerConfig, page_size: int,
+                     max_pages: int):
+    """Build the prefill step for one sequence: ``fn(params, pool,
+    tokens[T], length, table[max_pages]) -> (pool, first_token)``.
+
+    ``tokens`` is the prompt padded to a ladder bucket T; ``length`` is
+    the true prompt length (a traced int32 scalar — one executable per
+    T bucket serves every prompt length in it). Writes positions
+    ``[0, length)`` into the sequence's pages through ``table`` (padding
+    positions route to the null page), attends causally within the
+    chunk over the QUANTIZED k/v — exactly the values decode steps will
+    read back from the pool — and returns the first generated token
+    (greedy argmax at position ``length - 1``).
+    """
+
+    def prefill(params, pool, tokens, length, table):
+        from ..ops.quantize import matmul as _mm
+
+        (T,) = tokens.shape
+        h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
+        tpos = jnp.arange(T)
+        x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+        x = x + params["embed"]["pos"][tpos].astype(cfg.dtype)
+        valid = tpos < length                       # real prompt slots
+        # per-position pool coordinates; masked positions → null page 0
+        pg = jnp.where(valid, table[jnp.minimum(tpos // page_size,
+                                                max_pages - 1)], 0)
+        off = tpos % page_size
+        causal = tpos[None, :] <= tpos[:, None]     # [T, T]
+        neg = jnp.asarray(-1e30, jnp.float32)
+        pool = dict(pool)
+        for li, p in enumerate(params["layers"]):
+            y = _layer_norm(x, **p["ln1"])
+            qkv = _mm(y, p["attn"]["qkv"]).reshape(T, 3, nh, hd)
+            q = qkv[:, 0].transpose(1, 0, 2)        # [nh, T, hd]
+            k = qkv[:, 1].transpose(1, 0, 2)
+            v = qkv[:, 2].transpose(1, 0, 2)
+            kq, ks = _quantize_slots(k[None])       # [1, nh, T, hd]
+            vq, vs = _quantize_slots(v[None])
+            kq, ks, vq, vs = kq[0], ks[0], vq[0], vs[0]
+            # ONE scatter per tensor per layer: advanced indices at the
+            # page and offset axes broadcast to [T, nh, ...] views
+            pool["k"] = pool["k"].at[pg, li, :, off].set(
+                kq.transpose(1, 0, 2)
+            )
+            pool["v"] = pool["v"].at[pg, li, :, off].set(
+                vq.transpose(1, 0, 2)
+            )
+            pool["k_scale"] = pool["k_scale"].at[pg, li, :, off].set(
+                ks.transpose(1, 0, 2)
+            )
+            pool["v_scale"] = pool["v_scale"].at[pg, li, :, off].set(
+                vs.transpose(1, 0, 2)
+            )
+            # attend within the chunk over the quantized k/v — the same
+            # dequantize-commutes formulation as _forward_cached, so
+            # prefill sees exactly what the pool now holds
+            kd = kq.astype(cfg.dtype)
+            scores = jnp.einsum(
+                "ntd,nsd->nts", q, kd,
+                preferred_element_type=jnp.float32,
+            ) / float(np.sqrt(hd))
+            scores = scores * ks[..., 0][:, None, :]
+            scores = jnp.where(causal[None], scores, neg)
+            w = jax.nn.softmax(scores, axis=-1)
+            w = (w * vs[..., 0][:, None, :]).astype(cfg.dtype)
+            ctx = jnp.einsum("nts,nsd->ntd", w, vq.astype(cfg.dtype))
+            ctx = ctx.transpose(1, 0, 2).reshape(T, h)
+            x = x + _mm(ctx, p["attn"]["out"])
+            x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+        hs = _layer_norm(x, **params["final_ln"])
+        last = jnp.take(hs, length - 1, axis=0)
+        first = jnp.argmax(
+            _logits(cfg, params, last), axis=-1
+        ).astype(jnp.int32)
+        return pool, first
+
+    return prefill
+
+
+def paged_decode_step_fn(cfg: TransformerConfig, page_size: int,
+                         max_pages: int):
+    """Build the batched decode step: ``fn(params, pool, tokens[S],
+    pos[S], tables[S, max_pages]) -> (pool, next_tokens[S])``.
+
+    One token per running slot: writes each slot's new k/v into its
+    current page (padding slots carry all-null tables and write into
+    the null page), gathers each slot's pages back as a contiguous
+    ``[S, heads, max_pages*page_size, head_dim]`` context (the paged KV
+    gather), and attends masked to ``j <= pos``. Every slot's row is
+    computed independently (the map_rows/vmap convention), which is
+    what makes a batched step bit-identical per slot to a solo step —
+    the serving bench hard-gates it.
+    """
+    C = max_pages * page_size
+
+    def step(params, pool, tokens, pos, tables):
+        from ..ops.quantize import matmul as _mm
+
+        (S,) = tokens.shape
+        h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
+        x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+        x = x + params["embed"]["pos"][pos].astype(cfg.dtype)
+        wpg = jnp.take_along_axis(
+            tables, jnp.minimum(pos // page_size, max_pages - 1)[:, None],
+            axis=1,
+        )[:, 0]                                     # [S] write page
+        woff = pos % page_size
+        valid = jnp.arange(C)[None, :] <= pos[:, None]   # [S, C]
+        neg = jnp.asarray(-1e30, jnp.float32)
+        pool = dict(pool)
+        for li, p in enumerate(params["layers"]):
+            y = _layer_norm(x, **p["ln1"])
+            qkv = _mm(y, p["attn"]["qkv"]).reshape(S, 3, nh, hd)
+            q = qkv[:, 0]                           # [S, nh, hd]
+            k = qkv[:, 1]
+            v = qkv[:, 2]
+            kq, ks = _quantize_slots(k[:, :, None, :])  # [S, nh, 1, hd]
+            vq, vs = _quantize_slots(v[:, :, None, :])
+            kq, ks = kq[:, :, 0], ks[:, :, 0]       # [S, nh, hd/1]
+            vq, vs = vq[:, :, 0], vs[:, :, 0]
+            pool["k"] = pool["k"].at[wpg, li, :, woff].set(kq)
+            pool["v"] = pool["v"].at[wpg, li, :, woff].set(vq)
+            pool["k_scale"] = pool["k_scale"].at[wpg, li, :, woff].set(ks)
+            pool["v_scale"] = pool["v_scale"].at[wpg, li, :, woff].set(vs)
+            # paged KV gather: each slot pulls its own pages (write
+            # above first, so slot j attends its own current token)
+            pk = pool["k"][tables, li]      # [S, MAXP, nh, page, hd]
+            pv = pool["v"][tables, li]
+            pks = pool["k_scale"][tables, li][..., 0]
+            pvs = pool["v_scale"][tables, li][..., 0]
+            pk = pk.transpose(0, 2, 1, 3, 4).reshape(S, nh, C, hd)
+            pv = pv.transpose(0, 2, 1, 3, 4).reshape(S, nh, C, hd)
+            pks = pks.transpose(0, 2, 1, 3).reshape(S, nh, C)
+            pvs = pvs.transpose(0, 2, 1, 3).reshape(S, nh, C)
+            scores = jnp.einsum(
+                "nhd,nhcd->nhc", q, pk.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            ) / float(np.sqrt(hd))
+            scores = scores * pks
+            scores = jnp.where(valid[:, None, :], scores, neg)
+            w = jax.nn.softmax(scores, axis=-1)
+            w = (w * pvs).astype(cfg.dtype)
+            ctx = jnp.einsum("nhc,nhcd->nhd", w, pv.astype(cfg.dtype))
+            ctx = ctx.reshape(S, h)
+            x = x + _mm(ctx, p["attn"]["out"])
+            x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+        hs = _layer_norm(x, **params["final_ln"])
+        nxt = jnp.argmax(
+            _logits(cfg, params, hs), axis=-1
+        ).astype(jnp.int32)
+        return pool, nxt
+
+    return step
+
+
 def generate_program(
     cfg: TransformerConfig,
     params: Dict,

@@ -24,7 +24,13 @@ Guarantees, stated once:
   ``serving.request`` trace spans, and flight-recorder ``serving.*``
   records ride the standard registry/tracer/black-box surfaces.
 
-See docs/serving.md for the operating guide.
+ISSUE 11 adds the **iterative decode engine** on top
+(:class:`DecodeEngine` via ``Server.register_decode``): token-level
+continuous batching over a block-paged int8 KV pool
+(:class:`PagedKVPool`) — sequence slots join/leave the running batch
+every step, the pool preempts (evict + requeue + bit-identical resume)
+when full, and the same four contracts hold per token instead of per
+flush. See docs/serving.md ("Iterative decode").
 """
 
 from __future__ import annotations
@@ -37,7 +43,13 @@ from .batcher import (  # noqa: F401
     ResultFuture,
     ServingError,
 )
+from .decode import DecodeConfig, DecodeEngine  # noqa: F401
 from .http import serve_http  # noqa: F401
+from .kvpool import (  # noqa: F401
+    PagedKVPool,
+    PoolAccountingError,
+    PoolExhaustedError,
+)
 from .server import (  # noqa: F401
     Endpoint,
     Server,
@@ -55,6 +67,11 @@ __all__ = [
     "RejectedError",
     "DeadlineExceededError",
     "UnknownEndpointError",
+    "DecodeConfig",
+    "DecodeEngine",
+    "PagedKVPool",
+    "PoolAccountingError",
+    "PoolExhaustedError",
     "serve_http",
     "metrics",
 ]

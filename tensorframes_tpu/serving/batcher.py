@@ -143,12 +143,24 @@ class _Request:
 class ContinuousBatcher:
     """The per-endpoint queue + worker. ``dispatch(feeds, rows)`` is the
     endpoint's coalesced entry (executor ``run_rows_bucketed`` under the
-    server's retry policy); results scatter back by request offset."""
+    server's retry policy); results scatter back by request offset.
+
+    **Pull mode** (``dispatch=None``): no worker thread — an external
+    consumer (the iterative decode engine) drains the queue itself with
+    :meth:`poll` and can push preempted work back with
+    :meth:`requeue_front`. In pull mode the queue IS the consumer's
+    slot-wait queue, and the dedicated expirer thread covers it exactly
+    as it covers push-mode flushes: a request waiting for a free decode
+    slot (or re-waiting after preemption) whose deadline lapses fails
+    with :class:`DeadlineExceededError` on the clock — a full KV pool
+    can never hold a request past its deadline (ISSUE 11 satellite)."""
 
     def __init__(
         self,
         name: str,
-        dispatch: Callable[[Dict[str, np.ndarray], int], Dict[str, np.ndarray]],
+        dispatch: Optional[
+            Callable[[Dict[str, np.ndarray], int], Dict[str, np.ndarray]]
+        ],
         max_batch_rows: int,
         max_latency_s: float,
         max_queue_rows: int,
@@ -185,34 +197,42 @@ class ContinuousBatcher:
 
     # -- lifecycle ----------------------------------------------------------
 
+    @property
+    def pull_mode(self) -> bool:
+        return self._dispatch is None
+
     def start(self) -> None:
         with self._cond:
             if self._open:
                 return
             self._open = True
             self._draining = False
-            self._worker = threading.Thread(
-                target=self._run, daemon=True,
-                name=f"tfs-serving-{self.name}",
-            )
-            self._worker.start()
+            if not self.pull_mode:
+                self._worker = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"tfs-serving-{self.name}",
+                )
+                self._worker.start()
             # deadlines are enforced by their own thread: the worker can
-            # be blocked inside a multi-second dispatch, and a queued
-            # request's expiry must be bounded by the clock, not by the
-            # flush in flight
+            # be blocked inside a multi-second dispatch (or, in pull
+            # mode, the consumer inside a multi-second decode step), and
+            # a queued request's expiry must be bounded by the clock,
+            # not by the flush in flight
             self._expirer = threading.Thread(
                 target=self._expire_run, daemon=True,
                 name=f"tfs-serving-{self.name}-deadlines",
             )
             self._expirer.start()
 
-    def stop(self, drain: bool = True,
-             timeout: Optional[float] = None) -> None:
-        """Close admission; with ``drain`` flush everything queued before
-        the worker exits, else fail queued requests with
-        :class:`ServingError`. Joins the worker (bounded by ``timeout``)."""
+    def close(self, drain: bool = True) -> None:
+        """Close admission WITHOUT joining the threads: with ``drain``
+        the queued requests stay for the worker/consumer to finish,
+        else they fail with :class:`ServingError` now. Pull-mode
+        consumers call this first, drain via :meth:`poll`, then
+        :meth:`stop` to join the expirer."""
         with self._cond:
-            if not self._open and self._worker is None:
+            if not self._open and not self._queue:
+                self._cond.notify_all()
                 return
             self._open = False
             if drain:
@@ -227,6 +247,20 @@ class ContinuousBatcher:
                         f"{self.name!r} abandoned"
                     ))
             self._cond.notify_all()
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Close admission; with ``drain`` flush everything queued before
+        the worker exits, else fail queued requests with
+        :class:`ServingError`. Joins the worker (bounded by ``timeout``).
+        In pull mode the consumer must have drained (or be draining) the
+        queue — the expirer exits once the queue is empty and closed."""
+        with self._cond:
+            if not self._open and self._worker is None \
+                    and self._expirer is None:
+                return
+        self.close(drain=drain)
+        with self._cond:
             worker = self._worker
             expirer = self._expirer
         if worker is not None:
@@ -310,6 +344,69 @@ class ContinuousBatcher:
         m.REQUESTS.inc()
         m.ROWS.inc(rows)
         return future
+
+    # -- pull-mode consumer API (the decode engine's slot-wait queue) -------
+
+    def poll(self, max_requests: int,
+             can_take: Optional[Callable[["_Request"], bool]] = None
+             ) -> List["_Request"]:
+        """Take up to ``max_requests`` FIFO requests (expired ones are
+        failed first, never returned). ``can_take`` gates the HEAD
+        request — the decode engine passes its has-pages-for-this-prompt
+        predicate, so admission stays FIFO (no starvation by smaller
+        later prompts). Returns ``[]`` when nothing is takeable."""
+        out: List[_Request] = []
+        with self._cond:
+            self._expire_locked(time.perf_counter())
+            while self._queue and len(out) < max_requests:
+                if can_take is not None and not can_take(self._queue[0]):
+                    break
+                req = self._queue.popleft()
+                self._queued_rows -= req.rows
+                m.QUEUE_DEPTH.dec(req.rows)
+                out.append(req)
+            if out:
+                # the expirer (and a draining stop()) recompute their
+                # wait the moment the queue shrinks
+                self._cond.notify_all()
+        return out
+
+    def requeue_front(self, req: "_Request") -> bool:
+        """Put an already-admitted request back at the HEAD of the queue
+        (preemption: the engine evicted its pages and it must re-wait
+        for a slot — oldest first, so it rejoins before newer arrivals).
+        Deliberately exempt from the ``max_queue_rows`` bound: the
+        request was admitted once; re-shedding it would turn preemption
+        into silent loss. Its original deadline keeps running (total
+        elapsed from submit — a full pool cannot hold it past that).
+        Returns False (failing the future) only when the batcher was
+        stopped without drain."""
+        with self._cond:
+            if not self._open and not self._draining:
+                req.future._fail(ServingError(
+                    f"server stopped without drain; preempted request "
+                    f"to {self.name!r} abandoned"
+                ))
+                return False
+            self._queue.appendleft(req)
+            self._queued_rows += req.rows
+            m.QUEUE_DEPTH.inc(req.rows)
+            self._cond.notify_all()
+        return True
+
+    def wait_for_work(self, timeout: Optional[float]) -> bool:
+        """Block until the queue is non-empty, admission closes, or
+        ``timeout`` elapses; True iff work is queued. The pull
+        consumer's idle wait (instead of a busy poll loop)."""
+        with self._cond:
+            if not self._queue and self._open:
+                self._cond.wait(timeout)
+            return bool(self._queue)
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
 
     # -- worker -------------------------------------------------------------
 

@@ -1,0 +1,690 @@
+"""Iterative decode engine: token-level continuous batching (ISSUE 11).
+
+The flush batcher (batcher.py) coalesces ROW-independent requests into
+one dispatch each — right for stateless scoring, wrong for
+autoregressive decode, where a request is a *sequence* of dependent
+steps against growing KV state. This module is the vLLM-style engine
+the ROADMAP's "heavy traffic" target needs: a persistent decode loop
+where per-request sequence slots **join and leave the running batch
+every step**, over a block-paged KV pool
+(:class:`~tensorframes_tpu.serving.kvpool.PagedKVPool`) shared by all
+sequences.
+
+Scheduling shape, per loop iteration:
+
+1. **join** — poll the admission queue (a pull-mode
+   :class:`~tensorframes_tpu.serving.batcher.ContinuousBatcher`: its
+   expirer thread covers requests waiting for a free slot, so a full
+   pool can never hold one past its deadline) while slots and prompt
+   pages are free; each join runs one **prefill** step (the prompt
+   chunk, padded to a ladder bucket) producing the first token.
+2. **decode** — one batched single-token step over every running slot,
+   padded to the slot-count bucket ladder. A slot that needs a new KV
+   page and finds the pool empty triggers **preemption**: the
+   youngest running sequence is evicted (pages freed, counted) and
+   requeued at the queue head with its generated tokens intact; on
+   rejoin it replays prefill + teacher-forced decode through the SAME
+   executables, so its continuation is bit-identical to never having
+   been preempted (asserted, not assumed). The oldest sequence is
+   never preempted and the pool floor guarantees its horizon fits —
+   forward progress is structural, not probabilistic.
+3. **leave** — finished sequences resolve their futures, free their
+   pages, and their slots are immediately joinable.
+
+Zero-steady-state-compile contract: both phases dispatch through
+``aot_jit`` at shapes drawn from ONE ladder —
+``compilecache.decode_warmup_grid`` (slot-count buckets for decode,
+prompt-length buckets for prefill, both delegating to
+``serving_row_buckets``) — and ``start()`` warms every point of that
+grid, so a warmed engine sustains any join/leave mix without touching
+XLA. Decode is greedy (argmax inside the step executable): determinism
+is what makes preemption-replay and the batched-vs-solo bit-identity
+gate (bench.py hard-gates it) meaningful.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from ..observability import flight as _flight
+from ..utils import get_logger
+from ..validation import ValidationError
+from . import metrics as m
+from .batcher import (
+    ContinuousBatcher,
+    DeadlineExceededError,
+    RejectedError,
+    ResultFuture,
+    ServingError,
+    _Request,
+)
+from .kvpool import PagedKVPool, PoolExhaustedError
+
+logger = get_logger(__name__)
+
+__all__ = ["DecodeConfig", "DecodeEngine"]
+
+
+@dataclasses.dataclass
+class DecodeConfig:
+    """Sizing knobs for one decode endpoint.
+
+    ``max_slots`` — running-batch width (slot counts pad through the
+    bucket ladder, so the top bucket is what compiles).
+    ``page_size`` — KV positions per pool page.
+    ``num_pages`` — total pool pages incl. the reserved null page;
+    ``None`` auto-sizes to hold every slot's full horizon (no
+    preemption under any admissible load). Size it smaller to trade
+    preemptions for HBM.
+    ``max_prompt_len`` / ``max_new_tokens`` — per-request bounds; their
+    sum is the decode horizon (must fit the model's ``max_seq_len``).
+    ``max_queue_requests`` — admission bound; past it submits shed with
+    ``RejectedError(reason="queue_full")``.
+    ``default_deadline_s`` — total-elapsed deadline applied when a
+    request carries none (``RetryPolicy.deadline_s`` semantics; expiry
+    covers queue AND slot wait — once running, a sequence completes).
+    ``warmup`` — precompile the slot × phase bucket grid at start.
+    """
+
+    max_slots: int = 8
+    page_size: int = 16
+    num_pages: Optional[int] = None
+    max_prompt_len: int = 32
+    max_new_tokens: int = 16
+    max_queue_requests: int = 1024
+    default_deadline_s: Optional[float] = None
+    warmup: bool = True
+
+
+class _Seq:
+    """One running sequence slot (engine-thread private)."""
+
+    __slots__ = ("req", "seq", "prompt", "want", "pos", "joined",
+                 "generated", "replay")
+
+    def __init__(self, req: _Request, seq: int, prompt: np.ndarray,
+                 want: int, joined: int):
+        self.req = req
+        self.seq = seq
+        self.prompt = prompt
+        self.want = want
+        self.pos = int(prompt.shape[0])  # next KV position to write
+        self.joined = joined             # monotonic join counter
+        self.generated: List[int] = []
+        self.replay: Optional[Deque[int]] = None
+
+
+class DecodeEngine:
+    """The persistent decode loop over one model + one paged KV pool.
+
+    Usually constructed through
+    :meth:`~tensorframes_tpu.serving.Server.register_decode`, which
+    routes ``Server.submit(name, {"prompt": ...})`` here and exposes it
+    over the HTTP sidecar. Standalone use::
+
+        eng = DecodeEngine("gen", gpt_tiny_cfg, params, DecodeConfig())
+        eng.start()
+        fut = eng.submit({"prompt": np.arange(7, dtype=np.int32)})
+        fut.result(60.0)["tokens"]     # [1, max_new_tokens] int32
+        eng.stop(drain=True)
+    """
+
+    def __init__(self, name: str, model_cfg, params,
+                 config: Optional[DecodeConfig] = None):
+        from ..compilecache import decode_warmup_grid
+        from ..models import generation as gen
+        from ..ops.executor import aot_jit
+
+        self.name = name
+        self.cfg = model_cfg
+        self.params = params
+        self.config = cfg = config or DecodeConfig()
+        if cfg.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if cfg.max_prompt_len < 1 or cfg.max_new_tokens < 1:
+            raise ValueError(
+                "max_prompt_len and max_new_tokens must be >= 1"
+            )
+        horizon = cfg.max_prompt_len + cfg.max_new_tokens
+        if horizon > model_cfg.max_seq_len:
+            raise ValueError(
+                f"decode horizon {horizon} (max_prompt_len + "
+                f"max_new_tokens) exceeds the model's max_seq_len="
+                f"{model_cfg.max_seq_len}"
+            )
+        max_pages = -(-horizon // cfg.page_size)
+        num_pages = cfg.num_pages
+        if num_pages is None:
+            # auto-size: every slot can hold a full horizon — the
+            # no-preemption configuration
+            num_pages = 1 + cfg.max_slots * max_pages
+        self._pool = PagedKVPool(
+            model_cfg, num_pages, cfg.page_size, max_pages
+        )
+        grid = decode_warmup_grid(cfg.max_slots, cfg.max_prompt_len)
+        self._slot_buckets = grid["decode"]
+        self._prefill_buckets = grid["prefill"]
+        self._prefill = aot_jit(
+            gen.paged_prefill_fn(model_cfg, cfg.page_size, max_pages),
+            label=f"decode.prefill[{name}]",
+        )
+        self._step = aot_jit(
+            gen.paged_decode_step_fn(model_cfg, cfg.page_size, max_pages),
+            label=f"decode.step[{name}]",
+        )
+        # admission: pull mode — no worker thread; the engine loop
+        # drains it, its expirer covers the slot-wait queue
+        self._admission = ContinuousBatcher(
+            name, None,
+            max_batch_rows=1,
+            max_latency_s=0.0,
+            max_queue_rows=cfg.max_queue_requests,
+        )
+        self._slots: List[Optional[_Seq]] = [None] * cfg.max_slots
+        self._resume: Dict[_Request, List[int]] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._starting = False
+        self._stopping = False
+        self._drain = True
+        self._next_seq = 0
+        self._join_counter = 0
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def pool(self) -> PagedKVPool:
+        return self._pool
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def counters(self) -> Dict[str, object]:
+        """Admission counters (shared batcher snapshot) + engine state."""
+        snap = self._admission.counters()
+        with self._lock:
+            snap["running_slots"] = sum(
+                1 for s in self._slots if s is not None
+            )
+        snap["free_pages"] = self._pool.num_free
+        return snap
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "DecodeEngine":
+        with self._lock:
+            if self._running or self._starting:
+                return self
+            if self._thread is not None and self._thread.is_alive():
+                # a previous stop(timeout=...) expired with the loop
+                # still draining: starting a SECOND loop over the same
+                # slots/pool would corrupt both — refuse until it exits
+                raise ServingError(
+                    f"decode engine {self.name!r} is still draining "
+                    "from a timed-out stop(); retry once it finishes"
+                )
+            self._starting = True
+        t0 = time.perf_counter()
+        try:
+            # _running commits only AFTER warmup + admission + the loop
+            # thread all succeed: a failed warm must leave the engine
+            # cleanly restartable, not a zombie that reports running
+            # while every submit sheds as 'closed'
+            self._pool.reopen()  # no-op unless restarting after stop()
+            if self.config.warmup:
+                self._warm()
+            self._admission.start()
+            thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"tfs-decode-{self.name}",
+            )
+            with self._lock:
+                self._thread = thread
+                self._stopping = False
+                self._running = True
+            thread.start()
+        finally:
+            with self._lock:
+                self._starting = False
+        _flight.record(
+            "serving.decode.start", endpoint=self.name,
+            slots=self.config.max_slots,
+            pages=self._pool.num_pages,
+            page_size=self.config.page_size,
+            warmup_s=round(time.perf_counter() - t0, 6),
+        )
+        return self
+
+    def _warm(self) -> None:
+        """Execute every point of the slot × phase bucket grid once
+        against null tables (writes land in the null page, results are
+        discarded — the pool state object is never reassigned). Unlike
+        ``warm_program`` this executes, not just compiles: the grid is
+        tiny, and the run also faults in the gather/scatter kernels."""
+        t0 = time.perf_counter()
+        cols = self._pool.columns
+        null = self._pool.null_table()
+        for tb in self._prefill_buckets:
+            self._prefill(
+                self.params, cols, np.zeros(tb, np.int32),
+                np.int32(1), null,
+            )
+        for sb in self._slot_buckets:
+            self._step(
+                self.params, cols, np.zeros(sb, np.int32),
+                np.zeros(sb, np.int32),
+                np.zeros((sb, self._pool.max_pages_per_seq), np.int32),
+            )
+        logger.info(
+            "decode warmup[%s]: prefill buckets %s + decode buckets %s "
+            "in %.2fs", self.name, self._prefill_buckets,
+            self._slot_buckets, time.perf_counter() - t0,
+        )
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Close admission; ``drain=True`` completes every admitted AND
+        queued sequence first, ``drain=False`` fails queued and running
+        requests with :class:`ServingError`. Bounded by ``timeout``."""
+        with self._lock:
+            if not self._running and self._thread is None:
+                # never started (or already stopped): still withdraw
+                # the pool from the process-wide free-pages gauge — a
+                # registered-but-never-started engine's pages must not
+                # inflate other engines' headroom signal forever
+                self._pool.close()
+                return
+            self._stopping = True
+            self._drain = drain
+            thread = self._thread
+        self._admission.close(drain=drain)
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                logger.warning(
+                    "decode engine %r still draining after stop "
+                    "timeout", self.name,
+                )
+        self._admission.stop(drain=drain, timeout=timeout)
+        with self._lock:
+            self._running = False
+            # keep the ref while the loop is still draining past the
+            # timeout — start() checks it to refuse a second loop
+            if self._thread is thread and not (
+                thread is not None and thread.is_alive()
+            ):
+                self._thread = None
+        self._pool.close()  # withdraw from the free-pages gauge
+        _flight.record(
+            "serving.decode.stop", endpoint=self.name, drain=drain,
+        )
+
+    # -- request path -------------------------------------------------------
+
+    def validate_feeds(self, feeds) -> Dict[str, object]:
+        """Normalize one decode request: ``{"prompt": 1-D int tokens
+        (or [1, plen]), "max_new_tokens": optional int}``. Length bounds
+        reject as ``too_large`` (the pool could never hold the
+        horizon), malformed feeds as :class:`ValidationError`."""
+        if not isinstance(feeds, dict) or "prompt" not in feeds:
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: feeds must be a dict "
+                "with a 'prompt' key (int token ids)"
+            )
+        extra = set(feeds) - {"prompt", "max_new_tokens"}
+        if extra:
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: unexpected feed(s) "
+                f"{sorted(extra)}; accepted: prompt, max_new_tokens"
+            )
+        try:
+            prompt = np.asarray(feeds["prompt"], dtype=np.int32)
+        except (TypeError, ValueError) as e:
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: prompt does not "
+                f"convert to int32 tokens: {e}"
+            ) from None
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: prompt must be a "
+                f"non-empty 1-D token vector (or [1, plen]), got shape "
+                f"{prompt.shape}"
+            )
+        vocab = int(self.cfg.vocab_size)
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: prompt tokens must be "
+                f"in [0, {vocab})"
+            )
+        new = feeds.get("max_new_tokens", self.config.max_new_tokens)
+        try:
+            new = int(new)
+        except (TypeError, ValueError):
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: max_new_tokens must "
+                f"be an int, got {feeds['max_new_tokens']!r}"
+            ) from None
+        if new < 1 or new > self.config.max_new_tokens:
+            raise ValidationError(
+                f"decode endpoint {self.name!r}: max_new_tokens={new} "
+                f"outside [1, {self.config.max_new_tokens}]"
+            )
+        plen = int(prompt.shape[0])
+        if plen > self.config.max_prompt_len:
+            m.rejected("too_large").inc()
+            raise RejectedError(
+                f"decode endpoint {self.name!r}: prompt of {plen} "
+                f"tokens exceeds max_prompt_len="
+                f"{self.config.max_prompt_len} — split or raise the "
+                "engine's DecodeConfig",
+                reason="too_large",
+            )
+        return {"prompt": prompt, "new": new}
+
+    def submit(self, feeds,
+               deadline_s: Optional[float] = None) -> ResultFuture:
+        """Admit one decode request; the future resolves to
+        ``{"tokens": int32 [1, max_new_tokens]}`` when its LAST token is
+        generated (streaming-final semantics). Raises
+        :class:`RejectedError` on shed/closed/oversize, the deadline
+        covers queue + slot wait."""
+        norm = self.validate_feeds(feeds)
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 (got {deadline_s}) — the same "
+                "contract as RetryPolicy.deadline_s"
+            )
+        return self._admission.offer(norm, 1, deadline_s)
+
+    def call(self, feeds, deadline_s: Optional[float] = None,
+             timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        return self.submit(feeds, deadline_s).result(timeout)
+
+    # -- the engine loop ----------------------------------------------------
+
+    def _loop(self) -> None:
+        try:
+            self._loop_body()
+        except BaseException as e:  # pragma: no cover - crash guard
+            logger.exception("decode engine %r loop died", self.name)
+            _flight.record(
+                "serving.decode.error", endpoint=self.name,
+                error=type(e).__name__, message=str(e),
+            )
+            self._fail_all(ServingError(
+                f"decode engine {self.name!r} failed: "
+                f"{type(e).__name__}: {e}"
+            ))
+
+    def _loop_body(self) -> None:
+        cfg = self.config
+        while True:
+            with self._lock:
+                stopping, drain = self._stopping, self._drain
+            if stopping and not drain:
+                self._fail_all(ServingError(
+                    f"decode engine {self.name!r} stopped without "
+                    "drain; running sequences abandoned"
+                ))
+                return
+            self._purge_resume()
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if free:
+                for req in self._admission.poll(
+                    len(free), can_take=self._admit_budget()
+                ):
+                    self._join(req)
+            if any(s is not None for s in self._slots):
+                self._decode_step()
+                continue
+            # idle: nothing running
+            if stopping and self._admission.queued_rows == 0:
+                return
+            if self._admission.queued_rows > 0:
+                # queued but unadmittable (pool pages held elsewhere):
+                # a bounded nap, not a hot spin — wait_for_work returns
+                # immediately on a non-empty queue, and the expirer
+                # thread (not this loop) owns deadline expiry
+                time.sleep(0.005)
+            else:
+                self._admission.wait_for_work(0.02)
+
+    def _admit_budget(self):
+        """A fresh admission predicate for ONE poll: each accepted
+        request claims its prompt pages from the snapshot budget, so a
+        multi-request poll can never overcommit the pool (the joins run
+        after the poll returns)."""
+        budget = [self._pool.num_free]
+
+        def can_take(req: _Request) -> bool:
+            need = self._pool.pages_needed(
+                int(req.feeds["prompt"].shape[0])
+            )
+            if need > budget[0]:
+                return False
+            budget[0] -= need
+            return True
+
+        return can_take
+
+    def _purge_resume(self) -> None:
+        # a preempted request can expire (or be abandoned) while
+        # requeued — its future resolves in the expirer; drop its
+        # replay state so the dict cannot grow unboundedly
+        if self._resume:
+            dead = [r for r in self._resume if r.future.done()]
+            for r in dead:
+                del self._resume[r]
+
+    def _prefill_bucket(self, plen: int) -> int:
+        for b in self._prefill_buckets:
+            if b >= plen:
+                return b
+        raise AssertionError(  # pragma: no cover - validated at submit
+            f"prompt of {plen} tokens above the warmed prefill ladder "
+            f"{self._prefill_buckets}"
+        )
+
+    def _join(self, req: _Request) -> None:
+        now = time.perf_counter()
+        if req.deadline is not None and req.deadline <= now:
+            # lost the race with the expirer between poll and here
+            m.DEADLINE_EXPIRED.inc()
+            req.future._fail(DeadlineExceededError(
+                f"request to {self.name!r} expired after "
+                f"{now - req.t_submit:.4f}s waiting for a decode slot"
+            ))
+            self._resume.pop(req, None)
+            return
+        prompt = req.feeds["prompt"]
+        plen = int(prompt.shape[0])
+        seq = self._next_seq
+        self._next_seq += 1
+        self._pool.alloc(seq, self._pool.pages_needed(plen))
+        tb = self._prefill_bucket(plen)
+        padded = np.zeros(tb, np.int32)
+        padded[:plen] = prompt
+        cols, first = self._prefill(
+            self.params, self._pool.columns, padded, np.int32(plen),
+            self._pool.table(seq),
+        )
+        self._pool.columns = cols
+        m.DECODE_STEPS["prefill"].inc()
+        self._join_counter += 1
+        s = _Seq(req, seq, prompt, int(req.feeds["new"]),
+                 self._join_counter)
+        replay = self._resume.pop(req, None)
+        tok = int(first)
+        if replay:
+            s.replay = collections.deque(replay)
+            expect = s.replay.popleft()
+            if tok != expect:
+                self._bit_identity_violation(s, tok, expect)
+                return
+            if not s.replay:
+                s.replay = None
+        else:
+            m.DECODE_TTFT.observe(time.perf_counter() - req.t_submit)
+            m.DECODE_TOKENS.inc()
+        s.generated.append(tok)
+        idx = self._slots.index(None)
+        self._slots[idx] = s
+        # delta, not set(): several engines share the process-wide
+        # occupancy gauge (the free-pages twin lives in PagedKVPool)
+        m.DECODE_SLOTS.inc()
+        _flight.record(
+            "serving.decode.join", endpoint=self.name, seq=seq,
+            prompt_len=plen, new_tokens=s.want,
+            resumed=bool(replay),
+            waited_s=round(now - req.t_submit, 6),
+        )
+        if len(s.generated) >= s.want:
+            self._finish(s)
+
+    def _active(self) -> List[_Seq]:
+        return [s for s in self._slots if s is not None]
+
+    def _decode_step(self) -> None:
+        # page faults first, oldest slot first: a slot whose next write
+        # position crosses into an unallocated page must get one, by
+        # preemption if the pool is dry. The victim is always the
+        # YOUNGEST running sequence (possibly the faulting slot itself)
+        # — the oldest is never evicted, and the pool floor (one full
+        # horizon) guarantees it can always finish: forward progress is
+        # structural, preemption cannot livelock.
+        for s in sorted(self._active(), key=lambda x: x.joined):
+            if s not in self._slots:
+                continue  # preempted by an earlier fault in this pass
+            need = s.pos // self._pool.page_size
+            if need < len(self._pool.owned(s.seq)):
+                continue
+            preempted_self = False
+            while self._pool.num_free < 1:
+                victim = max(self._active(), key=lambda x: x.joined)
+                self._preempt(victim)
+                if victim is s:
+                    preempted_self = True
+                    break
+            if preempted_self:
+                continue
+            try:
+                self._pool.alloc(s.seq, 1)
+            except PoolExhaustedError:  # pragma: no cover - guarded above
+                self._preempt(s)
+        active = self._active()
+        if not active:
+            return
+        n = len(active)
+        sb = next(b for b in self._slot_buckets if b >= n)
+        maxp = self._pool.max_pages_per_seq
+        tokens = np.zeros(sb, np.int32)
+        pos = np.zeros(sb, np.int32)
+        tables = np.zeros((sb, maxp), np.int32)
+        for row, s in enumerate(active):
+            tokens[row] = s.generated[-1]
+            pos[row] = s.pos
+            tables[row] = self._pool.table(s.seq)
+        cols, nxt = self._step(
+            self.params, self._pool.columns, tokens, pos, tables
+        )
+        self._pool.columns = cols
+        nxt = np.asarray(nxt)
+        m.DECODE_STEPS["decode"].inc()
+        for row, s in enumerate(active):
+            s.pos += 1
+            tok = int(nxt[row])
+            if s.replay:
+                expect = s.replay.popleft()
+                if tok != expect:
+                    self._bit_identity_violation(s, tok, expect)
+                    continue
+                if not s.replay:
+                    s.replay = None
+                tok = expect
+            else:
+                m.DECODE_TOKENS.inc()
+            s.generated.append(tok)
+            if len(s.generated) >= s.want:
+                self._finish(s)
+
+    def _slot_of(self, s: _Seq) -> int:
+        return self._slots.index(s)
+
+    def _preempt(self, s: _Seq) -> None:
+        self._slots[self._slot_of(s)] = None
+        m.DECODE_SLOTS.dec()
+        freed = self._pool.free_seq(s.seq)
+        m.DECODE_PREEMPTIONS.inc()
+        m.DECODE_EVICTIONS.inc(freed)
+        _flight.record(
+            "serving.decode.preempt", endpoint=self.name, seq=s.seq,
+            tokens_done=len(s.generated), pages_evicted=freed,
+        )
+        # requeue at the HEAD with the generated prefix intact: on
+        # rejoin, prefill + teacher-forced replay through the same
+        # executables reproduce the pool state bit-identically. A
+        # sequence preempted MID-replay keeps its unreplayed suffix
+        # too — dropping it would re-count those tokens as fresh and
+        # silently skip their bit-identity check
+        self._resume[s.req] = list(s.generated) + list(s.replay or ())
+        if not self._admission.requeue_front(s.req):
+            self._resume.pop(s.req, None)
+
+    def _finish(self, s: _Seq) -> None:
+        self._slots[self._slot_of(s)] = None
+        m.DECODE_SLOTS.dec()
+        self._pool.free_seq(s.seq)
+        out = np.asarray(s.generated[:s.want], np.int32)[None, :]
+        done = time.perf_counter()
+        m.REQUEST_LATENCY.observe(done - s.req.t_submit)
+        s.req.future._set({"tokens": out})
+        _flight.record(
+            "serving.decode.finish", endpoint=self.name, seq=s.seq,
+            tokens=int(out.shape[1]),
+            seconds=round(done - s.req.t_submit, 6),
+        )
+
+    def _bit_identity_violation(self, s: _Seq, got: int,
+                                expect: int) -> None:
+        """A resumed sequence diverged from its recorded prefix — a
+        determinism bug, never load. Fail THIS request loudly (the
+        engine keeps serving); silently continuing would hand the
+        client a sequence that contradicts the preemption contract.
+        Callable both mid-join (slot not yet assigned) and mid-step."""
+        if s in self._slots:
+            self._slots[self._slot_of(s)] = None
+            m.DECODE_SLOTS.dec()
+        self._pool.free_seq(s.seq)
+        m.DISPATCH_ERRORS.inc()
+        _flight.record(
+            "serving.decode.replay_divergence", endpoint=self.name,
+            seq=s.seq, got=got, expected=expect,
+            at_token=len(s.generated),
+        )
+        s.req.future._fail(ServingError(
+            f"decode engine {self.name!r}: resumed sequence diverged "
+            f"from its pre-preemption prefix (got token {got}, "
+            f"recorded {expect} at index {len(s.generated)}) — "
+            "determinism bug, please report"
+        ))
+
+    def _fail_all(self, exc: BaseException) -> None:
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._slots[i] = None
+                m.DECODE_SLOTS.dec()
+                self._pool.free_seq(s.seq)
+                s.req.future._fail(exc)
+        self._admission.close(drain=False)

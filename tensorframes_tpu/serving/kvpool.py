@@ -1,0 +1,241 @@
+"""Block-paged KV cache pool: fixed-size pages, per-sequence page tables.
+
+The memory manager half of the iterative decode engine (ISSUE 11,
+vLLM-style). Device state is the columnar pool from
+``models.generation.init_paged_kv`` — int8 k/v plus f32 per-slot scales,
+page-major ``[num_pages, layers, heads, page_size, head_dim]`` — so the
+pool IS a set of frame columns with pages as rows (:meth:`as_frame`
+materializes the TensorFrame view; ROADMAP #3's data plane can later
+back these columns with its block store). This class owns the HOST side:
+the free list, per-sequence page ownership, and the page tables the
+step functions gather through.
+
+Accounting contract (property-swept in tests/test_decode.py): every
+page except the reserved null page 0 is at all times EITHER free OR
+owned by exactly one sequence — ``alloc`` can never hand out an owned
+page, ``free_seq`` can never double-free, and :meth:`check` asserts the
+partition after any interleaving of join/extend/evict. Page 0 belongs
+to nobody: padding slots and masked prefill positions write their
+garbage there, and the attention masks guarantee it is never read
+unmasked.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PagedKVPool", "PoolAccountingError", "PoolExhaustedError"]
+
+
+class PoolAccountingError(RuntimeError):
+    """A page alloc/free invariant was violated (double free, freeing a
+    page the sequence does not own, or a corrupted free list) — always
+    a bug in the caller or the pool, never load-dependent."""
+
+
+class PoolExhaustedError(RuntimeError):
+    """``alloc`` asked for more pages than are free. The decode engine
+    turns this into preemption (evict a victim, retry), never an
+    unbounded wait."""
+
+
+class PagedKVPool:
+    """Fixed-size KV pages + per-sequence page tables over the columnar
+    pool state. ``columns`` holds the device arrays (reassigned by the
+    engine after every functional step); everything else is host-side
+    bookkeeping under the engine's scheduling thread (single-threaded
+    by design — the pool is not itself locked)."""
+
+    def __init__(self, cfg, num_pages: int, page_size: int,
+                 max_pages_per_seq: int):
+        from ..models.generation import init_paged_kv
+
+        if max_pages_per_seq < 1:
+            raise ValueError(
+                f"max_pages_per_seq must be >= 1, got {max_pages_per_seq}"
+            )
+        if num_pages < 1 + max_pages_per_seq:
+            # the null page plus one full sequence horizon is the floor:
+            # below it the OLDEST running sequence could page-fault with
+            # nothing left to evict — the livelock the forward-progress
+            # guarantee exists to rule out
+            raise ValueError(
+                f"num_pages={num_pages} cannot hold the null page plus "
+                f"one full sequence ({max_pages_per_seq} pages) — an "
+                "undersized pool could stall its own oldest sequence; "
+                "raise num_pages or lower the decode horizon"
+            )
+        self.cfg = cfg
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.columns: Dict[str, object] = init_paged_kv(
+            cfg, self.num_pages, self.page_size
+        )
+        self._free: collections.deque = collections.deque(
+            range(1, self.num_pages)
+        )
+        self._owned: Dict[int, List[int]] = {}
+        self._closed = False
+        # the free-pages gauge aggregates by DELTA across live pools
+        # (several decode endpoints share one process-wide series; a
+        # set() here would clobber the siblings)
+        from . import metrics as m
+
+        m.DECODE_FREE_PAGES.inc(len(self._free))
+
+    # -- capacity -----------------------------------------------------------
+
+    @property
+    def usable_pages(self) -> int:
+        """Allocatable pages (everything but the null page)."""
+        return self.num_pages - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_positions: int) -> int:
+        """Pages covering ``n_positions`` KV slots."""
+        return -(-int(n_positions) // self.page_size)
+
+    # -- alloc / free -------------------------------------------------------
+
+    def alloc(self, seq: int, n: int) -> List[int]:
+        """Give ``n`` pages to sequence ``seq`` (appended to its table).
+        Raises :class:`PoolExhaustedError` when fewer than ``n`` are
+        free (nothing is partially allocated)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"alloc of {n} pages")
+        held = self._owned.setdefault(int(seq), [])
+        if len(held) + n > self.max_pages_per_seq:
+            raise PoolAccountingError(
+                f"sequence {seq} would hold {len(held) + n} pages, "
+                f"over max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        if n > len(self._free):
+            raise PoolExhaustedError(
+                f"need {n} pages, {len(self._free)} free "
+                f"(of {self.usable_pages} usable)"
+            )
+        got = [self._free.popleft() for _ in range(n)]
+        held.extend(got)
+        if not self._closed:
+            from . import metrics as m
+
+            m.DECODE_FREE_PAGES.dec(n)
+        return got
+
+    def free_seq(self, seq: int) -> int:
+        """Return every page owned by ``seq`` to the free list; returns
+        the count (0 for a sequence holding nothing). Double frees and
+        corrupted ownership raise :class:`PoolAccountingError`."""
+        pages = self._owned.pop(int(seq), None)
+        if pages is None:
+            return 0
+        free_set = set(self._free)
+        for p in pages:
+            if p in free_set or p == 0:
+                self._owned[int(seq)] = pages  # restore for postmortem
+                raise PoolAccountingError(
+                    f"double free: page {p} of sequence {seq} is "
+                    "already free (or the null page)"
+                )
+        self._free.extend(pages)
+        if not self._closed:
+            from . import metrics as m
+
+            m.DECODE_FREE_PAGES.inc(len(pages))
+        return len(pages)
+
+    def owned(self, seq: int) -> List[int]:
+        return list(self._owned.get(int(seq), ()))
+
+    def table(self, seq: int) -> np.ndarray:
+        """The sequence's page table as the step functions expect it:
+        int32 ``[max_pages_per_seq]``, unused tail entries = null page 0."""
+        t = np.zeros(self.max_pages_per_seq, np.int32)
+        pages = self._owned.get(int(seq), ())
+        t[:len(pages)] = pages
+        return t
+
+    def null_table(self) -> np.ndarray:
+        """An all-null page table — what padding slots carry."""
+        return np.zeros(self.max_pages_per_seq, np.int32)
+
+    def close(self) -> None:
+        """Withdraw this pool's contribution from the process-wide
+        free-pages gauge (the engine calls it at stop). Accounting and
+        ``check()`` keep working; only the gauge stops tracking."""
+        if not self._closed:
+            self._closed = True
+            from . import metrics as m
+
+            m.DECODE_FREE_PAGES.dec(len(self._free))
+
+    def reopen(self) -> None:
+        """Re-enroll in the free-pages gauge (engine restart)."""
+        if self._closed:
+            self._closed = False
+            from . import metrics as m
+
+            m.DECODE_FREE_PAGES.inc(len(self._free))
+
+    # -- invariants ---------------------------------------------------------
+
+    def check(self) -> None:
+        """Assert the accounting partition: free ∪ owned = pages 1..P-1,
+        with no page in two places. Cheap; the property sweep calls it
+        after every mutation."""
+        free = list(self._free)
+        free_set = set(free)
+        if len(free) != len(free_set):
+            raise PoolAccountingError("free list holds a duplicate page")
+        owned_all: List[int] = []
+        for seq, pages in self._owned.items():
+            if len(pages) > self.max_pages_per_seq:
+                raise PoolAccountingError(
+                    f"sequence {seq} holds {len(pages)} pages > "
+                    f"max_pages_per_seq={self.max_pages_per_seq}"
+                )
+            owned_all.extend(pages)
+        owned_set = set(owned_all)
+        if len(owned_all) != len(owned_set):
+            raise PoolAccountingError(
+                "a page is owned by two sequences (or twice by one)"
+            )
+        if free_set & owned_set:
+            raise PoolAccountingError(
+                f"pages both free and owned: {sorted(free_set & owned_set)}"
+            )
+        want = set(range(1, self.num_pages))
+        have = free_set | owned_set
+        if have != want:
+            raise PoolAccountingError(
+                f"leaked pages: {sorted(want - have)}; "
+                f"phantom pages: {sorted(have - want)}"
+            )
+
+    # -- frame view ---------------------------------------------------------
+
+    def as_frame(self):
+        """The pool as a TensorFrame (one row per page, one column per
+        pool array) — a materialized snapshot view for the data plane /
+        debugging, not a live alias."""
+        from ..frame import frame_from_arrays
+
+        return frame_from_arrays(
+            {k: np.asarray(v) for k, v in self.columns.items()},
+            num_blocks=1,
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - convenience
+        return (
+            f"PagedKVPool(pages={self.num_pages}, "
+            f"page_size={self.page_size}, free={self.num_free}, "
+            f"seqs={len(self._owned)})"
+        )

@@ -28,6 +28,9 @@ __all__ = [
     "FLUSHES", "FLUSH_REASONS", "BATCH_ROWS", "PADDING_ROWS",
     "REQUEST_LATENCY", "QUEUE_WAIT", "DISPATCH_SECONDS",
     "DEADLINE_EXPIRED", "DISPATCH_ERRORS", "rejected",
+    "DECODE_PHASES", "DECODE_TOKENS", "DECODE_STEPS", "DECODE_TTFT",
+    "DECODE_SLOTS", "DECODE_FREE_PAGES", "DECODE_PREEMPTIONS",
+    "DECODE_EVICTIONS",
 ]
 
 #: Why an admission was refused (closed set — every series pre-registered).
@@ -105,6 +108,60 @@ DISPATCH_ERRORS = _counter(
     "tftpu_serving_dispatch_errors_total",
     "Coalesced flushes whose dispatch raised (every member request "
     "fails with the same error)",
+)
+
+
+# -- iterative decode (tftpu_decode_*, ISSUE 11) ----------------------------
+# The decode engine's health is a rate (tokens/sec = the tokens counter
+# differentiated), a latency (TTFT — the open-loop bench gates its
+# p50/p99), and three occupancy signals (running slots, free KV pages,
+# and how often the pool had to preempt). Request-level latency and
+# queue depth ride the shared serving instruments above — a decode
+# request IS a serving request.
+
+#: Engine phases (closed set — one executable family per phase).
+DECODE_PHASES: Tuple[str, ...] = ("prefill", "decode")
+
+DECODE_TOKENS = _counter(
+    "tftpu_decode_tokens_total",
+    "Newly generated tokens across all decode endpoints (replayed "
+    "tokens of a preempted sequence's resume are NOT counted — they "
+    "are recompute, not progress); rate = decode tokens/sec",
+)
+DECODE_STEPS: Dict[str, Counter] = {
+    p: _counter(
+        "tftpu_decode_steps_total",
+        "Engine step dispatches by phase (prefill = one sequence's "
+        "prompt chunk, decode = one batched token step over the "
+        "running slots)",
+        labels={"phase": p},
+    )
+    for p in DECODE_PHASES
+}
+DECODE_TTFT = _histogram(
+    "tftpu_decode_ttft_seconds",
+    "Time to first token: submit to the prompt's prefill completing "
+    "(the open-loop decode bench gates p50/p99 of this)",
+    buckets=LATENCY_BUCKETS,
+)
+DECODE_SLOTS = _gauge(
+    "tftpu_decode_slot_occupancy",
+    "Sequence slots currently running in the iterative decode batch",
+)
+DECODE_FREE_PAGES = _gauge(
+    "tftpu_decode_free_pages",
+    "Free pages across decode KV pools (the headroom preemption "
+    "defends)",
+)
+DECODE_PREEMPTIONS = _counter(
+    "tftpu_decode_preemptions_total",
+    "Running sequences preempted because the KV pool had no free page "
+    "(evicted, requeued at the head, resumed bit-identically later)",
+)
+DECODE_EVICTIONS = _counter(
+    "tftpu_decode_evictions_total",
+    "KV pages evicted by preemption (freed from a preempted "
+    "sequence's table)",
 )
 
 

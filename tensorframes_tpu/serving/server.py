@@ -207,6 +207,7 @@ class Server:
         self._retry = retry
         self._endpoints: Dict[str, Endpoint] = {}
         self._batchers: Dict[str, ContinuousBatcher] = {}
+        self._decode: Dict[str, object] = {}  # name -> DecodeEngine
         self._lock = threading.Lock()
         self._running = False
         self._starting = False
@@ -254,7 +255,7 @@ class Server:
                 )
         ep = Endpoint(name, program, self.config.donate, self._retry)
         with self._lock:
-            if name in self._endpoints:
+            if name in self._endpoints or name in self._decode:
                 raise ValueError(f"endpoint {name!r} already registered")
             self._endpoints[name] = ep
             batcher = ContinuousBatcher(
@@ -297,9 +298,56 @@ class Server:
                     batcher.start()
         return ep
 
+    def register_decode(self, name: str, model_cfg, params,
+                        decode_config=None):
+        """Register an iterative decode endpoint (ISSUE 11): a
+        :class:`~tensorframes_tpu.serving.DecodeEngine` over
+        ``model_cfg``/``params`` with a paged int8 KV pool.
+        ``submit(name, {"prompt": tokens})`` resolves to
+        ``{"tokens": [1, max_new_tokens]}`` when the LAST token lands
+        (streaming-final semantics — the HTTP sidecar replies once, at
+        sequence completion); the rejection/deadline taxonomy matches
+        flush endpoints (429 shed, 504 on slot-wait expiry). The engine
+        has its own admission queue and scheduler — it shares the
+        server's lifecycle, default deadline, and ``stats()`` surface,
+        not the flush batcher's coalescing."""
+        from .decode import DecodeConfig, DecodeEngine
+
+        if not name or "/" in name:
+            raise ValueError(
+                f"endpoint name must be non-empty and '/'-free, "
+                f"got {name!r}"
+            )
+        cfg = decode_config or DecodeConfig()
+        if cfg.default_deadline_s is None:
+            cfg = dataclasses.replace(
+                cfg, default_deadline_s=self.config.default_deadline_s
+            )
+        cfg = dataclasses.replace(
+            cfg, warmup=cfg.warmup and self.config.warmup
+        )
+        engine = DecodeEngine(name, model_cfg, params, cfg)
+        with self._lock:
+            if name in self._endpoints or name in self._decode:
+                raise ValueError(f"endpoint {name!r} already registered")
+            self._decode[name] = engine
+            live = self._running or self._starting
+        if live:
+            # late registration on a live server: warm + spin the
+            # engine outside the lock; a failed start must not leave a
+            # zombie name behind (same rollback contract as register())
+            try:
+                engine.start()
+            except BaseException:
+                with self._lock:
+                    self._decode.pop(name, None)
+                engine.stop(drain=False)
+                raise
+        return engine
+
     def endpoints(self) -> List[str]:
         with self._lock:
-            return sorted(self._endpoints)
+            return sorted(set(self._endpoints) | set(self._decode))
 
     def _warm(self, ep: Endpoint):
         """Precompile (or disk-load) the endpoint's bucket ladder so the
@@ -324,11 +372,17 @@ class Server:
                 return self
             self._starting = True
             eps = list(self._endpoints.values())
+            engines = list(self._decode.values())
         t0 = time.perf_counter()
         try:
             if self.config.warmup:
                 for ep in eps:
                     self.warmup_reports[ep.name] = self._warm(ep)
+            # decode engines warm their slot × phase bucket grid inside
+            # their own start() — still in the warm phase, so the
+            # running flag only flips once every endpoint is hot
+            for eng in engines:
+                eng.start()
         finally:
             with self._lock:
                 self._starting = False
@@ -343,6 +397,8 @@ class Server:
                     endpoints=sorted(self._endpoints),
                     warmup_s=round(time.perf_counter() - t0, 6),
                 )
+                for eng in engines:
+                    eng.stop(drain=True)
                 return self
             # batchers open BEFORE the running flag flips: healthz must
             # never say running=true while submits would shed as
@@ -373,10 +429,12 @@ class Server:
                 # so start() leaves admission closed instead of opening
                 # the batchers after this stop() has returned
                 self._stop_requested = True
-            if not self._running and not self._batchers:
+            if not self._running and not self._batchers \
+                    and not self._decode:
                 return
             self._running = False
             batchers = list(self._batchers.values())
+            engines = list(self._decode.values())
         pending = sum(b.queued_rows for b in batchers)
         _flight.record(
             "serving.drain" if drain else "serving.stop",
@@ -384,6 +442,8 @@ class Server:
         )
         for b in batchers:
             b.stop(drain=drain, timeout=timeout)
+        for eng in engines:
+            eng.stop(drain=drain, timeout=timeout)
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -403,6 +463,12 @@ class Server:
         to this request's rows of every program output. Raises
         :class:`RejectedError` on backpressure/closed/oversize (never
         blocks admission), :class:`ValidationError` on malformed feeds."""
+        eng = self._decode.get(endpoint)
+        if eng is not None:
+            # iterative decode rides the engine's own admission queue
+            # (its expirer covers slot waits); the engine inherited the
+            # server default deadline at register time
+            return eng.submit(feeds, deadline_s=deadline_s)
         try:
             ep = self._endpoints[endpoint]
         except KeyError:
@@ -437,25 +503,40 @@ class Server:
         traffic as its own."""
         with self._lock:
             batchers = dict(self._batchers)
+            engines = dict(self._decode)
             running = self._running
         queues: Dict[str, int] = {}
+        decode: Dict[str, Dict[str, int]] = {}
         totals = {
             "admitted_requests": 0,
             "admitted_rows": 0,
             "rejected": {r: 0 for r in m.REJECT_REASONS},
             "deadline_expired": 0,
         }
-        for name, b in batchers.items():
-            snap = b.counters()
+
+        def _tally(name, snap):
             queues[name] = snap["queued_rows"]
             totals["admitted_requests"] += snap["admitted_requests"]
             totals["admitted_rows"] += snap["admitted_rows"]
             for r, c in snap["rejected"].items():
                 totals["rejected"][r] += c
             totals["deadline_expired"] += snap["deadline_expired"]
-        return {
+
+        for name, b in batchers.items():
+            _tally(name, b.counters())
+        for name, eng in engines.items():
+            snap = eng.counters()
+            _tally(name, snap)
+            decode[name] = {
+                "running_slots": snap["running_slots"],
+                "free_pages": snap["free_pages"],
+            }
+        out = {
             "running": running,
             "endpoints": sorted(queues),
             "queued_rows": queues,
             **totals,
         }
+        if decode:
+            out["decode"] = decode
+        return out
