@@ -943,6 +943,54 @@ def _bench_aggregate_strings(n_rows: int = 1_000_000, n_groups: int = 512):
     return warm_s
 
 
+def _bench_segment_reduce(n_rows: int = 1_000_000, n_groups: int = 512,
+                          gate_rows: int = 20_000):
+    """Keyed segment reduce at 1M rows / 512 groups through the
+    strategy dispatch (``_segment_reduce_best`` — host bincount, the
+    pallas kernel, or the jitted scatter, whatever the cost model
+    picks for this backend), median wall s/call. FIRST the ISSUE 12
+    hard gate runs: the pallas kernel at a modest size must be
+    bit-identical to its reference emulation, and to the XLA scatter
+    on the exact op classes — a wrong kernel fails the bench run, not
+    just a unit test."""
+    import jax
+    import jax.numpy as jnp
+    from tensorframes_tpu.kernels import segment_reduce as ksr
+    from tensorframes_tpu.ops.verbs import _segment_reduce_best
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, n_groups, gate_rows).astype(np.int32)
+    cols = {
+        "s": rng.standard_normal(gate_rows).astype(np.float32),
+        "m": rng.integers(-100, 100, gate_rows).astype(np.int32),
+    }
+    ops = (("s", "reduce_sum"), ("m", "reduce_max"))
+    got = ksr.segment_reduce_pallas(ops, n_groups, cols, ids)
+    ref = ksr.segment_reduce_reference(ops, n_groups, cols, ids)
+    for k in got:
+        assert np.array_equal(got[k], ref[k], equal_nan=True), (
+            f"segment-reduce kernel != reference emulation on {k!r} "
+            "(bit-identity hard gate)"
+        )
+    assert np.array_equal(
+        got["m"],
+        np.asarray(jax.ops.segment_max(
+            jnp.asarray(cols["m"]), jnp.asarray(ids),
+            num_segments=n_groups,
+        )),
+    ), "segment-reduce kernel != XLA scatter on an exact op class"
+
+    big_ids = rng.integers(0, n_groups, n_rows).astype(np.int32)
+    vals = {"v": rng.standard_normal(n_rows).astype(np.float32)}
+    ops1 = (("v", "reduce_sum"),)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _segment_reduce_best(ops1, n_groups, vals, big_ids)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
 def _bench_map_rows_ragged(n_rows: int = 20_000, iters: int = 3):
     """Ragged map_rows throughput: grouped vmapped dispatch with
     bucketed lead dims (one dispatch per distinct cell shape, not one
@@ -1676,6 +1724,10 @@ def main():
         "aggregate_strings", _bench_aggregate_strings, float("nan"),
         metric_keys=("aggregate_strings_1M_512groups_wall_s",),
     )
+    segment_reduce_s = _try(
+        "segment_reduce", _bench_segment_reduce, float("nan"),
+        metric_keys=("segment_reduce_1M_wall_s",),
+    )
     ragged_rps = _try("map_rows_ragged", _bench_map_rows_ragged, 0.0,
                       metric_keys=("map_rows_ragged_rows_per_sec",))
     ragged_dev_rps = _try(
@@ -1977,6 +2029,25 @@ def main():
             )
         )
 
+    # straggler-kernel family summary (ISSUE 12), the `# plan |`
+    # convention — printed AFTER every kernel-exercising sub-bench
+    # (segment_reduce, ragged map_rows, generate, the serving decode
+    # engine) so the dispatch/selection counters reflect this run
+    try:
+        from tensorframes_tpu.observability.metrics import (
+            REGISTRY as _kern_reg,
+        )
+
+        for ln in _kern_reg.summary_lines():
+            if ln.startswith("tftpu_kernels_") or (
+                ln.startswith("tftpu_plan_cost_decisions_total")
+                and ("pallas_" in ln or "_attn" in ln
+                     or "segment_reduce" in ln)
+            ):
+                print(f"# kernels | {ln}")
+    except Exception as e:  # telemetry must never kill the JSON line
+        print(f"# kernels | snapshot unavailable: {e}")
+
     from tensorframes_tpu import native
 
     convert_s, convertback_s = _try(
@@ -2003,7 +2074,10 @@ def main():
         "aggregate_1M_512groups_wall_s": round(aggregate_s, 6),
         "aggregate_device_1M_512groups_wall_s": round(aggregate_dev_s, 6),
         "aggregate_strings_1M_512groups_wall_s": round(aggregate_str_s, 6),
+        "segment_reduce_1M_wall_s": round(segment_reduce_s, 6),
         "map_rows_ragged_rows_per_sec": round(ragged_rps),
+        # ISSUE 12 snapshot alias: the kernel-selection gate keys
+        "ragged_map_rows_per_sec": round(ragged_rps),
         "map_rows_ragged_device_rows_per_sec": round(ragged_dev_rps),
         "map_rows_fixed_rows_per_sec": round(fixed_rps),
         "pair_native_inception_rows_per_sec": round(pair_native, 1),

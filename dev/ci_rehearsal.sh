@@ -105,6 +105,13 @@ run_step "Test (8-device virtual CPU mesh)" \
 run_step "Fusion-off smoke (TFTPU_FUSION=0 fallback stays green)" \
   env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py tests/test_relational_pipeline.py -q
 
+# ci.yml's kernels-off smoke (ISSUE 12): TFTPU_PALLAS=0 removes the
+# straggler pallas kernels from every cost-model decision — the
+# XLA/host lowerings they replace must keep every selecting suite
+# green (same contract as the fusion-off escape hatch above)
+run_step "Kernels-off smoke (TFTPU_PALLAS=0 straggler kernels removed)" \
+  env TFTPU_PALLAS=0 python -m pytest tests/test_kernels.py tests/test_segment.py tests/test_verbs.py tests/test_decode.py tests/test_generation.py -q
+
 # ci.yml's compile-cache smoke: a tier-1 slice twice against one shared
 # persistent store; the second run must report disk hits > 0 in its
 # metrics JSONL (docs/compilecache.md cross-process contract)

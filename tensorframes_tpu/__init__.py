@@ -92,6 +92,7 @@ from .validation import StaticAnalysisError, ValidationError  # noqa: E402,F401
 from . import analysis  # noqa: E402,F401
 from .analysis import analyze_frame, lint_plan, lint_program  # noqa: E402,F401
 from . import plan  # noqa: E402,F401  (registers tftpu_plan_* metrics)
+from . import kernels  # noqa: E402,F401  (registers tftpu_kernels_* metrics)
 from .plan import explain_plan  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
     aggregate,

@@ -27,7 +27,10 @@ artifact:
   every rank of an SPMD fleet computes the same key, so one rank's
   published executable is every rank's hit; resizing the fleet misses
   cleanly), ``XLA_FLAGS``, jax version, entry kind (block/vmap/fn),
-  donation and hoist flags, and the store format version.
+  donation and hoist flags, the straggler-kernel selection state
+  (:func:`tensorframes_tpu.kernels.fingerprint_token` — pallas
+  enabled/kill-switched, force hook, interpreter mode), and the store
+  format version.
 
 ``TFG108`` (analysis/rules.py) calls :func:`program_fingerprint` twice
 with independent traces: a program whose fingerprint differs across
@@ -51,7 +54,10 @@ import numpy as np
 #: Bumped whenever the entry layout or key composition changes: old
 #: entries simply miss (never mis-deserialize). v2: sharding/topology
 #: axes joined the key (unified sharded/multi-process AOT dispatch).
-FORMAT_VERSION = 2
+#: v3: the straggler-kernel selection state joined the env component
+#: (ISSUE 12 — a ``disable_pallas()`` flip or a ``TFTPU_PALLAS``
+#: change must never serve a stale executable).
+FORMAT_VERSION = 3
 
 __all__ = [
     "FORMAT_VERSION",
@@ -103,10 +109,17 @@ def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
     from ..config import get_config
     from ..parallel.distributed import process_topology
 
+    from .. import kernels as _kernels
+
     cfg = get_config()
     dev = jax.devices()[0]
     return {
         "format": FORMAT_VERSION,
+        # kernel-selection state: pallas on/off (config switch AND the
+        # runtime Mosaic kill-switch), the force hook, and interpreter
+        # mode — any flip invalidates every key, because the lowering
+        # the cost model picks is baked into the traced program
+        "kernels": _kernels.fingerprint_token(),
         "jax": jax.__version__,
         "backend": jax.default_backend(),
         "device_kind": getattr(dev, "device_kind", "unknown"),

@@ -111,6 +111,18 @@ class Config:
     # real-TPU window shows it beating the XLA structural fusion —
     # dev/tpu_smoke.py prints the adjudicating comparison.
     pallas_int8_matmul: bool = _env_bool("TFTPU_PALLAS_INT8_MM", False)
+    # Master switch for the straggler pallas kernels (tensorframes_tpu/
+    # kernels: paged int8-KV decode attention, fused segment reduce,
+    # ragged gather). TFTPU_PALLAS=0 removes them from every cost-model
+    # decision — the CI smoke proves the XLA/host lowerings alone keep
+    # every suite green. Distinct from the runtime kill-switch
+    # (ops/segment.disable_pallas), which trips on a Mosaic failure.
+    pallas_kernels: bool = _env_bool("TFTPU_PALLAS", True)
+    # Force-select the straggler kernels even on CPU backends (the
+    # pallas interpreter runs them — slow, but the full wiring from
+    # cost model to kernel executes). Tests and the in-bench
+    # bit-identity gates use this; never enable it for throughput.
+    pallas_force: bool = _env_bool("TFTPU_PALLAS_FORCE", False)
     # Lazy verb-chain fusion (tensorframes_tpu/plan): chained lazy maps
     # record a logical plan instead of nesting compute thunks, and each
     # maximal fusable run lowers to ONE composed XLA program dispatched

@@ -1,0 +1,152 @@
+"""Pallas straggler kernels, selected per segment by the plan cost model.
+
+The bench trajectory names three hot paths the default XLA lowerings
+leave on the table (ROADMAP #6): ragged ``map_rows`` (~12M rows/s vs
+1B+ for fixed-shape add3), decode attention (~17k tokens/s at 512 seq —
+the steady-state inner loop of the serving decode engine), and the
+segment reduce PR 7 routed to a host ``np.bincount`` because XLA:CPU
+serializes scatter. This package holds the purpose-built kernels:
+
+* :mod:`.segment_reduce` — one fused pallas dispatch computing every
+  (column, op) of a keyed reduction: sum/mean via the one-hot MXU
+  contraction, min/max via masked VPU reductions, sorted-or-not ids.
+* :mod:`.decode_attention` — paged int8-KV decode attention: per-slot
+  pages stream HBM→VMEM through the page table (scalar-prefetch index
+  maps), dequantize in-register, and the attention math runs in the
+  same kernel — the gather→dequant→attend chain of
+  ``models/generation.paged_decode_step_fn`` becomes ONE kernel with no
+  materialized ``[S, pages, heads, page, hd]`` copy.
+* :mod:`.ragged_gather` — ragged row staging on device: cells move as
+  one flat buffer + offsets, and the kernel scatters each shape
+  group's rows into its padded batch in VMEM, replacing the per-group
+  host ``np.stack`` + transfer of the ragged ``map_rows`` path.
+
+**Selection is a counted cost-model decision** (``plan/rules.py``:
+``decide_segment_reduce`` / ``decide_decode_attention`` /
+``decide_ragged_gather`` → ``pallas_*`` decision values), never an
+unconditional dispatch: kernels engage on TPU-family backends (or
+everywhere under ``TFTPU_PALLAS_FORCE=1``, which tests and the
+in-bench bit-identity gates use — the CPU pallas interpreter runs the
+kernels there, so tier-1 stays green under ``JAX_PLATFORMS=cpu``).
+``TFTPU_PALLAS=0`` removes them from every decision, and the runtime
+Mosaic kill-switch (:func:`tensorframes_tpu.ops.segment.disable_pallas`)
+covers recovery — it already invalidates the fused-program cache, and
+:func:`enabled` consults it, so a tripped switch disables THIS package
+too and no stale executable survives (the compile-cache fingerprint
+carries :func:`fingerprint_token`).
+
+Every kernel is **bit-identity-gated**: against its plain-jnp
+same-tiling reference emulation always (exact by construction — the
+gate that catches indexing/masking/dequant bugs), and against the
+XLA/host reference wherever exactness is structural (min/max, integer
+sums, and the decode-attention chain, which the pallas interpreter
+reproduces bit-for-bit on CPU).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from ..observability.metrics import counter as _counter
+from ..observability.metrics import histogram as _histogram
+
+__all__ = [
+    "KERNELS",
+    "enabled",
+    "force_active",
+    "interpret_mode",
+    "fingerprint_token",
+    "note_dispatch",
+    "build_timer",
+]
+
+#: The registered kernel names — one counted dispatch series each, and
+#: the vocabulary of the ``pallas_*`` cost-model decision values.
+KERNELS = ("segment_reduce", "decode_attn", "ragged_gather")
+
+# Pre-registered at import (the `# kernels |` bench summary and the
+# exposition must always carry the family — a process that never
+# dispatched a kernel reads 0, the series does not vanish).
+DISPATCHES = {
+    k: _counter(
+        "tftpu_kernels_dispatch_total",
+        "Pallas straggler-kernel dispatches, by kernel",
+        labels={"kernel": k},
+    )
+    for k in KERNELS
+}
+INTERPRET_FALLBACKS = {
+    k: _counter(
+        "tftpu_kernels_interpret_fallback_total",
+        "Kernel dispatches that ran on the CPU pallas interpreter "
+        "instead of a compiled Mosaic kernel, by kernel",
+        labels={"kernel": k},
+    )
+    for k in KERNELS
+}
+BUILD_SECONDS = _histogram(
+    "tftpu_kernels_build_seconds",
+    "Wall-clock of building (tracing + first-dispatch compiling) one "
+    "straggler-kernel call",
+)
+
+
+def enabled() -> bool:
+    """True when the straggler kernels may be selected at all: the
+    ``TFTPU_PALLAS`` config switch is on AND the process-wide Mosaic
+    kill-switch has not tripped (``ops.segment.disable_pallas`` — one
+    switch covers every pallas family, and tripping it already clears
+    the fused-program cache so no stale trace replays)."""
+    from ..config import get_config
+    from ..ops import segment as _segment
+
+    return bool(get_config().pallas_kernels) and _segment.pallas_enabled()
+
+
+def force_active() -> bool:
+    """``TFTPU_PALLAS_FORCE`` — select kernels even off-TPU (the pallas
+    interpreter runs them). The bit-identity test/bench hook."""
+    from ..config import get_config
+
+    return bool(get_config().pallas_force)
+
+
+def interpret_mode() -> bool:
+    """True when kernels must run on the pallas CPU interpreter (no
+    Mosaic toolchain for this backend) — the tier-1 configuration."""
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def fingerprint_token() -> Dict[str, object]:
+    """The kernel-selection state that must key every compiled
+    executable (folded into the compile-cache fingerprint's env slot):
+    a ``disable_pallas()`` flip, a ``TFTPU_PALLAS``/``_FORCE`` change,
+    or moving between interpreter and Mosaic must all miss cleanly —
+    a store hit across any of them would replay a stale lowering."""
+    return {
+        "enabled": enabled(),
+        "force": force_active(),
+        "interpret": interpret_mode(),
+    }
+
+
+def note_dispatch(kernel: str, interpret: bool) -> None:
+    """Count one kernel dispatch (and its interpreter fallback)."""
+    DISPATCHES[kernel].inc()
+    if interpret:
+        INTERPRET_FALLBACKS[kernel].inc()
+
+
+class build_timer:
+    """``with build_timer(): ...`` — records kernel build wall-clock."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        BUILD_SECONDS.observe(time.perf_counter() - self._t0)
+        return False

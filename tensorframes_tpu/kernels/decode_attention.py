@@ -1,0 +1,184 @@
+"""Fused paged int8-KV decode attention (the kernel half of the
+serving decode engine, ROADMAP #6 / ISSUE 12).
+
+The XLA lowering of ``models/generation.paged_decode_step_fn`` runs
+decode attention as a chain: gather every slot's pages into a
+materialized ``[S, pages, heads, page, hd]`` HBM copy, dequantize, and
+attend. Decode is HBM-bandwidth-bound, so that copy IS the cost. This
+kernel fuses the chain: the grid walks ``(slot, page-table entry)``,
+each page streams HBM→VMEM **as int8** through a scalar-prefetched
+page-table index map (the vLLM paged-attention shape), scales ride
+along, and on a slot's last page the whole attention — dequantize,
+scores, null/validity masking, softmax, context — runs in-register.
+Nothing gathered ever touches HBM.
+
+Bit-identity: the kernel performs the REFERENCE chain's exact op
+sequence per slot (same einsums, same ``preferred_element_type``, same
+masking constant, same softmax) — on the CPU pallas interpreter the
+output is bit-identical to the XLA chain (asserted in tests), and the
+engine-level gates (batched==solo, preemption replay, the dense
+``generate()`` oracle) hold whichever lowering the cost model picks
+because the choice is made once per engine, not per step.
+
+Null-page handling is inherited unchanged: padding slots carry
+all-null tables (every gathered page is page 0) and real slots mask to
+``position <= pos``, so the null page's garbage never reaches an
+unmasked score — the same invariant the XLA chain relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,          # [S, nh, hd] activation dtype
+    k_pages: jnp.ndarray,    # [P, L, nh, page, hd] int8
+    v_pages: jnp.ndarray,    # [P, L, nh, page, hd] int8
+    k_scale: jnp.ndarray,    # [P, L, nh, page, 1] f32
+    v_scale: jnp.ndarray,    # [P, L, nh, page, 1] f32
+    layer: int,              # static layer index
+    tables: jnp.ndarray,     # [S, maxp] int32 page tables
+    pos: jnp.ndarray,        # [S] int32 current positions
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """One layer's paged decode attention for every slot: returns the
+    ``[S, nh, hd]`` context in ``q.dtype``. Traceable (callers embed it
+    in the jitted decode step); ``interpret`` defaults to the backend's
+    :func:`tensorframes_tpu.kernels.interpret_mode`."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from . import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    S, nh, hd = q.shape
+    page = int(k_pages.shape[3])
+    maxp = int(tables.shape[1])
+    C = maxp * page
+    dtype = q.dtype
+    li = int(layer)
+
+    def kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+               o_ref, k8, v8, ks, vs):
+        s = pl.program_id(0)
+        j = pl.program_id(1)
+        sl = pl.ds(j * page, page)
+        k8[:, sl, :] = k_ref[0, 0]
+        v8[:, sl, :] = v_ref[0, 0]
+        ks[:, sl] = ks_ref[0, 0, :, :, 0]
+        vs[:, sl] = vs_ref[0, 0, :, :, 0]
+
+        @pl.when(j == maxp - 1)
+        def _attend():
+            neg = jnp.asarray(-1e30, jnp.float32)
+            # [1, C] validity row — broadcasting over heads exactly as
+            # the reference's valid[:, None, :] slice does per slot
+            valid = lax.broadcasted_iota(
+                jnp.int32, (1, C), 1
+            ) <= pos_ref[s]
+            scores = jnp.einsum(
+                "hd,hcd->hc", q_ref[0], k8[:].astype(dtype),
+                preferred_element_type=jnp.float32,
+            ) / float(np.sqrt(hd))
+            scores = scores * ks[:]
+            scores = jnp.where(valid, scores, neg)
+            w = jax.nn.softmax(scores, axis=-1)
+            w = (w * vs[:]).astype(dtype)
+            o_ref[0] = jnp.einsum("hc,hcd->hd", w, v8[:].astype(dtype))
+
+    # Every index-map component derives from a grid index (``j - j``
+    # zeros): this package enables x64 at import, under which literal
+    # ints trace i64 beside the i32 grid index and Mosaic fails to
+    # legalize the mixed-type func.return (the ops/segment.py lesson).
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, maxp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, nh, hd), lambda s, j, tbl, p: (s, j - j, j - j)
+            ),
+            pl.BlockSpec(
+                (1, 1, nh, page, hd),
+                lambda s, j, tbl, p: (
+                    tbl[s, j], (j - j) + li, j - j, j - j, j - j
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, nh, page, hd),
+                lambda s, j, tbl, p: (
+                    tbl[s, j], (j - j) + li, j - j, j - j, j - j
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, nh, page, 1),
+                lambda s, j, tbl, p: (
+                    tbl[s, j], (j - j) + li, j - j, j - j, j - j
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, nh, page, 1),
+                lambda s, j, tbl, p: (
+                    tbl[s, j], (j - j) + li, j - j, j - j, j - j
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nh, hd), lambda s, j, tbl, p: (s, j - j, j - j)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((nh, C, hd), jnp.int8),
+            pltpu.VMEM((nh, C, hd), jnp.int8),
+            pltpu.VMEM((nh, C), jnp.float32),
+            pltpu.VMEM((nh, C), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nh, hd), dtype),
+        interpret=bool(interpret),
+    )(
+        tables.astype(jnp.int32), pos.astype(jnp.int32),
+        q, k_pages, v_pages, k_scale, v_scale,
+    )
+
+
+def paged_attention_reference(
+    q, k_pages, v_pages, k_scale, v_scale, layer, tables, pos
+):
+    """The XLA gather→dequant→attend chain — this IS the production
+    lowering (``paged_decode_step_fn``'s non-kernel branch calls it)
+    AND the oracle the kernel is bit-identity-gated against, so the
+    two can never drift apart."""
+    S, nh, hd = q.shape
+    page = int(k_pages.shape[3])
+    maxp = int(tables.shape[1])
+    C = maxp * page
+    dtype = q.dtype
+    li = int(layer)
+    neg = jnp.asarray(-1e30, jnp.float32)
+    valid = jnp.arange(C)[None, :] <= pos[:, None]
+    pk = k_pages[tables, li]
+    pv = v_pages[tables, li]
+    pks = k_scale[tables, li][..., 0]
+    pvs = v_scale[tables, li][..., 0]
+    pk = pk.transpose(0, 2, 1, 3, 4).reshape(S, nh, C, hd)
+    pv = pv.transpose(0, 2, 1, 3, 4).reshape(S, nh, C, hd)
+    pks = pks.transpose(0, 2, 1, 3).reshape(S, nh, C)
+    pvs = pvs.transpose(0, 2, 1, 3).reshape(S, nh, C)
+    scores = jnp.einsum(
+        "nhd,nhcd->nhc", q, pk.astype(dtype),
+        preferred_element_type=jnp.float32,
+    ) / float(np.sqrt(hd))
+    scores = scores * pks
+    scores = jnp.where(valid[:, None, :], scores, neg)
+    w = jax.nn.softmax(scores, axis=-1)
+    w = (w * pvs).astype(dtype)
+    return jnp.einsum("nhc,nhcd->nhd", w, pv.astype(dtype))

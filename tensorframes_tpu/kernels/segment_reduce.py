@@ -1,0 +1,400 @@
+"""Fused multi-op pallas segment reduce — the third keyed-reduction
+strategy beside the jitted scatter and the host ``np.bincount``
+(``ops/segment.py``), selected per segment by
+``plan/rules.decide_segment_reduce``.
+
+One pallas dispatch computes EVERY (column, op) fetch of a keyed
+``aggregate``: the grid walks row tiles sequentially and accumulates
+per-segment partials into the same output block —
+
+* ``sum``/``mean`` of floats: the one-hot MXU contraction (the PR 7
+  trick — ``[tile, segments]`` membership one-hot against the value
+  tile as a dense f32 matmul, ``precision=HIGHEST``);
+* ``sum``/``mean`` of ints/bools: the same one-hot contraction with an
+  **int32 accumulator** (``preferred_element_type=int32`` — exact
+  associative arithmetic, bit-identical to the scatter by
+  construction);
+* ``min``/``max``: a masked VPU reduction over the
+  ``[tile, segments, d]`` broadcast (order-free, so also exactly the
+  scatter's bits); the row tile shrinks adaptively so that broadcast
+  stays VMEM-bounded, and :func:`eligible` refuses shapes where it
+  cannot.
+
+Mean division and final dtype casts happen OUTSIDE the kernel with the
+jitted path's formula (``(s / c).astype(v.dtype)``; the count table is
+i32-exact). Bit-identity is gated two ways: against
+:func:`segment_reduce_reference` — the same tiled computation in plain
+jnp, exact by construction for every op/dtype — and against the XLA
+scatter for the order-free classes (min/max, integer sums).
+
+Sorted-or-not segment ids; padded rows carry id ``num_segments`` and
+match nothing real (a padded row can land in a padded SEGMENT slot,
+which the final slice discards). Runs on the pallas CPU interpreter
+when no Mosaic toolchain serves the backend
+(:func:`tensorframes_tpu.kernels.interpret_mode`).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import build_timer, note_dispatch
+
+#: default rows per grid step (sublane-aligned); shrinks for min/max
+_TILE_ROWS = 256
+#: past this, the one-hot wastes more FLOPs than the scatter costs
+MAX_SEGMENTS = 4096
+#: element budget for the [tile, segments, d] min/max broadcast
+_MASK_BUDGET = 1 << 20
+
+_FLOAT_OK = ("float32", "bfloat16")
+_INT_OK = ("int32", "int16", "int8", "uint8", "bool")
+_OPS = ("reduce_sum", "reduce_mean", "reduce_min", "reduce_max")
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _dtype_name(v) -> str:
+    return str(v.dtype)
+
+
+def _np_to_jnp_dtype(name: str):
+    return jnp.bfloat16 if name == "bfloat16" else np.dtype(name)
+
+
+def _col_meta(ops_key, val_cols) -> Tuple[Tuple[str, str, int, int, str], ...]:
+    """Per-column (name, dtype, inner dim, ndim, op) — the build-cache
+    key axis that varies with the feed."""
+    meta = []
+    for x, op in ops_key:
+        v = val_cols[x]
+        ndim = int(getattr(v, "ndim", 1))
+        d = 1 if ndim == 1 else int(v.shape[1])
+        meta.append((x, _dtype_name(v), d, ndim, op))
+    return tuple(meta)
+
+
+def _tile_rows(meta, num_segments: int) -> int:
+    """Row-tile size: the default unless a min/max column's masked
+    broadcast would blow the element budget, in which case shrink
+    (never below the 8-row sublane floor — :func:`eligible` refuses
+    shapes that would still not fit there)."""
+    s_pad = _round_up(max(num_segments, 1), 8)
+    tile = _TILE_ROWS
+    for _, _, d, _, op in meta:
+        if op in ("reduce_min", "reduce_max"):
+            d_pad = _round_up(d, 128)
+            while tile > 8 and tile * s_pad * d_pad > _MASK_BUDGET:
+                tile //= 2
+    return tile
+
+
+def eligible(ops_key, val_cols, num_segments: int) -> bool:
+    """True when the fused pallas kernel can serve this keyed
+    reduction exactly: bounded segment count, 1-D/2-D values, float32/
+    bfloat16 (f32 accumulate) or ≤32-bit int/bool (i32 accumulate —
+    wider ints could overflow the exact accumulator), and a min/max
+    broadcast that fits the tile budget."""
+    if not 0 < num_segments <= MAX_SEGMENTS:
+        return False
+    for x, op in ops_key:
+        if op not in _OPS:
+            return False
+        v = val_cols[x]
+        if getattr(v, "ndim", None) not in (1, 2):
+            return False
+        if _dtype_name(v) not in _FLOAT_OK + _INT_OK:
+            return False
+    meta = _col_meta(ops_key, val_cols)
+    tile = _tile_rows(meta, num_segments)
+    s_pad = _round_up(num_segments, 8)
+    return not any(
+        tile * s_pad * _round_up(d, 128) > _MASK_BUDGET
+        for _, _, d, _, op in meta
+        if op in ("reduce_min", "reduce_max")
+    )
+
+
+def _acc_dtype(dtype_name: str):
+    """(accumulator dtype, is_float) for a sum/mean column."""
+    if dtype_name in _FLOAT_OK:
+        return jnp.float32, True
+    return jnp.int32, False
+
+
+def _minmax_identity(dtype_name: str, op: str):
+    if dtype_name in _FLOAT_OK:
+        return jnp.asarray(
+            jnp.inf if op == "reduce_min" else -jnp.inf,
+            _np_to_jnp_dtype(dtype_name),
+        )
+    if dtype_name == "bool":
+        return jnp.asarray(op == "reduce_min", jnp.bool_)
+    info = np.iinfo(np.dtype(dtype_name))
+    return jnp.asarray(
+        info.max if op == "reduce_min" else info.min,
+        np.dtype(dtype_name),
+    )
+
+
+def _tile_partial(op: str, dtype_name: str, seg: jnp.ndarray,
+                  vals: jnp.ndarray, s_pad: int):
+    """One tile's per-segment partial — THE shared math of the kernel
+    body and the plain-jnp reference emulation (bit-identity between
+    them is by construction: same ops, same order, same dtypes).
+    ``seg`` [tile] int32, ``vals`` [tile, d_pad]."""
+    tile = seg.shape[0]
+    seg_iota = lax.broadcasted_iota(jnp.int32, (tile, s_pad), 1)
+    member = seg[:, None] == seg_iota                      # [tile, s_pad]
+    if op in ("reduce_sum", "reduce_mean"):
+        acc, is_float = _acc_dtype(dtype_name)
+        kw = {"precision": lax.Precision.HIGHEST} if is_float else {}
+        return lax.dot_general(
+            member.astype(acc),
+            vals.astype(acc),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc,
+            **kw,
+        )
+    ident = _minmax_identity(dtype_name, op)
+    masked = jnp.where(member[:, :, None], vals[:, None, :], ident)
+    red = jnp.min if op == "reduce_min" else jnp.max
+    return red(masked, axis=0)                             # [s_pad, d_pad]
+
+
+def _count_partial(seg: jnp.ndarray, s_pad: int) -> jnp.ndarray:
+    """Per-segment row counts for one tile (i32-exact; every lane of
+    the [s_pad, 128] table carries the same count — lane 0 is read)."""
+    tile = seg.shape[0]
+    seg_iota = lax.broadcasted_iota(jnp.int32, (tile, s_pad), 1)
+    member = (seg[:, None] == seg_iota).astype(jnp.int32)
+    return lax.dot_general(
+        member,
+        jnp.ones((tile, 128), jnp.int32),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def _pad_inputs(meta, num_segments, val_cols, seg_ids, tile):
+    """Tile-pad the feed: segs [n_pad, 1] (padding rows → id ==
+    num_segments), each column [n_pad, d_pad]."""
+    seg_ids = jnp.asarray(np.asarray(seg_ids)).astype(jnp.int32)
+    n = int(seg_ids.shape[0])
+    n_pad = _round_up(max(n, 1), tile)
+    segs = jnp.full((n_pad, 1), num_segments, jnp.int32)
+    if n:
+        segs = segs.at[:n, 0].set(seg_ids)
+    padded = {}
+    for x, dtype_name, d, ndim, _ in meta:
+        v = jnp.asarray(val_cols[x])
+        v2 = v[:, None] if ndim == 1 else v
+        d_pad = _round_up(d, 128)
+        buf = jnp.zeros((n_pad, d_pad), v2.dtype)
+        if n:
+            buf = buf.at[:n, :d].set(v2)
+        padded[x] = buf
+    return segs, padded, n_pad
+
+
+def _finalize(meta, num_segments, partials, counts):
+    """Slice away padding and apply the jitted path's mean/cast
+    formula: ``s.astype(v.dtype)`` for sums, ``(s / c).astype(v.dtype)``
+    for means. Returns 2-D [K, d] columns (callers restore 1-D)."""
+    out = {}
+    for x, dtype_name, d, _, op in meta:
+        dt = _np_to_jnp_dtype(dtype_name)
+        p = partials[x][:num_segments, :d]
+        if op in ("reduce_min", "reduce_max"):
+            out[x] = p
+        elif op == "reduce_sum":
+            out[x] = p.astype(dt)
+        else:  # reduce_mean
+            s = p.astype(dt)
+            c = counts[:num_segments, :1].astype(dt)
+            out[x] = (s / c).astype(dt)
+    return out
+
+
+def _unpad(meta, res) -> Dict[str, np.ndarray]:
+    out = {}
+    for x, _, _, ndim, _ in meta:
+        v = np.asarray(res[x])
+        out[x] = v[:, 0] if ndim == 1 else v
+    return out
+
+
+@lru_cache(maxsize=32)
+def _pallas_fn_for(meta, num_segments: int, interpret: bool):
+    """Build (once per op-set/shape family) the jitted wrapper whose
+    body is ONE pallas_call computing every partial + the shared count
+    table. ``meta`` is the :func:`_col_meta` tuple."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tile = _tile_rows(meta, num_segments)
+    s_pad = _round_up(num_segments, 8)
+    need_counts = any(op == "reduce_mean" for *_, op in meta)
+    n_cols = len(meta)
+
+    def kernel(seg_ref, *refs):
+        val_refs = refs[:n_cols]
+        out_refs = refs[n_cols:2 * n_cols]
+        cnt_ref = refs[2 * n_cols] if need_counts else None
+        first = pl.program_id(0) == 0
+        seg = seg_ref[:, 0]
+        for (x, dtype_name, d, ndim, op), v_ref, o_ref in zip(
+            meta, val_refs, out_refs
+        ):
+            part = _tile_partial(op, dtype_name, seg, v_ref[:], s_pad)
+            if op in ("reduce_min", "reduce_max"):
+                ident = _minmax_identity(dtype_name, op)
+
+                @pl.when(first)
+                def _init(o_ref=o_ref, ident=ident):
+                    o_ref[:] = jnp.full(
+                        o_ref.shape, ident, o_ref.dtype
+                    )
+
+                comb = jnp.minimum if op == "reduce_min" else jnp.maximum
+                o_ref[:] = comb(o_ref[:], part)
+            else:
+                @pl.when(first)
+                def _init(o_ref=o_ref):
+                    o_ref[:] = jnp.zeros_like(o_ref)
+
+                o_ref[:] += part
+        if cnt_ref is not None:
+            @pl.when(first)
+            def _init_c():
+                cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+            cnt_ref[:] += _count_partial(seg, s_pad)
+
+    @jax.jit
+    def run(segs, vals):
+        n_pad = segs.shape[0]
+        grid = (n_pad // tile,)
+        # every index-map component derives from the grid index: this
+        # package enables x64 at import, under which a literal 0
+        # traces i64 beside the i32 grid index and Mosaic fails to
+        # legalize the mixed-type func.return (the ops/segment.py
+        # lesson); ``i - i`` is an i32 zero
+        in_specs = [pl.BlockSpec((tile, 1), lambda i: (i, i - i),
+                                 memory_space=pltpu.VMEM)]
+        out_shapes = []
+        out_specs = []
+        ins = [segs]
+        for x, dtype_name, d, ndim, op in meta:
+            d_pad = _round_up(d, 128)
+            in_specs.append(pl.BlockSpec(
+                (tile, d_pad), lambda i: (i, i - i),
+                memory_space=pltpu.VMEM,
+            ))
+            ins.append(vals[x])
+            if op in ("reduce_min", "reduce_max"):
+                out_dt = _np_to_jnp_dtype(dtype_name)
+            else:
+                out_dt = _acc_dtype(dtype_name)[0]
+            out_shapes.append(jax.ShapeDtypeStruct((s_pad, d_pad), out_dt))
+            out_specs.append(pl.BlockSpec(
+                (s_pad, d_pad), lambda i: (i - i, i - i),
+                memory_space=pltpu.VMEM,
+            ))
+        if need_counts:
+            out_shapes.append(
+                jax.ShapeDtypeStruct((s_pad, 128), jnp.int32)
+            )
+            out_specs.append(pl.BlockSpec(
+                (s_pad, 128), lambda i: (i - i, i - i),
+                memory_space=pltpu.VMEM,
+            ))
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(*ins)
+        partials = {meta[k][0]: outs[k] for k in range(n_cols)}
+        counts = outs[n_cols] if need_counts else None
+        return _finalize(meta, num_segments, partials, counts)
+
+    return run
+
+
+def segment_reduce_pallas(
+    ops_key, num_segments: int, val_cols, seg_ids,
+    interpret: Optional[bool] = None,
+) -> Dict[str, np.ndarray]:
+    """Run the fused kernel: ``ops_key`` is the ((name, op), ...) tuple
+    of ``_segment_reduce_best``, ``val_cols`` maps names to 1-D/2-D
+    numpy or jax arrays, ``seg_ids`` the int row→segment map. Returns
+    numpy columns sliced to ``num_segments``, dtypes matching the
+    jitted path's contract. Caller gates :func:`eligible` first."""
+    from . import interpret_mode
+
+    if interpret is None:
+        interpret = interpret_mode()
+    meta = _col_meta(ops_key, val_cols)
+    tile = _tile_rows(meta, num_segments)
+    with build_timer():
+        fn = _pallas_fn_for(meta, num_segments, bool(interpret))
+    segs, padded, _ = _pad_inputs(
+        meta, num_segments, val_cols, seg_ids, tile
+    )
+    note_dispatch("segment_reduce", bool(interpret))
+    return _unpad(meta, fn(segs, padded))
+
+
+def segment_reduce_reference(
+    ops_key, num_segments: int, val_cols, seg_ids,
+) -> Dict[str, np.ndarray]:
+    """Plain-jnp emulation of the kernel's exact tiled computation —
+    the bit-identity oracle (same per-tile math via
+    :func:`_tile_partial`, same sequential tile order, same finalize
+    formula; no pallas anywhere). Tests and the in-bench gate assert
+    ``segment_reduce_pallas == segment_reduce_reference`` bitwise."""
+    meta = _col_meta(ops_key, val_cols)
+    tile = _tile_rows(meta, num_segments)
+    s_pad = _round_up(num_segments, 8)
+    segs, padded, n_pad = _pad_inputs(
+        meta, num_segments, val_cols, seg_ids, tile
+    )
+    seg_flat = segs[:, 0]
+    need_counts = any(op == "reduce_mean" for *_, op in meta)
+    partials: Dict[str, jnp.ndarray] = {}
+    counts = None
+    for t in range(n_pad // tile):
+        seg_t = seg_flat[t * tile:(t + 1) * tile]
+        for x, dtype_name, d, ndim, op in meta:
+            v_t = padded[x][t * tile:(t + 1) * tile]
+            part = _tile_partial(op, dtype_name, seg_t, v_t, s_pad)
+            if x not in partials:
+                if op in ("reduce_min", "reduce_max"):
+                    partials[x] = jnp.full(
+                        part.shape, _minmax_identity(dtype_name, op),
+                        part.dtype,
+                    )
+                else:
+                    partials[x] = jnp.zeros_like(part)
+            if op in ("reduce_min", "reduce_max"):
+                comb = jnp.minimum if op == "reduce_min" else jnp.maximum
+                partials[x] = comb(partials[x], part)
+            else:
+                partials[x] = partials[x] + part
+        if need_counts:
+            cp = _count_partial(seg_t, s_pad)
+            counts = cp if counts is None else counts + cp
+    return _unpad(
+        meta, _finalize(meta, num_segments, partials, counts)
+    )

@@ -402,7 +402,8 @@ def paged_prefill_fn(cfg: TransformerConfig, page_size: int,
 
 
 def paged_decode_step_fn(cfg: TransformerConfig, page_size: int,
-                         max_pages: int):
+                         max_pages: int,
+                         attn_kernel: Optional[str] = None):
     """Build the batched decode step: ``fn(params, pool, tokens[S],
     pos[S], tables[S, max_pages]) -> (pool, next_tokens[S])``.
 
@@ -414,8 +415,17 @@ def paged_decode_step_fn(cfg: TransformerConfig, page_size: int,
     computed independently (the map_rows/vmap convention), which is
     what makes a batched step bit-identical per slot to a solo step —
     the serving bench hard-gates it.
+
+    ``attn_kernel="pallas"`` replaces the gather→dequant→attend chain
+    with the fused paged int8-KV pallas kernel
+    (:func:`tensorframes_tpu.kernels.decode_attention.paged_decode_attention`
+    — pages stream HBM→VMEM through the page table and dequantize
+    in-register; no materialized gather copy). The choice is a counted
+    cost-model decision made ONCE per engine
+    (``plan/rules.decide_decode_attention``), so batched and solo
+    steps always trace the same lowering and the bit-identity gates
+    hold either way.
     """
-    C = max_pages * page_size
 
     def step(params, pool, tokens, pos, tables):
         from ..ops.quantize import matmul as _mm
@@ -429,8 +439,6 @@ def paged_decode_step_fn(cfg: TransformerConfig, page_size: int,
             axis=1,
         )[:, 0]                                     # [S] write page
         woff = pos % page_size
-        valid = jnp.arange(C)[None, :] <= pos[:, None]   # [S, C]
-        neg = jnp.asarray(-1e30, jnp.float32)
         pool = dict(pool)
         for li, p in enumerate(params["layers"]):
             y = _layer_norm(x, **p["ln1"])
@@ -446,26 +454,35 @@ def paged_decode_step_fn(cfg: TransformerConfig, page_size: int,
             pool["v"] = pool["v"].at[wpg, li, :, woff].set(vq)
             pool["k_scale"] = pool["k_scale"].at[wpg, li, :, woff].set(ks)
             pool["v_scale"] = pool["v_scale"].at[wpg, li, :, woff].set(vs)
-            # paged KV gather: each slot pulls its own pages (write
-            # above first, so slot j attends its own current token)
-            pk = pool["k"][tables, li]      # [S, MAXP, nh, page, hd]
-            pv = pool["v"][tables, li]
-            pks = pool["k_scale"][tables, li][..., 0]
-            pvs = pool["v_scale"][tables, li][..., 0]
-            pk = pk.transpose(0, 2, 1, 3, 4).reshape(S, nh, C, hd)
-            pv = pv.transpose(0, 2, 1, 3, 4).reshape(S, nh, C, hd)
-            pks = pks.transpose(0, 2, 1, 3).reshape(S, nh, C)
-            pvs = pvs.transpose(0, 2, 1, 3).reshape(S, nh, C)
-            scores = jnp.einsum(
-                "nhd,nhcd->nhc", q, pk.astype(cfg.dtype),
-                preferred_element_type=jnp.float32,
-            ) / float(np.sqrt(hd))
-            scores = scores * pks
-            scores = jnp.where(valid[:, None, :], scores, neg)
-            w = jax.nn.softmax(scores, axis=-1)
-            w = (w * pvs).astype(cfg.dtype)
-            ctx = jnp.einsum("nhc,nhcd->nhd", w, pv.astype(cfg.dtype))
-            ctx = ctx.reshape(S, h)
+            if attn_kernel == "pallas":
+                # fused paged-attention kernel: the page gather, int8
+                # dequant, and masked softmax-attend run in ONE pallas
+                # dispatch (write above first, so slot j still attends
+                # its own current token)
+                from ..kernels.decode_attention import (
+                    paged_decode_attention,
+                )
+
+                ctx = paged_decode_attention(
+                    q, pool["k"], pool["v"],
+                    pool["k_scale"], pool["v_scale"],
+                    li, tables, pos,
+                ).reshape(S, h)
+            else:
+                # paged KV gather: each slot pulls its own pages (write
+                # above first, so slot j attends its own current token).
+                # ONE implementation serves both the production XLA
+                # lowering and the kernel's bit-identity oracle — they
+                # cannot drift apart
+                from ..kernels.decode_attention import (
+                    paged_attention_reference,
+                )
+
+                ctx = paged_attention_reference(
+                    q, pool["k"], pool["v"],
+                    pool["k_scale"], pool["v_scale"],
+                    li, tables, pos,
+                ).reshape(S, h)
             x = x + _mm(ctx, p["attn"]["out"])
             x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
         hs = _layer_norm(x, **params["final_ln"])

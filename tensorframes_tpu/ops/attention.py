@@ -18,6 +18,10 @@ Three implementations, one contract (``[batch, heads, seq, head_dim]``):
   neighbor exchange) while accumulating the online softmax. Communication
   overlaps compute, memory per device is O(seq/sp), and the math is
   exactly dense attention.
+
+Serving decode adds a fourth: :func:`paged_decode_attention` — the
+fused paged int8-KV kernel (``kernels/decode_attention.py``) behind
+the same public surface, selected per engine by the plan cost model.
 """
 
 from __future__ import annotations
@@ -357,6 +361,37 @@ def ulysses_attention(
         check=False,
     )
     return fn(q, k, v)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    layer: int,
+    tables: jnp.ndarray,
+    pos: jnp.ndarray,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused paged int8-KV decode attention (ISSUE 12): one kernel
+    gathers each slot's pages through its page table (scalar-prefetch
+    index maps, pages stream HBM→VMEM as int8), dequantizes
+    in-register, and computes the masked softmax attention — the
+    public face of ``kernels/decode_attention.paged_decode_attention``.
+    ``q`` [slots, heads, head_dim]; the pool arrays are the
+    ``models/generation.init_paged_kv`` layout. Bit-identical to the
+    XLA gather→dequant→attend chain on the CPU interpreter (asserted
+    in tests). The serving decode engine selects it per engine via the
+    cost model (``plan/rules.decide_decode_attention``)."""
+    from ..kernels.decode_attention import (
+        paged_decode_attention as _kernel,
+    )
+
+    return _kernel(
+        q, k_pages, v_pages, k_scale, v_scale, layer, tables, pos,
+        interpret=interpret,
+    )
 
 
 def dense_attention(

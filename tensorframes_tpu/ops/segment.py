@@ -194,6 +194,22 @@ def segment_reduce_host(ops_key, num_segments, val_cols, seg_ids):
     import numpy as np
 
     seg_ids = np.asarray(seg_ids)
+    if seg_ids.size == 0:
+        # zero-row feed (ISSUE 12 bugfix sweep): ``np.asarray([])`` is
+        # float64 and ``np.bincount`` rejects float ids with a
+        # TypeError. Every segment is empty, so the answer is closed-
+        # form: zeros for sums, 0/0 → NaN for means — exactly the bits
+        # the jitted segment program produces for empty segments.
+        out = {}
+        for x, op in ops_key:
+            v = np.asarray(val_cols[x])
+            s = np.zeros(num_segments, np.float64)
+            if op == "reduce_mean":
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    s = s / np.zeros(num_segments, np.float64)
+            out[x] = s.astype(v.dtype)
+        return out
+    seg_ids = seg_ids.astype(np.intp, copy=False)
     out = {}
     counts = None
     for x, op in ops_key:

@@ -487,6 +487,11 @@ def _group_rows_by_shape(
     lengths via a single fromiter, then unique/argsort, no 20k-iteration
     python dict loop; multi-input / higher-rank cells keep the general
     tuple-key path."""
+    if n == 0:
+        # zero rows → zero groups: np.split over an empty order array
+        # would fabricate one EMPTY group whose downstream staging
+        # (np.stack of nothing, est_bytes reading idx[0]) crashes
+        return []
     if len(input_names) == 1:
         col = b[input_names[0]]
         cells = col if isinstance(col, list) else list(col)
@@ -529,6 +534,64 @@ def _stack_group(col, idx) -> np.ndarray:
     return np.stack([np.asarray(c) for c in cells])
 
 
+def _ragged_gather_plan(cols, input_names, n, program, group_list):
+    """Device-side ragged staging (ISSUE 12): when the cost model
+    selects the pallas ragged-gather kernel
+    (``plan/rules.decide_ragged_gather`` — single 1-D ragged column,
+    kernel-capable backend), the column's cells move ONCE as a flat
+    device buffer and each shape group's padded batch is gathered
+    on-device by ``kernels/ragged_gather.py`` — the per-group host
+    ``np.stack`` + transfer disappears. Returns a
+    ``gather(idx) -> feeds`` closure, or None to keep host staging
+    (the ordinary path — not a counted decision)."""
+    if len(input_names) != 1:
+        return None
+    name = input_names[0]
+    cells = cols[name]
+    if not cells or not all(
+        isinstance(c, np.ndarray) and c.ndim == 1 and c.shape[0] > 0
+        for c in cells
+    ):
+        return None
+    if len({c.dtype for c in cells}) != 1:
+        return None
+    from ..plan import rules as _prules
+
+    decision = _prules.decide_ragged_gather(
+        n, len(group_list), cells[0].dtype
+    )
+    if decision is None:
+        return None
+    from ..kernels import ragged_gather as _krg
+    from ..plan.lower import _note_decision
+
+    lens = np.fromiter((c.shape[0] for c in cells), np.int64, count=n)
+    if int(lens.sum()) > np.iinfo(np.int32).max:
+        # start offsets ride int32 scalar prefetch; a flat buffer past
+        # 2^31 elements would wrap them — host staging handles it
+        return None
+    starts = np.zeros(n, np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    flat = np.concatenate(cells)
+    spec = program.input(name)
+    if dt.demotion_active() and flat.dtype != spec.dtype.np_dtype:
+        # the x64 demotion boundary, applied once to the flat buffer
+        # instead of per stacked group (mirrors group_feeds)
+        flat = flat.astype(spec.dtype.np_dtype)
+    flat_dev = jax.device_put(flat)
+    _note_decision(decision)
+
+    def gather(idx):
+        g = len(idx)
+        gb = bucket_rows(g)
+        st = np.zeros(gb, np.int32)  # padding rows re-read offset 0;
+        st[:g] = starts[np.asarray(idx)]  # their outputs are sliced off
+        L = int(lens[int(idx[0])])
+        return {name: _krg.ragged_gather_rows(flat_dev, st, L)}
+
+    return gather
+
+
 def _ragged_rows_outs(
     cols: Dict[str, list],
     input_names: Sequence[str],
@@ -545,9 +608,21 @@ def _ragged_rows_outs(
     r3 #5; ≙ TFDataOps.scala:90-103). Returns one value per output:
     a dense ``[n, *cell]`` array (uniform cell shapes) or a per-row
     cell list (ragged outputs)."""
-    group_list = _group_rows_by_shape(cols, input_names, n)
+    if n == 0:
+        # zero ragged rows: dtype/rank-correct empties (Unknown inner
+        # dims degrade to 0), mirroring map_rows' empty-block branch —
+        # the staging below assumes at least one row per group
+        out0: Dict[str, object] = {}
+        for o in program.outputs:
+            dims = tuple(0 if d == Unknown else d for d in o.shape.dims)
+            out0[o.name] = np.empty((0,) + dims, dtype=o.dtype.np_dtype)
+        return out0
+    group_list = [g for g in _group_rows_by_shape(cols, input_names, n)
+                  if len(g)]
     donate_r = get_config().donate_inputs
     window = max(1, get_config().map_pipeline_depth)
+    gather = _ragged_gather_plan(cols, input_names, n, program,
+                                 group_list)
 
     def group_feeds(idx):
         g = len(idx)
@@ -602,7 +677,31 @@ def _ragged_rows_outs(
 
     outs_list: List[Dict[str, np.ndarray]] = []
     for wave in waves:
-        staged = jax.device_put([group_feeds(idx) for idx in wave])
+        if gather is not None:
+            try:
+                # padded batches materialize ON DEVICE (one flat
+                # buffer moved once, above); rows already bucket-padded
+                staged = [gather(idx) for idx in wave]
+            except Exception as e:
+                from . import segment as _segment
+
+                # same triage as _segment_reduce_best: only a Mosaic
+                # kernel-compile failure justifies the process-wide
+                # fallback (kill-switch + fused-cache invalidation,
+                # then the exact host staging below); a genuine bug in
+                # the gather stays loud — swallowing it would silently
+                # double-stage every ragged column forever
+                if not _segment.pallas_enabled() or "Mosaic" not in str(e):
+                    raise
+                _segment.disable_pallas(
+                    f"{type(e).__name__} in ragged-gather kernel"
+                )
+                gather = None
+                staged = jax.device_put(
+                    [group_feeds(idx) for idx in wave]
+                )
+        else:
+            staged = jax.device_put([group_feeds(idx) for idx in wave])
         in_flight_r: _deque = _deque()
         for f in staged:
             # freshly-transferred private copies: donation-safe
@@ -1040,28 +1139,45 @@ def _empty_agg_blocks(schema) -> List[Block]:
 
 def _segment_reduce_best(ops_key, num_groups, val_cols, seg_ids):
     """Keyed-reduction backend dispatch, recorded as a cost-model
-    decision: host ``np.bincount`` on the CPU backend for 1-D float
-    sums/means (XLA:CPU's serialized scatter is ~20x slower), the
-    jitted segment program otherwise. Values may be numpy or jax
+    decision (``plan/rules.decide_segment_reduce``): host
+    ``np.bincount`` on the CPU backend for 1-D float sums/means
+    (XLA:CPU's serialized scatter is ~20x slower), the fused pallas
+    segment-reduce kernel on kernel-capable backends
+    (``kernels/segment_reduce.py`` — ONE dispatch for every fetch),
+    the jitted segment program otherwise. Values may be numpy or jax
     arrays; returns numpy columns. EVERY host-frame keyed reduction —
     the eager fast path and the plan's fused epilogues — dispatches
     here, so fused and unfused outputs stay bit-identical whichever
-    backend wins."""
+    backend wins (the strategy choice is deterministic per feed). A
+    Mosaic failure in the kernel trips the process-wide kill-switch
+    (fused-cache invalidation included) and falls through to the
+    jitted scatter — the PR 7 recovery contract."""
     from . import segment as _segment
+    from ..plan.lower import _note_decision
+    from ..plan.rules import decide_segment_reduce
 
-    if _segment.host_segment_eligible(ops_key, val_cols):
-        from ..plan.lower import _note_decision
-        from ..plan.rules import Decision
-
-        _note_decision(Decision(
-            "host_segment_reduce",
-            "CPU backend: bincount's weighted histogram beats XLA's "
-            "serialized segment scatter for float sums",
-            {"num_groups": int(num_groups)},
-        ))
+    decision = decide_segment_reduce(ops_key, val_cols, num_groups)
+    _note_decision(decision)
+    if decision.kind == "host_segment_reduce":
         return _segment.segment_reduce_host(
             ops_key, num_groups, val_cols, seg_ids
         )
+    if decision.kind == "pallas_segment_reduce":
+        from ..kernels import segment_reduce as _ksr
+
+        try:
+            return _ksr.segment_reduce_pallas(
+                ops_key, num_groups, val_cols, seg_ids
+            )
+        except Exception as e:
+            # same triage as run_segment_fast: only a Mosaic kernel-
+            # compile failure justifies the process-wide fallback
+            if not _segment.pallas_enabled() or "Mosaic" not in str(e):
+                raise
+            _segment.disable_pallas(
+                f"{type(e).__name__} in segment-reduce kernel"
+            )
+            _ksr._pallas_fn_for.cache_clear()
     seg_vals = {x: jnp.asarray(val_cols[x]) for x, _ in ops_key}
     # int32 ids: halves the host→HBM id-column transfer (the hot cost
     # on relay-attached chips); group counts can't exceed int32 — the

@@ -116,6 +116,11 @@ _COST_DECISIONS = {
     for kind in (
         "fuse", "split_single_stage", "epilogue_per_block",
         "epilogue_concat", "bucket_segments", "host_segment_reduce",
+        # kernel selection (ISSUE 12): which lowering serves each
+        # measured straggler — plan/rules.decide_segment_reduce /
+        # decide_decode_attention / decide_ragged_gather
+        "pallas_segment_reduce", "jit_segment_reduce",
+        "pallas_decode_attn", "xla_decode_attn", "pallas_ragged_gather",
     )
 }
 

@@ -49,6 +49,9 @@ __all__ = [
     "decide_fuse",
     "decide_epilogue",
     "decide_segment_bucket",
+    "decide_segment_reduce",
+    "decide_decode_attention",
+    "decide_ragged_gather",
     "reassoc_safe",
 ]
 
@@ -377,6 +380,121 @@ def decide_epilogue(
         f"dispatch over device-concatenated values keeps {unsafe} "
         "bit-identical to the unfused path",
         details,
+    )
+
+
+# ---------------------------------------------------------------------------
+# kernel selection (ISSUE 12): which LOWERING serves each straggler —
+# the pallas kernel, the jitted XLA program, or the host path. Pure
+# decisions; the dispatch sites count them through _note_decision and
+# the compile-cache fingerprint carries kernels.fingerprint_token() so
+# a selection flip can never serve a stale executable.
+# ---------------------------------------------------------------------------
+
+def _kernel_backend_ok() -> bool:
+    """Kernels engage on TPU-family backends, or anywhere under the
+    ``TFTPU_PALLAS_FORCE`` test/bench hook (the pallas CPU interpreter
+    runs them — slow, but the full selection wiring executes)."""
+    import jax
+
+    from .. import kernels
+
+    if not kernels.enabled():
+        return False
+    if kernels.force_active():
+        return True
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def decide_segment_reduce(ops_key, val_cols, num_segments: int) -> Decision:
+    """Keyed-reduction strategy for one segment: ``host_segment_reduce``
+    (CPU bincount — the measured XLA:CPU-scatter escape, unchanged),
+    ``pallas_segment_reduce`` (the fused multi-op kernel,
+    ``kernels/segment_reduce.py``), or ``jit_segment_reduce`` (the
+    jitted scatter program). Order matters: the host path keeps CPU
+    float sums (its f64 accumulation is the tighter bound and bincount
+    beats interpreted pallas by orders of magnitude); the kernel takes
+    whatever remains eligible on a kernel-capable backend."""
+    from ..kernels import segment_reduce as _ksr
+    from ..ops.segment import host_segment_eligible
+
+    details = {
+        "num_groups": int(num_segments),
+        "ops": [op for _, op in ops_key],
+    }
+    if host_segment_eligible(ops_key, val_cols):
+        return Decision(
+            "host_segment_reduce",
+            "CPU backend: bincount's weighted histogram beats XLA's "
+            "serialized segment scatter for float sums",
+            details,
+        )
+    if _kernel_backend_ok() and _ksr.eligible(
+        ops_key, val_cols, num_segments
+    ):
+        return Decision(
+            "pallas_segment_reduce",
+            "fused multi-op pallas kernel: every (column, op) partial "
+            "in ONE dispatch (one-hot MXU sums, masked VPU min/max) "
+            "instead of one scatter per fetch",
+            details,
+        )
+    return Decision(
+        "jit_segment_reduce",
+        "jitted XLA segment program (kernel ineligible or disabled)",
+        details,
+    )
+
+
+def decide_decode_attention(
+    num_heads: int, head_dim: int, page_size: int, max_pages: int
+) -> Decision:
+    """Decode-attention lowering for a serving decode engine, chosen
+    ONCE at engine build (both the batched and the solo step trace the
+    same choice — the batched==solo and preemption-replay bit-identity
+    gates therefore hold whichever side wins)."""
+    details = {
+        "heads": int(num_heads), "head_dim": int(head_dim),
+        "page_size": int(page_size), "max_pages": int(max_pages),
+    }
+    if _kernel_backend_ok():
+        return Decision(
+            "pallas_decode_attn",
+            "fused paged int8-KV kernel: pages stream HBM→VMEM through "
+            "the scalar-prefetched page table and dequantize "
+            "in-register — no materialized gather copy",
+            details,
+        )
+    return Decision(
+        "xla_decode_attn",
+        "XLA gather→dequant→attend chain (kernels disabled or no "
+        "Mosaic backend)",
+        details,
+    )
+
+
+def decide_ragged_gather(
+    n_rows: int, n_groups: int, cell_dtype
+) -> Optional[Decision]:
+    """Ragged map_rows staging: the pallas flat-buffer gather
+    (``pallas_ragged_gather``) when the single-1-D-ragged-column fast
+    path applies on a kernel-capable backend; None keeps the host
+    ``np.stack`` staging (not a counted decision — it is the ordinary
+    path, not a choice). The caller additionally verifies the cell
+    shapes and the int32 offset bound before acting on the choice."""
+    import numpy as _np
+
+    if n_rows == 0 or not _kernel_backend_ok():
+        return None
+    if _np.dtype(cell_dtype).kind not in ("f", "i", "u", "b"):
+        return None
+    return Decision(
+        "pallas_ragged_gather",
+        "single 1-D ragged column: cells move as one flat buffer and "
+        "the kernel stages each shape group's padded batch on device "
+        f"({n_groups} shape group(s) — host np.stack and per-group "
+        "transfers eliminated)",
+        {"rows": int(n_rows), "shape_groups": int(n_groups)},
     )
 
 

@@ -174,8 +174,28 @@ class DecodeEngine:
             gen.paged_prefill_fn(model_cfg, cfg.page_size, max_pages),
             label=f"decode.prefill[{name}]",
         )
+        # decode-attention lowering: a counted cost-model decision made
+        # ONCE per engine (ISSUE 12) — batched and solo steps trace the
+        # same choice, so the batched==solo / preemption-replay
+        # bit-identity gates hold whichever lowering wins. The choice
+        # also reaches the compile-cache fingerprint (kernels token),
+        # so a disable_pallas() flip can never serve a stale executable.
+        from ..plan.lower import _note_decision
+        from ..plan.rules import decide_decode_attention
+
+        decision = decide_decode_attention(
+            model_cfg.num_heads, model_cfg.head_dim, cfg.page_size,
+            max_pages,
+        )
+        _note_decision(decision)
+        self._attn_kernel: Optional[str] = (
+            "pallas" if decision.kind == "pallas_decode_attn" else None
+        )
         self._step = aot_jit(
-            gen.paged_decode_step_fn(model_cfg, cfg.page_size, max_pages),
+            gen.paged_decode_step_fn(
+                model_cfg, cfg.page_size, max_pages,
+                attn_kernel=self._attn_kernel,
+            ),
             label=f"decode.step[{name}]",
         )
         # admission: pull mode — no worker thread; the engine loop
@@ -196,6 +216,45 @@ class DecodeEngine:
         self._drain = True
         self._next_seq = 0
         self._join_counter = 0
+
+    def _run_step(self, *args):
+        """Dispatch one batched decode step, honoring the pallas
+        recovery contract: a Mosaic kernel-compile failure trips the
+        process-wide kill-switch (fused-cache invalidation included),
+        rebuilds the step on the XLA gather chain, and retries — a
+        custom kernel must never take down the engine."""
+        from .. import kernels as _kernels
+
+        try:
+            out = self._step(*args)
+        except Exception as e:
+            from ..models import generation as gen
+            from ..ops import segment as _segment
+            from ..ops.executor import aot_jit
+
+            if (
+                self._attn_kernel is None
+                or not _segment.pallas_enabled()
+                or "Mosaic" not in str(e)
+            ):
+                raise
+            _segment.disable_pallas(
+                f"{type(e).__name__} in decode-attention kernel"
+            )
+            self._attn_kernel = None
+            self._step = aot_jit(
+                gen.paged_decode_step_fn(
+                    self.cfg, self.config.page_size,
+                    self._pool.max_pages_per_seq, attn_kernel=None,
+                ),
+                label=f"decode.step[{self.name}]",
+            )
+            out = self._step(*args)
+        if self._attn_kernel is not None:
+            _kernels.note_dispatch(
+                "decode_attn", _kernels.interpret_mode()
+            )
+        return out
 
     # -- introspection ------------------------------------------------------
 
@@ -278,7 +337,7 @@ class DecodeEngine:
                 np.int32(1), null,
             )
         for sb in self._slot_buckets:
-            self._step(
+            self._run_step(
                 self.params, cols, np.zeros(sb, np.int32),
                 np.zeros(sb, np.int32),
                 np.zeros((sb, self._pool.max_pages_per_seq), np.int32),
@@ -596,7 +655,7 @@ class DecodeEngine:
             tokens[row] = s.generated[-1]
             pos[row] = s.pos
             tables[row] = self._pool.table(s.seq)
-        cols, nxt = self._step(
+        cols, nxt = self._run_step(
             self.params, self._pool.columns, tokens, pos, tables
         )
         self._pool.columns = cols

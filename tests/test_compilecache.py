@@ -433,6 +433,47 @@ def test_fingerprint_donate_and_kind_in_key():
     assert program_fingerprint(p, probe=8, kind="vmap") != base
 
 
+def test_fingerprint_kernel_selection_in_key():
+    """ISSUE 12 key-axis regression: the straggler-kernel selection
+    state lives in the env component, so a ``disable_pallas()`` flip,
+    ``TFTPU_PALLAS=0``, or the force hook can never serve a stale
+    executable from the store — and restoring the state restores the
+    key (warmed entries stay warm across a no-op round trip)."""
+    from tensorframes_tpu import configure
+    from tensorframes_tpu.ops import segment
+
+    frame = tfs.frame_from_arrays({"x": np.arange(8.0)})
+    p = tfs.compile_program(lambda x: {"y": x * 3.0}, frame)
+    base = program_fingerprint(p, probe=8)
+
+    was = segment._pallas_disabled
+    try:
+        segment.disable_pallas("fingerprint key test")
+        tripped = program_fingerprint(p, probe=8)
+    finally:
+        segment._pallas_disabled = was
+    assert tripped != base  # the kill-switch is a key axis
+
+    configure(pallas_kernels=False)
+    try:
+        off = program_fingerprint(p, probe=8)
+    finally:
+        configure(pallas_kernels=True)
+    assert off != base
+    # both spell 'kernels disabled' — one executable family serves them
+    assert off == tripped
+
+    configure(pallas_force=True)
+    try:
+        forced = program_fingerprint(p, probe=8)
+    finally:
+        configure(pallas_force=False)
+    assert forced not in (base, off)
+
+    # round trip: restored state keys identically (no gratuitous miss)
+    assert program_fingerprint(p, probe=8) == base
+
+
 # ---------------------------------------------------------------------------
 # topology-fingerprinted keys (ISSUE 10 tentpole)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,550 @@
+"""Straggler pallas kernels (ISSUE 12): bit-identity property sweeps
+against the reference lowerings (CPU pallas interpreter), cost-model
+selection wiring, kill-switch recovery, metrics pre-registration, and
+the zero-row edge pins from the bugfix sweep.
+
+Every kernel gate here is EXACT equality, not allclose: the same-spec
+plain-jnp emulation is bit-identical by construction, the order-free
+op classes (min/max, integer sums) are bit-identical to the XLA
+scatter, and the decode-attention kernel reproduces the XLA
+gather→dequant→attend chain bit-for-bit on the interpreter.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import configure
+from tensorframes_tpu import kernels
+from tensorframes_tpu.kernels import decode_attention as kda
+from tensorframes_tpu.kernels import ragged_gather as krg
+from tensorframes_tpu.kernels import segment_reduce as ksr
+from tensorframes_tpu.observability.metrics import REGISTRY
+from tensorframes_tpu.ops import segment
+from tensorframes_tpu.plan import rules as prules
+
+
+@pytest.fixture
+def forced():
+    """Select the kernels on CPU (interpreter). Also pins
+    ``pallas_kernels=True`` so the selection tests stay meaningful
+    under the CI kernels-off smoke (``TFTPU_PALLAS=0``) — these tests
+    exercise the kernels themselves; the off-smoke's point is the
+    suites that merely COULD select them."""
+    from tensorframes_tpu.config import get_config
+
+    cfg = get_config()
+    was_force, was_kernels = cfg.pallas_force, cfg.pallas_kernels
+    configure(pallas_force=True, pallas_kernels=True)
+    try:
+        yield
+    finally:
+        configure(pallas_force=was_force, pallas_kernels=was_kernels)
+
+
+def _assert_eq(a, b, msg):
+    assert a.dtype == b.dtype, (msg, a.dtype, b.dtype)
+    assert a.shape == b.shape, (msg, a.shape, b.shape)
+    np.testing.assert_array_equal(a, b, err_msg=msg)
+
+
+# ---------------------------------------------------------------------------
+# segment reduce
+# ---------------------------------------------------------------------------
+
+_SWEEP_DTYPES = ("float32", "int32", "int8", "bool")
+
+
+@pytest.mark.parametrize("n,s", [(0, 3), (1, 1), (37, 5), (1000, 64),
+                                 (300, 1), (513, 9)])
+def test_segment_reduce_sweep_bit_identical(n, s):
+    """ops × dtypes × segment counts (0-row, 1-segment, tile-crossing):
+    pallas == same-spec reference emulation bitwise, and == the XLA
+    scatter for the order-free classes."""
+    rng = np.random.default_rng(n * 31 + s)
+    ids = rng.integers(0, s, n).astype(np.int32)  # unsorted by nature
+    cols = {
+        "f_sum": rng.standard_normal(n).astype(np.float32),
+        "f_mean": rng.standard_normal(n).astype(np.float32),
+        "f_min": rng.standard_normal((n, 3)).astype(np.float32),
+        "i_sum": rng.integers(-50, 50, (n, 2)).astype(np.int32),
+        "i_max": rng.integers(-50, 50, n).astype(np.int8),
+        "b_min": rng.integers(0, 2, n).astype(bool),
+    }
+    ops = (
+        ("f_sum", "reduce_sum"), ("f_mean", "reduce_mean"),
+        ("f_min", "reduce_min"), ("i_sum", "reduce_sum"),
+        ("i_max", "reduce_max"), ("b_min", "reduce_min"),
+    )
+    assert ksr.eligible(ops, cols, s)
+    got = ksr.segment_reduce_pallas(ops, s, cols, ids, interpret=True)
+    ref = ksr.segment_reduce_reference(ops, s, cols, ids)
+    for k in got:
+        assert np.array_equal(got[k], ref[k], equal_nan=True), k
+        assert got[k].dtype == ref[k].dtype
+    if n:
+        # order-free classes are additionally exactly the scatter
+        sidx = jnp.asarray(ids)
+        _assert_eq(
+            got["i_sum"],
+            np.asarray(jax.ops.segment_sum(
+                jnp.asarray(cols["i_sum"]), sidx, num_segments=s
+            )),
+            "int sum vs scatter",
+        )
+        _assert_eq(
+            got["f_min"],
+            np.asarray(jax.ops.segment_min(
+                jnp.asarray(cols["f_min"]), sidx, num_segments=s
+            )),
+            "float min vs scatter",
+        )
+        _assert_eq(
+            got["i_max"],
+            np.asarray(jax.ops.segment_max(
+                jnp.asarray(cols["i_max"]), sidx, num_segments=s
+            )),
+            "int8 max vs scatter",
+        )
+
+
+def test_segment_reduce_empty_segments_mean_is_nan():
+    """Segments past the max observed id (the bucketing shape): sums
+    read 0, means read NaN — and pallas matches the emulation on the
+    NaN slots bit-for-bit."""
+    ids = np.asarray([0, 0, 2], np.int32)
+    cols = {"v": np.asarray([1.0, 3.0, 5.0], np.float32)}
+    ops = (("v", "reduce_mean"),)
+    got = ksr.segment_reduce_pallas(ops, 5, cols, ids, interpret=True)
+    ref = ksr.segment_reduce_reference(ops, 5, cols, ids)
+    assert np.array_equal(got["v"], ref["v"], equal_nan=True)
+    assert got["v"][0] == pytest.approx(2.0)
+    assert np.isnan(got["v"][1]) and np.isnan(got["v"][3])
+
+
+def test_segment_reduce_eligibility_gates():
+    f64 = {"v": np.zeros(4, np.float64)}
+    assert not ksr.eligible((("v", "reduce_sum"),), f64, 2)
+    i64 = {"v": np.zeros(4, np.int64)}
+    assert not ksr.eligible((("v", "reduce_sum"),), i64, 2)
+    ok = {"v": np.zeros(4, np.float32)}
+    assert not ksr.eligible((("v", "reduce_sum"),), ok, 0)
+    assert not ksr.eligible(
+        (("v", "reduce_sum"),), ok, ksr.MAX_SEGMENTS + 1
+    )
+    # a min/max whose [tile, segments, d] broadcast cannot fit the
+    # budget even at the 8-row tile floor is refused
+    wide = {"v": np.zeros((4, 4096), np.float32)}
+    assert not ksr.eligible((("v", "reduce_min"),), wide, 4096)
+    assert ksr.eligible((("v", "reduce_min"),), ok, 64)
+
+
+def test_aggregate_forced_kernel_bit_identical(forced):
+    """End-to-end: the cost model selects pallas_segment_reduce under
+    force, and the aggregate result is bit-identical to the unforced
+    run (exact op classes: min + integer sum)."""
+    before = REGISTRY.counter(
+        "tftpu_plan_cost_decisions_total",
+        labels={"decision": "pallas_segment_reduce"},
+    ).value
+
+    def run():
+        rng = np.random.default_rng(7)
+        n = 400
+        frame = tfs.frame_from_arrays(
+            {
+                "k": rng.integers(0, 9, n),
+                "v": rng.standard_normal(n).astype(np.float32),
+                "w": rng.integers(-10, 10, n).astype(np.int32),
+            },
+            num_blocks=3,
+        )
+        with tfs.with_graph():
+            v_input = tfs.block(frame, "v", tf_name="v_input")
+            w_input = tfs.block(frame, "w", tf_name="w_input")
+            agg = tfs.aggregate(
+                [tfs.reduce_min(v_input, axis=0, name="v"),
+                 tfs.reduce_sum(w_input, axis=0, name="w")],
+                frame.group_by("k"),
+            )
+        return sorted(
+            (int(r["k"]), float(r["v"]), int(r["w"]))
+            for r in agg.collect()
+        )
+
+    forced_res = run()
+    assert REGISTRY.counter(
+        "tftpu_plan_cost_decisions_total",
+        labels={"decision": "pallas_segment_reduce"},
+    ).value > before
+    configure(pallas_force=False)
+    assert run() == forced_res
+
+
+def test_segment_reduce_kill_switch_recovery(forced, monkeypatch):
+    """A Mosaic failure in the kernel trips the process-wide
+    kill-switch and the SAME call returns the jitted scatter's answer —
+    the PR 7 recovery contract."""
+    from tensorframes_tpu.ops import verbs
+
+    was = segment._pallas_disabled
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("Mosaic lowering failed (test)")
+
+    monkeypatch.setattr(ksr, "segment_reduce_pallas", boom)
+    try:
+        rng = np.random.default_rng(3)
+        cols = {"v": rng.integers(-5, 5, 64).astype(np.int32)}
+        ids = rng.integers(0, 4, 64).astype(np.int32)
+        out = verbs._segment_reduce_best(
+            (("v", "reduce_sum"),), 4, cols, ids
+        )
+        assert calls["n"] == 1
+        assert not segment.pallas_enabled()  # switch tripped
+        _assert_eq(
+            out["v"],
+            np.asarray(jax.ops.segment_sum(
+                jnp.asarray(cols["v"]), jnp.asarray(ids),
+                num_segments=4,
+            )),
+            "fallback answer",
+        )
+    finally:
+        segment._pallas_disabled = was
+
+
+def test_non_mosaic_kernel_error_stays_loud(forced, monkeypatch):
+    from tensorframes_tpu.ops import verbs
+
+    def boom(*a, **k):
+        raise RuntimeError("genuine bug, not a kernel-compile failure")
+
+    monkeypatch.setattr(ksr, "segment_reduce_pallas", boom)
+    with pytest.raises(RuntimeError, match="genuine bug"):
+        verbs._segment_reduce_best(
+            (("v", "reduce_sum"),), 2,
+            {"v": np.zeros(8, np.int32)},
+            np.zeros(8, np.int32),
+        )
+    assert segment.pallas_enabled()  # the switch must NOT trip
+
+
+# ---------------------------------------------------------------------------
+# ragged gather
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32"])
+def test_ragged_gather_bit_identical_to_stack(dtype):
+    rng = np.random.default_rng(11)
+    cells = [
+        rng.standard_normal(int(rng.integers(1, 40))).astype(dtype)
+        for _ in range(80)
+    ]
+    lens = np.asarray([len(c) for c in cells])
+    starts = np.zeros(len(cells), np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    flat = np.concatenate(cells)
+    flat_dev = jnp.asarray(flat)
+    for L in np.unique(lens):
+        idx = np.flatnonzero(lens == L)
+        st = starts[idx]
+        got = np.asarray(krg.ragged_gather_rows(
+            flat_dev, st, int(L), interpret=True
+        ))
+        _assert_eq(got, krg.gather_reference(flat, st, int(L)),
+                   f"length {L}")
+    # padding rows re-reading offset 0 (the bucket-pad convention)
+    st = np.zeros(4, np.int32)
+    st[:2] = starts[:2]
+    got = np.asarray(krg.ragged_gather_rows(
+        flat_dev, st, int(lens[0]), interpret=True
+    ))
+    _assert_eq(got, krg.gather_reference(flat, st, int(lens[0])),
+               "padded rows")
+
+
+def test_ragged_gather_rejects_zero_length():
+    with pytest.raises(ValueError, match="length >= 1"):
+        krg.ragged_gather_rows(jnp.zeros(4), np.zeros(2), 0)
+
+
+def test_ragged_map_rows_forced_kernel_bit_identical(forced):
+    before = REGISTRY.counter(
+        "tftpu_plan_cost_decisions_total",
+        labels={"decision": "pallas_ragged_gather"},
+    ).value
+
+    def run():
+        rng = np.random.default_rng(0)
+        lens = rng.choice([3, 5, 8, 13], 150)
+        rows = [{"v": np.arange(n, dtype=np.float32) + 0.25}
+                for n in lens]
+        frame = tfs.frame_from_rows(rows, num_blocks=3)
+        program = tfs.compile_program(
+            lambda v: {"s": v.sum()}, frame, block=False
+        )
+        out = tfs.map_rows(program, frame)
+        return np.concatenate(
+            [np.asarray(b["s"]) for b in out.blocks()]
+        )
+
+    forced_res = run()
+    assert REGISTRY.counter(
+        "tftpu_plan_cost_decisions_total",
+        labels={"decision": "pallas_ragged_gather"},
+    ).value > before
+    configure(pallas_force=False)
+    _assert_eq(run(), forced_res, "ragged map_rows forced vs host")
+
+
+# -- bugfix-sweep pins: zero-row edges of the ragged fallback ---------------
+
+def test_group_rows_by_shape_zero_rows_yields_no_groups():
+    from tensorframes_tpu.ops.verbs import _group_rows_by_shape
+
+    assert _group_rows_by_shape({"v": []}, ["v"], 0) == []
+
+
+def test_ragged_rows_outs_zero_rows_returns_typed_empties():
+    from tensorframes_tpu.ops.verbs import _ragged_rows_outs
+
+    tiny = tfs.frame_from_rows(
+        [{"v": np.arange(3, dtype=np.float32)}]
+    )
+    program = tfs.compile_program(
+        lambda v: {"s": v.sum()}, tiny, block=False
+    )
+    outs = _ragged_rows_outs(
+        {"v": []}, ["v"], 0, program, program.compiled()
+    )
+    assert outs["s"].shape == (0,)
+    assert outs["s"].dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "S,maxp,page,nh,hd",
+    [(1, 1, 4, 2, 8), (5, 3, 8, 4, 16), (8, 2, 16, 2, 4)],
+)
+def test_paged_decode_attention_bit_identical(S, maxp, page, nh, hd):
+    """Kernel vs the XLA gather→dequant→attend chain across slot/page
+    mixes — including a padding slot with an all-null table."""
+    rng = np.random.default_rng(S * 7 + maxp)
+    P, L = maxp * S + 1, 2
+    q = jnp.asarray(rng.standard_normal((S, nh, hd)), jnp.float32)
+    kp = jnp.asarray(
+        rng.integers(-127, 128, (P, L, nh, page, hd)), jnp.int8
+    )
+    vp = jnp.asarray(
+        rng.integers(-127, 128, (P, L, nh, page, hd)), jnp.int8
+    )
+    ks = jnp.asarray(
+        rng.uniform(0.01, 0.1, (P, L, nh, page, 1)), jnp.float32
+    )
+    vs = jnp.asarray(
+        rng.uniform(0.01, 0.1, (P, L, nh, page, 1)), jnp.float32
+    )
+    tables = jnp.asarray(
+        rng.integers(1, P, (S, maxp)), jnp.int32
+    ).at[-1].set(0)  # padding slot: all-null table
+    pos = jnp.asarray(
+        rng.integers(0, maxp * page, S), jnp.int32
+    ).at[-1].set(0)
+    for li in range(L):
+        got = np.asarray(kda.paged_decode_attention(
+            q, kp, vp, ks, vs, li, tables, pos, interpret=True
+        ))
+        ref = np.asarray(kda.paged_attention_reference(
+            q, kp, vp, ks, vs, li, tables, pos
+        ))
+        _assert_eq(got, ref, f"layer {li}")
+
+
+def test_ops_attention_paged_wrapper():
+    from tensorframes_tpu.ops.attention import paged_decode_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 2, 4)), jnp.float32)
+    kp = jnp.asarray(rng.integers(-5, 5, (3, 1, 2, 4, 4)), jnp.int8)
+    ks = jnp.ones((3, 1, 2, 4, 1), jnp.float32)
+    tables = jnp.asarray([[1, 2], [0, 0]], jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    got = paged_decode_attention(
+        q, kp, kp, ks, ks, 0, tables, pos, interpret=True
+    )
+    ref = kda.paged_attention_reference(
+        q, kp, kp, ks, ks, 0, tables, pos
+    )
+    _assert_eq(np.asarray(got), np.asarray(ref), "public wrapper")
+
+
+def test_decode_engine_forced_kernel_matches_oracle(forced):
+    """Slot/page mixes through the real engine with the kernel
+    selected: tokens bit-identical to the unforced engine AND to the
+    dense int8-KV ``generate()`` oracle."""
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+    from tensorframes_tpu.serving.decode import (
+        DecodeConfig, DecodeEngine,
+    )
+
+    cfg = gen.gpt_tiny()
+    params = tr.init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    new = 4
+    prompts = [
+        rng.integers(
+            0, cfg.vocab_size, (int(rng.integers(2, 9)),)
+        ).astype(np.int32)
+        for _ in range(4)
+    ]
+
+    def run():
+        eng = DecodeEngine("kern-t", cfg, params, DecodeConfig(
+            max_slots=2, page_size=4, max_prompt_len=8,
+            max_new_tokens=new,
+        ))
+        eng.start()
+        try:
+            futs = [eng.submit({"prompt": p}) for p in prompts]
+            return [f.result(300)["tokens"] for f in futs]
+        finally:
+            eng.stop(drain=True, timeout=120)
+
+    forced_outs = run()
+    assert kernels.DISPATCHES["decode_attn"].value > 0
+    configure(pallas_force=False)
+    base_outs = run()
+    for i, p in enumerate(prompts):
+        _assert_eq(forced_outs[i], base_outs[i], f"req {i} vs XLA chain")
+        oracle = np.asarray(
+            gen.generate(cfg, params, p[None, :], new, kv_quant=True)
+        )
+        _assert_eq(forced_outs[i], oracle, f"req {i} vs oracle")
+
+
+def test_decode_engine_mosaic_failure_recovers(forced):
+    """The engine survives a kernel-compile failure: kill-switch trips,
+    the step rebuilds on the XLA chain, the request still completes."""
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+    from tensorframes_tpu.serving.decode import (
+        DecodeConfig, DecodeEngine,
+    )
+
+    cfg = gen.gpt_tiny()
+    params = tr.init_params(cfg, seed=0)
+    was = segment._pallas_disabled
+    eng = DecodeEngine("kern-moz", cfg, params, DecodeConfig(
+        max_slots=2, page_size=4, max_prompt_len=8, max_new_tokens=3,
+        warmup=False,
+    ))
+    assert eng._attn_kernel == "pallas"
+    real_step = eng._step
+    state = {"failed": False}
+
+    def flaky(*args):
+        if not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("Mosaic lowering failed (test)")
+        return real_step(*args)
+
+    eng._step = flaky
+    try:
+        eng.start()
+        out = eng.call(
+            {"prompt": np.asarray([1, 2, 3], np.int32)}, timeout=300
+        )
+        assert out["tokens"].shape == (1, 3)
+        assert state["failed"]
+        assert eng._attn_kernel is None  # rebuilt on the XLA chain
+        assert not segment.pallas_enabled()
+        oracle = np.asarray(gen.generate(
+            cfg, params, np.asarray([[1, 2, 3]], np.int32), 3,
+            kv_quant=True,
+        ))
+        _assert_eq(out["tokens"], oracle, "post-recovery tokens")
+    finally:
+        eng.stop(drain=False, timeout=60)
+        segment._pallas_disabled = was
+
+
+# ---------------------------------------------------------------------------
+# selection, registry, and switches
+# ---------------------------------------------------------------------------
+
+def test_decisions_on_cpu_default_to_non_pallas():
+    cols = {"v": np.zeros(8, np.int32)}
+    assert prules.decide_segment_reduce(
+        (("v", "reduce_sum"),), cols, 4
+    ).kind == "jit_segment_reduce"
+    assert prules.decide_decode_attention(4, 8, 4, 2).kind == \
+        "xla_decode_attn"
+    assert prules.decide_ragged_gather(10, 2, np.float32) is None
+
+
+def test_decisions_under_force_pick_pallas(forced):
+    cols = {"v": np.zeros(8, np.int32)}
+    assert prules.decide_segment_reduce(
+        (("v", "reduce_sum"),), cols, 4
+    ).kind == "pallas_segment_reduce"
+    assert prules.decide_decode_attention(4, 8, 4, 2).kind == \
+        "pallas_decode_attn"
+    assert prules.decide_ragged_gather(
+        10, 2, np.float32
+    ).kind == "pallas_ragged_gather"
+
+
+def test_host_segment_reduce_still_wins_cpu_float_sums(forced):
+    """The measured CPU bincount win outranks the kernel even under
+    force: 1-D float sums/means stay on the host path."""
+    cols = {"v": np.zeros(8, np.float32)}
+    assert prules.decide_segment_reduce(
+        (("v", "reduce_mean"),), cols, 4
+    ).kind == "host_segment_reduce"
+
+
+def test_tftpu_pallas_off_removes_kernels_everywhere(forced):
+    configure(pallas_kernels=False)
+    assert not kernels.enabled()
+    cols = {"v": np.zeros(8, np.int32)}
+    assert prules.decide_segment_reduce(
+        (("v", "reduce_sum"),), cols, 4
+    ).kind == "jit_segment_reduce"
+    assert prules.decide_decode_attention(4, 8, 4, 2).kind == \
+        "xla_decode_attn"
+    assert prules.decide_ragged_gather(
+        10, 2, np.float32
+    ) is None  # the forced fixture restores the prior switch state
+
+
+def test_kill_switch_disables_kernels_package():
+    was = segment._pallas_disabled
+    try:
+        segment.disable_pallas("kernels package test")
+        assert not kernels.enabled()
+        assert kernels.fingerprint_token()["enabled"] is False
+    finally:
+        segment._pallas_disabled = was
+
+
+def test_kernels_metrics_preregistered():
+    names = {m.name for m in REGISTRY.collect()}
+    assert "tftpu_kernels_dispatch_total" in names
+    assert "tftpu_kernels_interpret_fallback_total" in names
+    assert "tftpu_kernels_build_seconds" in names
+    labels = {
+        dict(m.labels).get("kernel")
+        for m in REGISTRY.collect()
+        if m.name == "tftpu_kernels_dispatch_total"
+    }
+    assert labels == set(kernels.KERNELS)

@@ -162,3 +162,60 @@ def test_aggregate_retries_after_kernel_compile_failure(monkeypatch):
             )
     finally:
         segment._pallas_disabled = was
+
+
+# ---------------------------------------------------------------------------
+# segment_reduce_host edge pins (ISSUE 12 bugfix sweep)
+# ---------------------------------------------------------------------------
+
+def test_host_reduce_zero_rows_returns_zeros_and_nan_means():
+    """Empty feed: ``np.asarray([])`` is float64 and bincount rejects
+    float ids — the host path must short-circuit instead, producing
+    zeros for sums and 0/0 → NaN for means (the jitted program's exact
+    empty-segment bits), in the value dtype, without warnings."""
+    import warnings
+
+    for seg_ids in (np.asarray([], np.int64), []):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            out = segment.segment_reduce_host(
+                (("a", "reduce_sum"), ("b", "reduce_mean")),
+                3,
+                {"a": np.asarray([], np.float32),
+                 "b": np.asarray([], np.float64)},
+                seg_ids,
+            )
+        assert out["a"].dtype == np.float32
+        np.testing.assert_array_equal(out["a"], np.zeros(3, np.float32))
+        assert out["b"].dtype == np.float64
+        assert np.isnan(out["b"]).all()
+
+
+def test_host_reduce_all_padding_segments_mean_is_silent_nan():
+    """Segments past the max observed id (the bucketing shape): means
+    read NaN on the padded slots without a numpy warning leaking, and
+    the real slots carry the bincount answer."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = segment.segment_reduce_host(
+            (("v", "reduce_mean"),),
+            6,
+            {"v": np.asarray([2.0, 4.0, 10.0], np.float32)},
+            np.asarray([1, 1, 3]),
+        )
+    assert out["v"][1] == pytest.approx(3.0)
+    assert out["v"][3] == pytest.approx(10.0)
+    assert np.isnan(out["v"][[0, 2, 4, 5]]).all()
+
+
+def test_host_reduce_list_seg_ids_cast_to_int():
+    """Python-list ids (the eager path can hand them over) bincount
+    fine after the intp cast."""
+    out = segment.segment_reduce_host(
+        (("v", "reduce_sum"),), 2,
+        {"v": np.asarray([1.5, 2.5, 4.0], np.float32)},
+        [0, 1, 0],
+    )
+    np.testing.assert_allclose(out["v"], [5.5, 2.5])
