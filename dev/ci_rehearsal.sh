@@ -171,6 +171,18 @@ run_step "Serving decode smoke (iterative decode engine, paged KV pool)" bash -c
   test -s '$WORK/obs/serving_decode_trace.json'
 "
 
+# ci.yml's serving-fleet smoke (ISSUE 13): a supervised 2-replica
+# serving fleet behind the router ingress, one replica SIGKILLed under
+# open-loop load — exits nonzero on any lost request, an unbounded
+# post-kill p99 window, or a restarted replica that compiled instead of
+# warming from the shared store; tftpu_router_* metrics ride the
+# observability artifacts
+run_step "Serving fleet smoke (kill -9 a replica under open-loop load)" bash -c "
+  env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.serving_fleet_main()\" &&
+  test -s '$WORK/obs/serving_fleet_metrics.jsonl' &&
+  test -s '$WORK/obs/serving_fleet_trace.json'
+"
+
 # ci.yml's fleet chaos-drill step: kill-rank + hung-collective +
 # drop-heartbeat on a 2-process CPU fleet, with the flight black box
 # spooled next to the other observability artifacts

@@ -199,6 +199,102 @@ def drill_fleet_chaos(root: str) -> str:
             "drop-heartbeat detected with coordinated abort" + shipped)
 
 
+def drill_serving_fleet(root: str) -> str:
+    """Serving-fleet chaos (ISSUE 13): a 2-replica supervised serving
+    fleet where the ``serving.replica`` kill site SIGKILLs rank 1
+    mid-run (armed via env in the victim — deterministic KillRank
+    chaos, no code in the drill doing the killing), under a trickle of
+    routed requests. The fleet must answer every request (redriving any
+    caught in flight), restart the dead replica from the SHARED compile
+    store with zero XLA compiles, and the ``router.dispatch`` Delay
+    site must convert a stalled dispatch into a counted 504 — never a
+    hang."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from tensorframes_tpu.resilience import faults
+    from tensorframes_tpu.serving import ServingFleet
+
+    cmd = [
+        sys.executable, "-m", "tensorframes_tpu.serving.replica_main",
+        "--demo", "--max-batch-rows", "8",
+    ]
+    fleet = ServingFleet(
+        cmd, 2,
+        rendezvous_dir=os.path.join(root, "serving-fleet"),
+        heartbeat_timeout_s=3.0,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "TFTPU_HEARTBEAT_INTERVAL_S": "0.1",
+            # the victim arms its own kill: rank 1, attempt 0, after
+            # ~20 main-loop beats (~1s) — the registered
+            # `serving.replica` kill_point fires, not an external kill
+            "TFTPU_SERVING_CHAOS_KILL_AFTER": "20",
+            "TFTPU_SERVING_CHAOS_KILL_RANK": "1",
+        },
+    )
+    fleet.start()
+
+    def post(body, timeout=90):
+        req = urllib.request.Request(
+            fleet.url + "/v1/score", data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read() or b"{}")
+
+    try:
+        n_ok = 0
+        for i in range(60):  # ~3s of trickle load across the kill
+            st, _ = post({"inputs": {"x": [[float(i % 5)] * 8]},
+                          "deadline_s": 60.0})
+            if st != 200:
+                raise AssertionError(f"request {i} got {st}, not 200")
+            n_ok += 1
+            time.sleep(0.05)
+        deadline = time.time() + 90.0
+        while 1 not in fleet.restart_reports and time.time() < deadline:
+            time.sleep(0.1)
+        report = fleet.restart_reports.get(1)
+        if not report:
+            raise AssertionError(
+                f"killed replica never restarted: {fleet.status()}"
+            )
+        if report.get("xla_compiles") != 0 or \
+                (report.get("compile_cache_hits") or 0) < 1:
+            raise AssertionError(
+                f"restarted replica was not store-warm: {report}"
+            )
+        if fleet.restarts != 1:
+            raise AssertionError(
+                f"expected exactly 1 restart, got {fleet.restarts}"
+            )
+        # router.dispatch Delay chaos: the stalled dispatch must become
+        # a counted 504 under the request deadline, never a hang
+        with faults.inject("router.dispatch", faults.Delay(0.5)):
+            st, body = post({"inputs": {"x": [[1.0] * 8]},
+                             "deadline_s": 0.2})
+        if st != 504:
+            raise AssertionError(
+                f"delayed dispatch returned {st}, expected 504: {body}"
+            )
+        return (
+            f"{n_ok} routed requests all answered through a "
+            f"kill_point SIGKILL of replica 1 (redrives="
+            f"{fleet.status()['router']['redrives']}); restart was "
+            f"store-warm (0 XLA compiles, "
+            f"{report['compile_cache_hits']} store hits, "
+            f"{report['recovery_s']}s recovery); delayed dispatch "
+            "expired as a counted 504"
+        )
+    finally:
+        fleet.stop()
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -213,6 +309,7 @@ def main(argv=None) -> int:
         ("corrupted-restore", drill_corrupted_restore),
         ("transient-faults", drill_transient_faults),
         ("fleet-chaos", drill_fleet_chaos),
+        ("serving-fleet", drill_serving_fleet),
     ]
     names = [n for n, _ in drills]
     for sel in args.only + args.skip:

@@ -261,6 +261,26 @@ register_site(
     "enrolled rank can be deterministically preempted mid-run)",
 )
 
+# Serving-fleet chaos sites (ISSUE 13). Registered here — not at the
+# instrumenting modules — because the drills that arm them (tests,
+# dev/resilience_drill.py serving-fleet leg) must see them in the
+# catalog even in processes that never import the serving package.
+register_site(
+    "router.dispatch",
+    "serving/router.py Router.dispatch, before each router→replica "
+    "attempt — an injected Delay stalls the proxied dispatch (deadline-"
+    "expiry chaos at the ingress); any other injected error fails the "
+    "attempt exactly like a dead replica socket, driving the redrive "
+    "path deterministically",
+)
+register_site(
+    "serving.replica",
+    "serving/replica.py serve_replica main loop — an injected KillRank "
+    "SIGKILLs the replica process (the serving-fleet kill-replica "
+    "chaos: the fleet must reroute, redrive, and restart it); other "
+    "injected errors crash the loop into the nonzero-exit path",
+)
+
 
 @contextmanager
 def inject(

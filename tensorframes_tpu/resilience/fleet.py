@@ -77,6 +77,8 @@ __all__ = [
     "rendezvous_dir",
     "write_beat",
     "read_heartbeats",
+    "write_json_atomic",
+    "read_latest_records",
     "fleet_status",
     "signal_abort",
     "abort_requested",
@@ -199,6 +201,48 @@ def _hb_path(directory: str, run_id: str, rank: int) -> str:
     return os.path.join(directory, f"hb_{run_id}_p{rank}.json")
 
 
+def write_json_atomic(path: str, rec: dict) -> str:
+    """Publish one JSON record atomically (tmp-write + rename) — a
+    reader never sees a torn record. Shared by heartbeats and the
+    serving fleet's replica cards."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_latest_records(
+    directory: str,
+    pattern: str,
+    run_id: Optional[str] = None,
+    *,
+    rank_field: str = "process_index",
+) -> Dict[int, dict]:
+    """The newest record per rank (``{rank: record}``) matching
+    ``pattern``, filtered to ``run_id`` when given. Tolerates
+    unreadable/foreign files — a monitor must never crash on a
+    half-provisioned dir. The ONE tolerant-read used by heartbeats and
+    replica cards."""
+    out: Dict[int, dict] = {}
+    for path in _glob.glob(os.path.join(directory, pattern)):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        try:
+            rank = int(rec[rank_field])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if run_id is not None and rec.get("run_id") != run_id:
+            continue
+        prev = out.get(rank)
+        if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
+            out[rank] = rec
+    return out
+
+
 def write_beat(
     directory: str,
     *,
@@ -225,38 +269,18 @@ def write_beat(
         "stopped": bool(stopped),
     }
     os.makedirs(directory, exist_ok=True)
-    path = _hb_path(directory, rec["run_id"], rank)
-    tmp = f"{path}.tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(rec, f)
-    os.replace(tmp, path)
-    return path
+    return write_json_atomic(
+        _hb_path(directory, rec["run_id"], rank), rec
+    )
 
 
 def read_heartbeats(
     directory: str, run_id: Optional[str] = None
 ) -> Dict[int, dict]:
     """The newest published beat per rank (``{rank: record}``), filtered
-    to ``run_id`` when given. Tolerates unreadable/foreign files — a
-    monitor must never crash on a half-provisioned dir."""
-    out: Dict[int, dict] = {}
+    to ``run_id`` when given (see :func:`read_latest_records`)."""
     pattern = f"hb_{run_id}_p*.json" if run_id else "hb_*_p*.json"
-    for path in _glob.glob(os.path.join(directory, pattern)):
-        try:
-            with open(path) as f:
-                rec = json.load(f)
-        except (OSError, ValueError):
-            continue
-        try:
-            rank = int(rec["process_index"])
-        except (KeyError, TypeError, ValueError):
-            continue
-        if run_id is not None and rec.get("run_id") != run_id:
-            continue
-        prev = out.get(rank)
-        if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
-            out[rank] = rec
-    return out
+    return read_latest_records(directory, pattern, run_id)
 
 
 @dataclass

@@ -31,6 +31,15 @@ continuous batching over a block-paged int8 KV pool
 every step, the pool preempts (evict + requeue + bit-identical resume)
 when full, and the same four contracts hold per token instead of per
 flush. See docs/serving.md ("Iterative decode").
+
+ISSUE 13 scales it out: :class:`ServingFleet` runs N supervised
+replica servers (heartbeats, per-replica crash restart, ONE shared
+compile store so restarts warm with zero XLA compiles) behind a
+:class:`Router` ingress that load-balances by queue depth, routes only
+to ``state=running`` replicas, and redrives failed dispatches to
+survivors under the original deadline with idempotency-key dedup —
+every admitted request gets exactly one response through a ``kill -9``.
+See docs/serving.md ("Scale-out").
 """
 
 from __future__ import annotations
@@ -44,7 +53,10 @@ from .batcher import (  # noqa: F401
     ServingError,
 )
 from .decode import DecodeConfig, DecodeEngine  # noqa: F401
+from .fleet import FleetDegradedError, ServingFleet  # noqa: F401
 from .http import serve_http  # noqa: F401
+from .replica import serve_replica  # noqa: F401
+from .router import Router, RouterConfig  # noqa: F401
 from .kvpool import (  # noqa: F401
     PagedKVPool,
     PoolAccountingError,
@@ -73,5 +85,10 @@ __all__ = [
     "PoolAccountingError",
     "PoolExhaustedError",
     "serve_http",
+    "serve_replica",
+    "Router",
+    "RouterConfig",
+    "ServingFleet",
+    "FleetDegradedError",
     "metrics",
 ]

@@ -1,29 +1,50 @@
-"""Thin HTTP adapter over :class:`~tensorframes_tpu.serving.Server`.
+"""Thin, hardened HTTP adapter over :class:`~tensorframes_tpu.serving.Server`.
 
 The in-process future API is the real surface; this adapter exists so a
-sidecar/load-generator can speak to a server without linking Python —
-the same daemon-thread ``ThreadingHTTPServer`` shape as
+sidecar/load-generator/fleet router can speak to a server without
+linking Python — the same daemon-thread ``ThreadingHTTPServer`` shape as
 ``observability.metrics_server`` (one file, stdlib only, no framework).
 
 Routes:
 
 * ``POST /v1/<endpoint>`` — body ``{"inputs": {col: value|nested list},
-  "deadline_s": float?}``; each handler thread blocks on its request's
-  future (the batcher coalesces across concurrent handlers — the
-  threaded server IS the concurrency source). Replies
-  ``{"outputs": {...}, "rows": n, "latency_s": ...}``.
-* ``GET /healthz`` — ``Server.stats()`` (running flag, endpoints,
-  queue depths, admission counters).
+  "deadline_s": float?, "idempotency_key": str?}``; each handler thread
+  blocks on its request's future (the batcher coalesces across
+  concurrent handlers — the threaded server IS the concurrency source).
+  Replies ``{"outputs": {...}, "rows": n, "latency_s": ...}``. The
+  idempotency key rides straight into ``Server.submit`` — a redriven
+  dispatch joins the original future instead of re-executing.
+* ``GET /healthz`` — ``Server.stats()``: the lifecycle ``state``
+  (``starting|running|draining|stopped``), queue depths, admission
+  counters, and process compile counters — everything the fleet router
+  scrapes.
+* ``POST /admin/drain`` — triggers ``Server.drain()`` (admission
+  closes, queued work completes) and replies 202 with the state; the
+  rolling-restart hook. Poll ``/healthz`` for ``draining`` →
+  ``stopped``.
 
 Status mapping keeps the failure taxonomy visible to load balancers:
-400 malformed/validation, 404 unknown endpoint, 429 ``queue_full`` /
-``too_large`` (backpressure shed — retry with backoff), 503 ``closed``
-(draining/stopped), 504 deadline expired, 500 dispatch error.
+400 malformed/validation, 404 unknown endpoint, 408 read timeout, 413
+body over the ingress limit, 429 ``queue_full`` / ``too_large``
+(backpressure shed — retry with backoff), 503 ``closed``
+(draining/stopped) or connection bound reached, 504 deadline expired,
+500 dispatch error.
+
+Ingress hardening (ISSUE 13): the transport sheds BEFORE admission —
+request bodies over ``max_body_bytes`` get 413, a connection whose read
+stalls past ``read_timeout_s`` is closed (408 when a reply is still
+possible), and connections beyond ``max_connections`` get an immediate
+503 — each counted by reason in
+``tftpu_serving_rejections_total{reason=}``. Bounded the same way the
+batcher's queue is: overload sheds with a counted refusal, never an
+unbounded buffer or a hang.
 """
 
 from __future__ import annotations
 
 import json
+import socket
+import threading
 import time
 from typing import Optional
 
@@ -31,31 +52,237 @@ from ..utils import get_logger
 from ..validation import ValidationError
 from .batcher import DeadlineExceededError, RejectedError
 from .server import Server, UnknownEndpointError
+from . import metrics as m
 
 logger = get_logger(__name__)
 
-__all__ = ["serve_http"]
+__all__ = [
+    "serve_http", "make_hardened_http_server", "read_bounded_body",
+    "reply_json", "parse_json_object",
+    "DEFAULT_MAX_BODY_BYTES", "DEFAULT_READ_TIMEOUT_S",
+    "DEFAULT_MAX_CONNECTIONS",
+]
+
+#: Ingress defaults: generous for row-batch JSON, bounded for a server
+#: that must survive a misbehaving client.
+DEFAULT_MAX_BODY_BYTES = 8 << 20
+DEFAULT_READ_TIMEOUT_S = 30.0
+DEFAULT_MAX_CONNECTIONS = 128
+
+_CONN_LIMIT_BODY = json.dumps({
+    "error": "concurrent connection limit reached — retry with backoff",
+    "reason": "conn_limit",
+}).encode()
+_CONN_LIMIT_RESPONSE = (
+    b"HTTP/1.1 503 Service Unavailable\r\n"
+    b"Content-Type: application/json\r\n"
+    b"Content-Length: " + str(len(_CONN_LIMIT_BODY)).encode() + b"\r\n"
+    b"Connection: close\r\n\r\n" + _CONN_LIMIT_BODY
+)
+
+
+def reply_json(handler, code: int, payload: dict) -> None:
+    """Write one JSON response on a ``BaseHTTPRequestHandler`` — the
+    shared reply shape of the server sidecar and the router ingress."""
+    body = json.dumps(payload).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def parse_json_object(handler, raw: bytes) -> Optional[dict]:
+    """Parse a request body that must be a JSON object; on anything
+    else replies 400 and returns None (shared 400 taxonomy of the
+    sidecar and the router ingress)."""
+    try:
+        req = json.loads(raw or b"{}")
+        if not isinstance(req, dict):
+            raise TypeError(
+                f"body must be a JSON object, got {type(req).__name__}"
+            )
+        return req
+    except (ValueError, TypeError) as e:
+        handler._reply(400, {"error": f"malformed request: {e}"})
+        return None
+
+
+def read_bounded_body(handler, max_body_bytes: int,
+                      read_timeout_s: Optional[float]) -> Optional[bytes]:
+    """Read ``handler``'s request body under the ingress bounds
+    (shared by the server sidecar and the fleet router's ingress).
+    Returns the raw bytes, or ``None`` when a hardening refusal already
+    replied (413 over the byte limit, 408 on a stalled read, 400 on a
+    malformed Content-Length) and marked the connection for close —
+    each counted in ``tftpu_serving_rejections_total{reason=}``."""
+    try:
+        length = int(handler.headers.get("Content-Length", 0) or 0)
+    except (TypeError, ValueError):
+        length = -1
+    if length < 0:
+        handler._reply(400, {"error": "malformed Content-Length"})
+        handler.close_connection = True
+        return None
+    if length > max_body_bytes:
+        m.http_rejected("body_too_large").inc()
+        handler._reply(413, {
+            "error": (
+                f"request body of {length} bytes exceeds the "
+                f"ingress limit of {max_body_bytes}"
+            ),
+            "reason": "body_too_large",
+        })
+        # the unread body is still in flight: close instead of
+        # draining an attacker's megabytes to reuse the socket
+        handler.close_connection = True
+        return None
+    try:
+        return handler.rfile.read(length)
+    except TimeoutError:  # socket.timeout alias: stalled read
+        m.http_rejected("read_timeout").inc()
+        handler.close_connection = True
+        try:
+            handler._reply(408, {
+                "error": (
+                    f"connection read stalled past {read_timeout_s:g}s"
+                ),
+                "reason": "read_timeout",
+            })
+        except OSError:  # pragma: no cover - peer already gone
+            pass
+        return None
+
+
+def _reject_conn(server, request, slots) -> None:
+    """Send the raw conn-limit 503 and close, off the accept thread.
+    The drain of the client's unread request bytes (closing with data
+    still buffered RSTs the socket, which can discard the 503 before
+    the client reads it) is bounded by a TOTAL deadline — a trickling
+    peer cannot pin this thread past it. ``slots`` bounds how many of
+    these threads exist at once (released here)."""
+    try:
+        request.settimeout(0.5)
+        request.sendall(_CONN_LIMIT_RESPONSE)
+        request.shutdown(socket.SHUT_WR)
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline and request.recv(65536):
+            pass
+    except OSError:
+        pass
+    finally:
+        try:
+            server.close_request(request)
+        except OSError:  # pragma: no cover - already closed
+            pass
+        slots.release()
+
+
+def make_hardened_http_server(addr, handler_cls, max_connections: int):
+    """Build a ``ThreadingHTTPServer`` with a concurrent-connection
+    bound (and a bounded reject path). A factory function so the
+    ``http.server`` import stays inside the serving path, matching
+    ``serve_http``."""
+    from http.server import ThreadingHTTPServer
+
+    class _Bounded(ThreadingHTTPServer):
+        daemon_threads = True
+
+        def __init__(self, server_address, RequestHandlerClass):
+            super().__init__(server_address, RequestHandlerClass)
+            self._conn_lock = threading.Lock()
+            self._active_conns = 0
+            self.max_connections = int(max_connections)
+            self._reject_slots = threading.BoundedSemaphore(8)
+
+        def process_request(self, request, client_address):
+            with self._conn_lock:
+                admit = self._active_conns < self.max_connections
+                if admit:
+                    self._active_conns += 1
+            if not admit:
+                # shed at the accept edge with a raw 503. The
+                # send/drain runs on a short-lived daemon thread:
+                # process_request executes ON the accept loop, and
+                # a peer trickling bytes (or a slow send) must
+                # never stall accepts for the whole server — that
+                # would let one client past the cap take down
+                # healthz scrapes too
+                m.http_rejected("conn_limit").inc()
+                # the reject path is bounded too: a connection
+                # flood past the cap must not spawn more reject
+                # threads than the cap allows for real work — past
+                # the reject budget, just close (still counted)
+                if self._reject_slots.acquire(blocking=False):
+                    threading.Thread(
+                        target=_reject_conn,
+                        args=(self, request, self._reject_slots),
+                        daemon=True, name="tfs-http-conn-reject",
+                    ).start()
+                else:
+                    self.shutdown_request(request)
+                return
+            try:
+                super().process_request(request, client_address)
+            except BaseException:
+                # the handler thread never started (thread
+                # exhaustion — the very overload this cap guards):
+                # its finally-decrement will never run, and a
+                # leaked slot here would ratchet the counter to
+                # the cap and 503 every future connection forever
+                with self._conn_lock:
+                    self._active_conns -= 1
+                raise
+
+        def process_request_thread(self, request, client_address):
+            try:
+                super().process_request_thread(request, client_address)
+            finally:
+                with self._conn_lock:
+                    self._active_conns -= 1
+
+        def handle_error(self, request, client_address):
+            # a peer dropping mid-request (kill -9 chaos, impatient
+            # client) is normal operation here — no stderr traceback
+            import sys
+
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (ConnectionError, TimeoutError)):
+                return
+            super().handle_error(request, client_address)
+
+    return _Bounded(addr, handler_cls)
 
 
 def serve_http(server: Server, port: int = 0, addr: str = "127.0.0.1",
-               request_timeout_s: Optional[float] = None):
+               request_timeout_s: Optional[float] = None,
+               max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+               read_timeout_s: Optional[float] = DEFAULT_READ_TIMEOUT_S,
+               max_connections: int = DEFAULT_MAX_CONNECTIONS):
     """Serve ``server`` over HTTP from a daemon thread. ``port=0``
     binds an ephemeral port — read it back from
     ``httpd.server_address[1]``. Returns the ``ThreadingHTTPServer``;
     call ``.shutdown()`` to stop (drain the :class:`Server` itself
-    separately — the adapter owns no lifecycle)."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    separately — the adapter owns no lifecycle, though ``POST
+    /admin/drain`` lets remote operators trigger one). Hardening knobs:
+    ``max_body_bytes`` (413 past it), ``read_timeout_s`` (per-connection
+    socket timeout; ``None`` disables), ``max_connections`` (immediate
+    503 past the concurrent bound) — refusals counted in
+    ``tftpu_serving_rejections_total{reason=}``."""
+    from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # StreamRequestHandler applies this to the connection socket:
+        # a client that stalls mid-read (slowloris body, dead peer)
+        # cannot pin a handler thread forever
+        timeout = read_timeout_s
 
         def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            reply_json(self, code, payload)
+
+        def _read_body(self) -> Optional[bytes]:
+            return read_bounded_body(self, max_body_bytes, read_timeout_s)
 
         def do_GET(self):  # noqa: N802 - http.server API
             if self.path.split("?")[0] in ("/", "/healthz"):
@@ -65,27 +292,31 @@ def serve_http(server: Server, port: int = 0, addr: str = "127.0.0.1",
 
         def do_POST(self):  # noqa: N802 - http.server API
             path = self.path.split("?")[0]
+            if path == "/admin/drain":
+                body = self._read_body()
+                if body is None:
+                    return
+                server.drain()
+                self._reply(202, {"state": server.state})
+                return
             if not path.startswith("/v1/"):
                 self._reply(404, {"error": "not found"})
                 return
             endpoint = path[len("/v1/"):]
-            try:
-                length = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(length) or b"{}")
-                if not isinstance(req, dict):
-                    raise TypeError(
-                        f"body must be a JSON object, got "
-                        f"{type(req).__name__}"
-                    )
-                inputs = req.get("inputs")
-                deadline_s = req.get("deadline_s")
-            except (ValueError, TypeError) as e:
-                self._reply(400, {"error": f"malformed request: {e}"})
+            raw = self._read_body()
+            if raw is None:
                 return
+            req = parse_json_object(self, raw)
+            if req is None:
+                return
+            inputs = req.get("inputs")
+            deadline_s = req.get("deadline_s")
+            idem_key = req.get("idempotency_key")
             t0 = time.perf_counter()
             try:
                 fut = server.submit(endpoint, inputs,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s,
+                                    idempotency_key=idem_key)
             except UnknownEndpointError as e:
                 self._reply(404, {"error": str(e)})
                 return
@@ -131,9 +362,9 @@ def serve_http(server: Server, port: int = 0, addr: str = "127.0.0.1",
         def log_message(self, *args):  # load generators must not spam
             pass
 
-    import threading
-
-    httpd = ThreadingHTTPServer((addr, port), Handler)
+    httpd = make_hardened_http_server(
+        (addr, port), Handler, max_connections
+    )
     t = threading.Thread(
         target=httpd.serve_forever, daemon=True, name="tfs-serving-http"
     )

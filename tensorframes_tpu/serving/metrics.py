@@ -31,6 +31,12 @@ __all__ = [
     "DECODE_PHASES", "DECODE_TOKENS", "DECODE_STEPS", "DECODE_TTFT",
     "DECODE_SLOTS", "DECODE_FREE_PAGES", "DECODE_PREEMPTIONS",
     "DECODE_EVICTIONS",
+    "HTTP_REJECT_REASONS", "HTTP_REJECTIONS", "http_rejected",
+    "IDEMPOTENT_DEDUP",
+    "ROUTER_REJECT_REASONS", "ROUTER_REQUESTS", "ROUTER_REDRIVES",
+    "ROUTER_REJECTED", "ROUTER_REPLICAS_LIVE", "ROUTER_REPLICA_DEAD",
+    "ROUTER_REPLICA_RESTARTS", "ROUTER_DISPATCH_SECONDS",
+    "ROUTER_REQUEST_LATENCY", "router_rejected",
 ]
 
 #: Why an admission was refused (closed set — every series pre-registered).
@@ -168,3 +174,108 @@ DECODE_EVICTIONS = _counter(
 def rejected(reason: str) -> Counter:
     """The pre-registered rejection counter for ``reason``."""
     return REJECTED[reason]
+
+
+# -- hardened HTTP ingress (tftpu_serving_rejections_total, ISSUE 13) -------
+# Transport-level refusals happen BEFORE a request reaches admission
+# control, so they cannot ride the admission counter above: an oversized
+# body, a slow-read connection, or a connection past the concurrency
+# bound never becomes a queued request. A separate counter (the name the
+# fleet issue assigns) keeps the two shed layers distinguishable on a
+# dashboard: rejected_total spikes mean the batcher is full,
+# rejections_total spikes mean the transport is under attack/overload.
+
+#: Why the HTTP layer refused a connection/body (closed set).
+HTTP_REJECT_REASONS: Tuple[str, ...] = (
+    "body_too_large", "read_timeout", "conn_limit",
+)
+
+HTTP_REJECTIONS: Dict[str, Counter] = {
+    r: _counter(
+        "tftpu_serving_rejections_total",
+        "HTTP ingress refusals before admission, by reason "
+        "(body_too_large = request body over the ingress byte limit "
+        "[413], read_timeout = connection read stalled past the "
+        "per-connection timeout [408/close], conn_limit = concurrent "
+        "connection bound reached [503])",
+        labels={"reason": r},
+    )
+    for r in HTTP_REJECT_REASONS
+}
+
+IDEMPOTENT_DEDUP = _counter(
+    "tftpu_serving_idempotent_dedup_total",
+    "Submissions deduplicated by idempotency key (a redriven or "
+    "retried dispatch joined the original request's future instead of "
+    "executing again)",
+)
+
+
+def http_rejected(reason: str) -> Counter:
+    """The pre-registered ingress rejection counter for ``reason``."""
+    return HTTP_REJECTIONS[reason]
+
+
+# -- fleet router (tftpu_router_*, ISSUE 13) --------------------------------
+# The router is the one place that sees the whole fleet: how many
+# replicas are routable, how often a dispatch had to be redriven to a
+# survivor, and what the client-visible latency is THROUGH failures.
+# Per-replica cardinality stays out of the registry (TFL003) — ranks
+# ride flight records (router.* family) and the router's healthz body.
+
+#: Why the router refused an ingress request (closed set).
+ROUTER_REJECT_REASONS: Tuple[str, ...] = ("no_replica", "deadline")
+
+ROUTER_REQUESTS = _counter(
+    "tftpu_router_requests_total",
+    "Ingress requests admitted by the fleet router",
+)
+ROUTER_REDRIVES = _counter(
+    "tftpu_router_redrives_total",
+    "Dispatches redriven to a surviving replica after the chosen "
+    "replica failed mid-request (same idempotency key, original "
+    "deadline)",
+)
+ROUTER_REJECTED: Dict[str, Counter] = {
+    r: _counter(
+        "tftpu_router_rejected_total",
+        "Ingress requests the router refused, by reason (no_replica = "
+        "no live non-draining replica, deadline = the request's budget "
+        "lapsed before any dispatch succeeded)",
+        labels={"reason": r},
+    )
+    for r in ROUTER_REJECT_REASONS
+}
+ROUTER_REPLICAS_LIVE = _gauge(
+    "tftpu_router_replicas_live",
+    "Replicas the router currently considers routable (state=running, "
+    "fresh heartbeat, healthz reachable)",
+)
+ROUTER_REPLICA_DEAD = _counter(
+    "tftpu_router_replica_dead_total",
+    "Replicas newly marked dead by the router/fleet (process exit, "
+    "stale heartbeat, or repeated scrape failure)",
+)
+ROUTER_REPLICA_RESTARTS = _counter(
+    "tftpu_router_replica_restarts_total",
+    "Replica processes respawned by the serving fleet supervisor "
+    "after a death",
+)
+ROUTER_DISPATCH_SECONDS = _histogram(
+    "tftpu_router_dispatch_seconds",
+    "Wall-clock of one router->replica dispatch attempt (successful "
+    "or failed; redrives observe once per attempt)",
+    buckets=LATENCY_BUCKETS,
+)
+ROUTER_REQUEST_LATENCY = _histogram(
+    "tftpu_router_request_latency_seconds",
+    "Ingress request wall-clock through the router (admission to "
+    "relayed reply, including any redrives) — the fleet bench's p99 "
+    "gate reads this",
+    buckets=LATENCY_BUCKETS,
+)
+
+
+def router_rejected(reason: str) -> Counter:
+    """The pre-registered router rejection counter for ``reason``."""
+    return ROUTER_REJECTED[reason]

@@ -1,0 +1,14 @@
+"""Runnable entry for the demo fleet replica:
+
+    python -m tensorframes_tpu.serving.replica_main --demo
+
+A separate module (never imported by the serving package) so ``-m``
+does not re-execute ``replica.py``, which the package imports at init —
+runpy would otherwise warn about the double module object. All logic
+lives in :mod:`tensorframes_tpu.serving.replica`.
+"""
+
+from tensorframes_tpu.serving.replica import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,6 +29,7 @@ idempotent — the safe case for retry).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
@@ -89,6 +90,15 @@ class ServingConfig:
     default_deadline_s: Optional[float] = None
     donate: bool = False
     warmup: bool = True
+    #: bound of the idempotency-key dedup cache (completed and in-flight
+    #: futures a redriven submit can join instead of re-executing);
+    #: 0 disables dedup entirely. Entries also expire after
+    #: ``idempotency_ttl_s`` — dedup exists for the redrive window
+    #: (seconds), and a completed future pins its RESULT arrays, so a
+    #: count-only bound would hold the last N responses in memory
+    #: indefinitely under steady load.
+    idempotency_cache: int = 4096
+    idempotency_ttl_s: float = 60.0
 
 
 class Endpoint:
@@ -211,7 +221,18 @@ class Server:
         self._lock = threading.Lock()
         self._running = False
         self._starting = False
+        self._draining = False
         self._stop_requested = False
+        # idempotency-key dedup (ISSUE 13): (endpoint, key) ->
+        # (ResultFuture, inserted_at), FIFO eviction at
+        # config.idempotency_cache plus TTL expiry. A router redriving
+        # a request (or any client retrying with the same key) joins
+        # the original future instead of executing the program twice.
+        # Scoped per endpoint: the same client key against a different
+        # endpoint is a different operation, never a cache hit.
+        self._idem: "collections.OrderedDict[tuple, tuple]" = (
+            collections.OrderedDict()
+        )
         self.warmup_reports: Dict[str, object] = {}
 
     # -- registration -------------------------------------------------------
@@ -422,28 +443,62 @@ class Server:
         (the graceful default) completes every queued request first;
         ``drain=False`` fails them with :class:`ServingError`. New
         submissions during and after shutdown get a COUNTED rejection
-        (``reason=closed``), never a hang."""
+        (``reason=closed``), never a hang. While a graceful stop is
+        completing queued work, :attr:`state` reads ``draining`` —
+        routers and load balancers read ONE lifecycle source of truth."""
+        try:
+            with self._lock:
+                if self._starting:
+                    # stop() during start()'s warm loop: record the
+                    # request so start() leaves admission closed instead
+                    # of opening the batchers after this stop() returned
+                    self._stop_requested = True
+                if not self._running and not self._batchers \
+                        and not self._decode:
+                    return
+                self._running = False
+                if drain:
+                    self._draining = True
+                batchers = list(self._batchers.values())
+                engines = list(self._decode.values())
+            pending = sum(b.queued_rows for b in batchers)
+            _flight.record(
+                "serving.drain" if drain else "serving.stop",
+                endpoints=self.endpoints(), queued_rows=pending,
+            )
+            for b in batchers:
+                b.stop(drain=drain, timeout=timeout)
+            for eng in engines:
+                eng.stop(drain=drain, timeout=timeout)
+        finally:
+            with self._lock:
+                self._draining = False
+                # a stopped server keeps no dedup state: the cached
+                # futures pin result arrays, and nothing can redrive
+                # into a closed admission anyway
+                self._idem.clear()
+
+    def drain(self, wait: bool = False,
+              timeout: Optional[float] = None) -> None:
+        """Gracefully retire this server: close admission (new submits
+        shed with counted ``closed`` rejections), complete every queued
+        request, then read ``state == "stopped"``. The rolling-restart
+        primitive — externally triggerable as ``POST /admin/drain`` on
+        the HTTP sidecar, so an operator (or the fleet router) can
+        drain a replica without linking Python. ``wait=False`` (the
+        HTTP-friendly default) returns immediately; poll
+        :attr:`state`/healthz for ``draining`` → ``stopped``."""
+        if wait:
+            self.stop(drain=True, timeout=timeout)
+            return
         with self._lock:
-            if self._starting:
-                # stop() during start()'s warm loop: record the request
-                # so start() leaves admission closed instead of opening
-                # the batchers after this stop() has returned
-                self._stop_requested = True
-            if not self._running and not self._batchers \
-                    and not self._decode:
-                return
-            self._running = False
-            batchers = list(self._batchers.values())
-            engines = list(self._decode.values())
-        pending = sum(b.queued_rows for b in batchers)
-        _flight.record(
-            "serving.drain" if drain else "serving.stop",
-            endpoints=self.endpoints(), queued_rows=pending,
-        )
-        for b in batchers:
-            b.stop(drain=drain, timeout=timeout)
-        for eng in engines:
-            eng.stop(drain=drain, timeout=timeout)
+            if self._draining:
+                return  # one drain is already completing the queue
+            self._draining = True
+        threading.Thread(
+            target=self.stop, kwargs={"drain": True, "timeout": timeout},
+            daemon=True, name="tfs-serving-drain",
+        ).start()
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -455,14 +510,82 @@ class Server:
     def running(self) -> bool:
         return self._running
 
+    @property
+    def state(self) -> str:
+        """The lifecycle state, ONE source of truth for routers and
+        operators: ``starting`` (warmup in progress, admission still
+        closed), ``running`` (admission open), ``draining`` (admission
+        closed, queued work completing), ``stopped`` (admission closed,
+        nothing in flight). ``running == (state == "running")``."""
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._starting:
+            return "starting"
+        if self._draining:
+            return "draining"
+        if self._running:
+            return "running"
+        return "stopped"
+
     # -- request path -------------------------------------------------------
 
     def submit(self, endpoint: str, feeds,
-               deadline_s: Optional[float] = None) -> ResultFuture:
+               deadline_s: Optional[float] = None,
+               idempotency_key: Optional[str] = None) -> ResultFuture:
         """Admit one request; returns a :class:`ResultFuture` resolving
         to this request's rows of every program output. Raises
         :class:`RejectedError` on backpressure/closed/oversize (never
-        blocks admission), :class:`ValidationError` on malformed feeds."""
+        blocks admission), :class:`ValidationError` on malformed feeds.
+
+        ``idempotency_key`` deduplicates retried dispatches: a second
+        submit carrying a key this server has already admitted joins
+        the ORIGINAL request's future (counted by
+        ``tftpu_serving_idempotent_dedup_total``) instead of executing
+        the program again — the fleet router stamps every dispatch with
+        one so a redrive after a replica failure can never
+        double-execute on a replica that already accepted it."""
+        if idempotency_key is not None and self.config.idempotency_cache:
+            ikey = (endpoint, str(idempotency_key))
+            now = time.monotonic()
+            with self._lock:
+                self._prune_idem_locked(now)
+                entry = self._idem.get(ikey)
+            if entry is not None:
+                m.IDEMPOTENT_DEDUP.inc()
+                _flight.record(
+                    "serving.idempotent_dedup", endpoint=endpoint,
+                    key=str(idempotency_key),
+                )
+                return entry[0]
+        fut = self._submit_new(endpoint, feeds, deadline_s)
+        if idempotency_key is not None and self.config.idempotency_cache:
+            with self._lock:
+                # first-writer-wins: a racing duplicate that also missed
+                # the cache keeps ITS future (both executed — the race
+                # window is one admission; the router never races itself)
+                self._idem.setdefault(ikey, (fut, time.monotonic()))
+                while len(self._idem) > self.config.idempotency_cache:
+                    self._idem.popitem(last=False)
+        return fut
+
+    def _prune_idem_locked(self, now: float) -> None:
+        """Expire dedup entries past the TTL (FIFO order == insertion
+        order, so expired entries are a prefix). A completed future
+        pins its result arrays — dedup only needs to cover the redrive
+        window, not steady-state history."""
+        ttl = self.config.idempotency_ttl_s
+        if ttl is None or ttl <= 0:
+            return
+        while self._idem:
+            _, (_, inserted) = next(iter(self._idem.items()))
+            if now - inserted <= ttl:
+                break
+            self._idem.popitem(last=False)
+
+    def _submit_new(self, endpoint: str, feeds,
+                    deadline_s: Optional[float]) -> ResultFuture:
         eng = self._decode.get(endpoint)
         if eng is not None:
             # iterative decode rides the engine's own admission queue
@@ -505,6 +628,12 @@ class Server:
             batchers = dict(self._batchers)
             engines = dict(self._decode)
             running = self._running
+            state = self._state_locked()
+            # TTL-prune the idempotency cache here too: healthz is
+            # scraped continuously by routers, so expiry does not
+            # depend on further KEYED submits arriving (the cache must
+            # not pin the last burst's results after traffic stops)
+            self._prune_idem_locked(time.monotonic())
         queues: Dict[str, int] = {}
         decode: Dict[str, Dict[str, int]] = {}
         totals = {
@@ -533,10 +662,43 @@ class Server:
             }
         out = {
             "running": running,
+            "state": state,
             "endpoints": sorted(queues),
             "queued_rows": queues,
             **totals,
+            # process-wide compile accounting, for the fleet's
+            # zero-compile-restart assertion: a restarted replica warmed
+            # from the shared store must report xla_compiles == 0 with
+            # compile_cache_hits > 0 over its healthz (these ARE the
+            # process-global registry series — deliberately, unlike the
+            # per-server admission counters above)
+            "process": _process_compile_counters(),
         }
         if decode:
             out["decode"] = decode
         return out
+
+
+def _process_compile_counters() -> Dict[str, int]:
+    """XLA-compile and compile-store counters (instruments acquired at
+    import below — the executor/compilecache registered them first; the
+    same acquisition pattern the fleet supervisor uses for
+    tftpu_fleet_*)."""
+    return {
+        "xla_compiles": int(_COMPILE_SECONDS.count),
+        "compile_cache_hits": int(_STORE_HITS.value),
+        "compile_cache_misses": int(_STORE_MISSES.value),
+    }
+
+
+# Acquired (get-or-create by name) at import: ops/executor.py and
+# compilecache/store.py register these before the serving package loads
+# (package __init__ order), so these are the SAME instruments — healthz
+# reports the process's real compile accounting, and the registrations
+# stay at import time (TFL003).
+from ..observability.metrics import counter as _acquire_counter  # noqa: E402
+from ..observability.metrics import histogram as _acquire_histogram  # noqa: E402
+
+_COMPILE_SECONDS = _acquire_histogram("tftpu_executor_compile_seconds")
+_STORE_HITS = _acquire_counter("tftpu_compilecache_hits_total")
+_STORE_MISSES = _acquire_counter("tftpu_compilecache_misses_total")

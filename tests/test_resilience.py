@@ -933,6 +933,10 @@ def test_register_site_validates_and_lists_sorted():
     assert list(sites) == sorted(sites)
     assert "executor.dispatch" in sites
     assert "fleet.heartbeat" in sites
+    # the serving-fleet chaos sites (ISSUE 13): registered centrally in
+    # faults.py so drills see them even before the serving package loads
+    assert "router.dispatch" in sites
+    assert "serving.replica" in sites
 
 
 # ---------------------------------------------------------------------------
