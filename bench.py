@@ -292,6 +292,135 @@ def _bench_chain3_join(n_rows: int = 1_000_000, iters: int = 6,
     return fused_s, unfused_s, steady_compiles
 
 
+def _bench_multijoin(n_rows: int = 1_000_000, iters: int = 4,
+                     num_blocks: int = 4, n_g1: int = 512,
+                     n_g2: int = 64):
+    """1M-row star-schema map→join→join→aggregate (ISSUE 14): the
+    adaptive optimizer pushes the partial aggregate BELOW both dims
+    (each inner join degenerates to a whole-group semi-join filter —
+    1M rows never match-expand through either join) and the stats
+    sidecar makes the second execution a counted ``reoptimized``
+    lowering. ``TFTPU_REOPT=0`` re-runs the identical pipeline on the
+    PR 7 static path (joins execute, aggregate above), and
+    ``TFTPU_FUSION=0`` replays it per-stage. Values are int32 so every
+    rewrite is reassoc-safe: all three modes must be BIT-IDENTICAL
+    (asserted here — a mismatch raises). Returns
+    (opt_wall_s, static_wall_s, unfused_wall_s, pushdowns)."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.config import get_config
+    from tensorframes_tpu.observability.metrics import REGISTRY
+
+    rng = np.random.default_rng(0)
+    fact = tfs.frame_from_arrays(
+        {
+            "k1": rng.integers(0, n_g1, n_rows).astype(np.int32),
+            "k2": rng.integers(0, n_g2, n_rows).astype(np.int32),
+            "x": (np.arange(n_rows) % 16).astype(np.int32),
+            # dead fact columns incl. an embedding-style wide one:
+            # pushdown + needed-columns pruning must keep them out of
+            # the map dispatches, the joins, and the reduction
+            "a": np.arange(n_rows, dtype=np.float32),
+            "e": np.ones((n_rows, 8), np.float32),
+        },
+        num_blocks=num_blocks,
+    )
+    # star dims: unique keys (the m=1 condition); dim2 matches half the
+    # key space so the inner join genuinely filters groups
+    dim1 = tfs.frame_from_arrays(
+        {"k1": np.arange(n_g1, dtype=np.int32),
+         "w1": np.arange(n_g1, dtype=np.int32),
+         "tag1": np.ones(n_g1, np.float32)},  # dead build column
+        num_blocks=1,
+    )
+    dim2 = tfs.frame_from_arrays(
+        {"k2": np.arange(0, n_g2, 2, dtype=np.int32),
+         "w2": np.arange(n_g2 // 2, dtype=np.int32),
+         "tag2": np.ones(n_g2 // 2, np.float32)},
+        num_blocks=1,
+    )
+    p1 = tfs.compile_program(lambda x: {"y": x * 2 + 1}, fact)
+    p2 = tfs.compile_program(
+        lambda y: {"z": y * y}, tfs.map_blocks(p1, fact)
+    )
+    j0 = (
+        tfs.map_blocks(p2, tfs.map_blocks(p1, fact))
+        .join(dim1, on="k1").join(dim2, on="k2")
+    )
+    with tfs.with_graph():
+        z_in = tfs.block(j0, "z", tf_name="z_input")
+        fz = tfs.reduce_sum(z_in, axis=0, name="z")
+        agg_program = tfs.compile_program([fz], j0, reduce_mode="blocks")
+
+    def run_once():
+        f2 = tfs.map_blocks(p2, tfs.map_blocks(p1, fact))
+        j = f2.join(dim1, on="k1").join(dim2, on="k2")
+        out = tfs.aggregate(agg_program, j.group_by("k1", "k2"))
+        return out.blocks()
+
+    def wall(iters_):
+        run_once()  # warm jit caches (and the stats record) untimed
+        t0 = time.perf_counter()
+        for _ in range(iters_):
+            run_once()
+        return (time.perf_counter() - t0) / iters_
+
+    def _counter_value(decision):
+        for d in REGISTRY.snapshot():
+            if (
+                d["name"] == "tftpu_plan_cost_decisions_total"
+                and d["labels"].get("decision") == decision
+            ):
+                return float(d.get("value", 0.0))
+        return 0.0
+
+    was_fusion = get_config().plan_fusion
+    was_reopt = get_config().plan_reopt
+    try:
+        tfs.configure(plan_fusion=True, plan_reopt=True)
+        p0 = _counter_value("pushdown_aggregate")
+        opt_s = wall(iters)
+        pushdowns = int(_counter_value("pushdown_aggregate") - p0)
+        opt_rows = run_once()
+        tfs.configure(plan_reopt=False)  # the TFTPU_REOPT=0 path
+        static_s = wall(iters)
+        static_rows = run_once()
+        tfs.configure(plan_fusion=False)  # the TFTPU_FUSION=0 path
+        unfused_s = wall(iters)
+        unfused_rows = run_once()
+    finally:
+        tfs.configure(plan_fusion=was_fusion, plan_reopt=was_reopt)
+    for label, rows in (("static", static_rows), ("unfused", unfused_rows)):
+        if len(opt_rows) != len(rows):
+            raise AssertionError(
+                f"multijoin: optimizer produced {len(opt_rows)} "
+                f"block(s), {label} {len(rows)} — the bit-identical "
+                "contract is broken"
+            )
+        for fb, ub in zip(opt_rows, rows):
+            if set(fb) != set(ub):
+                raise AssertionError(
+                    f"multijoin: optimizer columns {sorted(fb)} != "
+                    f"{label} {sorted(ub)} — the bit-identical "
+                    "contract is broken"
+                )
+            for name in fb:
+                if not np.array_equal(
+                    np.asarray(fb[name]), np.asarray(ub[name])
+                ):
+                    raise AssertionError(
+                        "multijoin: optimizer and "
+                        f"{label} outputs differ in column {name!r} — "
+                        "the bit-identical contract is broken"
+                    )
+    if pushdowns <= 0:
+        raise AssertionError(
+            "multijoin: the optimizer never recorded a "
+            "pushdown_aggregate decision — the adaptive path did not "
+            "engage"
+        )
+    return opt_s, static_s, unfused_s, pushdowns
+
+
 def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0,
                      int8: bool = False, sweep: Sequence[int] = (),
                      side: int = 299, compute_dtype: str = "bfloat16",
@@ -1675,6 +1804,32 @@ def main():
                 chain3_join_compiles,
             )
         )
+    (
+        multijoin_opt_s, multijoin_static_s, multijoin_unfused_s,
+        multijoin_pushdowns,
+    ) = _try(
+        "multijoin", _bench_multijoin,
+        (float("nan"), float("nan"), float("nan"), 0),
+        metric_keys=(
+            "multijoin_opt_1M_wall_s", "multijoin_static_1M_wall_s",
+            "multijoin_unfused_1M_wall_s",
+        ),
+    )
+    if (
+        multijoin_opt_s == multijoin_opt_s
+        and multijoin_static_s == multijoin_static_s
+    ):
+        print(
+            "# plan | multijoin opt={:.4f}s static={:.4f}s "
+            "unfused={:.4f}s ratio={:.2f}x pushdowns={} "
+            "bit_identical=True (acceptance: >= 1.5x opt vs "
+            "TFTPU_REOPT=0)".format(
+                multijoin_opt_s, multijoin_static_s,
+                multijoin_unfused_s,
+                multijoin_static_s / multijoin_opt_s,
+                multijoin_pushdowns,
+            )
+        )
     try:
         from tensorframes_tpu.observability.metrics import (
             REGISTRY as _plan_reg,
@@ -2068,6 +2223,9 @@ def main():
         "chain3_unfused_1M_wall_s": round(chain3_unfused_s, 6),
         "chain3_join_fused_1M_wall_s": round(chain3_join_fused_s, 6),
         "chain3_join_unfused_1M_wall_s": round(chain3_join_unfused_s, 6),
+        "multijoin_opt_1M_wall_s": round(multijoin_opt_s, 6),
+        "multijoin_static_1M_wall_s": round(multijoin_static_s, 6),
+        "multijoin_unfused_1M_wall_s": round(multijoin_unfused_s, 6),
         "logreg_host_map_blocks_rows_per_sec": round(logreg_host_rps),
         "reduce_blocks_1M_wall_s": round(reduce_s, 6),
         "reduce_blocks_host_1M_wall_s": round(reduce_host_s, 6),

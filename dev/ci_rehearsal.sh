@@ -105,6 +105,15 @@ run_step "Test (8-device virtual CPU mesh)" \
 run_step "Fusion-off smoke (TFTPU_FUSION=0 fallback stays green)" \
   env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py tests/test_relational_pipeline.py -q
 
+# ci.yml's re-optimization-off smoke (ISSUE 14): TFTPU_REOPT=0 turns
+# the adaptive optimizer (aggregate pushdown below joins, join
+# reordering, stats-sidecar feedback) off — the relational suites and
+# the adaptive equivalence sweeps (which honor the ambient knob;
+# engagement-assertion tests skip themselves) must stay green on the
+# PR 7 static cost model
+run_step "Re-optimization-off smoke (TFTPU_REOPT=0 static cost model stays green)" \
+  env TFTPU_REOPT=0 python -m pytest tests/test_relational_pipeline.py tests/test_plan_adaptive.py -q
+
 # ci.yml's kernels-off smoke (ISSUE 12): TFTPU_PALLAS=0 removes the
 # straggler pallas kernels from every cost-model decision — the
 # XLA/host lowerings they replace must keep every selecting suite

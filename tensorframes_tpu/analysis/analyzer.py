@@ -214,24 +214,29 @@ def analyze_frame(
 
 def lint_plan(frame) -> DiagnosticReport:
     """Lint a frame's *logical plan* (TFG107 fusion-barrier, TFG109
-    unfused-aggregate): warn when a chain's otherwise-fusable map
-    stages are split by a barrier — a host-callback stage, a
-    ``to_host``/``to_numpy`` materialization or repartition between
-    maps, a trim map, or ragged source cells — and when an
-    aggregate/join consuming the chain stayed a barrier for a fixable
-    reason (non-algebraic fetches, a group key computed by a chained
-    stage, ragged value cells). Each finding's ``explain()`` names the
-    cause. Purely static over the recorded plan chain — never forces a
-    lazy frame."""
+    unfused-aggregate, TFG110 missed-aggregate-pushdown): warn when a
+    chain's otherwise-fusable map stages are split by a barrier — a
+    host-callback stage, a ``to_host``/``to_numpy`` materialization or
+    repartition between maps, a trim map, or ragged source cells —
+    when an aggregate/join consuming the chain stayed a barrier for a
+    fixable reason (non-algebraic fetches, a group key computed by a
+    chained stage, ragged value cells), and when an aggregate sits
+    above a join it could push below but for a fixable cause (an
+    order-sensitive float fetch, group keys not covering the join key,
+    mixed-side fetches, an outer join, duplicate build keys). Each
+    finding's ``explain()`` names the cause. Purely static over the
+    recorded plan chain — never forces a lazy frame."""
     from ..plan.ir import chain_barriers, unfused_epilogues
+    from ..plan.lower import pushdown_misses
 
     n_maps, barriers = chain_barriers(frame)
     ctx = RuleContext(
         program=None,
         plan_barriers=barriers,
         unfused_epilogues=unfused_epilogues(frame),
+        pushdown_misses=pushdown_misses(frame),
     )
-    diags = run_rules(ctx, codes=["TFG107", "TFG109"])
+    diags = run_rules(ctx, codes=["TFG107", "TFG109", "TFG110"])
     return DiagnosticReport(
         diags, subject=f"plan({n_maps} map stage(s))"
     )

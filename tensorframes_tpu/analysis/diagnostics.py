@@ -51,6 +51,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "TFG107": ("fusion-barrier", "warn"),
     "TFG108": ("cache-fingerprint-unstable", "warn"),
     "TFG109": ("unfused-aggregate", "warn"),
+    "TFG110": ("missed-aggregate-pushdown", "warn"),
 }
 
 # Pre-register the full counter family at import: one series per code,

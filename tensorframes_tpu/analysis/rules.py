@@ -83,6 +83,12 @@ class RuleContext:
     #: reason (lint_plan only): dicts with ``verb``, ``reason`` —
     #: recorded by plan.ir.mark_unfused, read by TFG109.
     unfused_epilogues: Optional[Sequence[dict]] = None
+    #: Fixable causes blocking an aggregate-below-join pushdown
+    #: (lint_plan only): dicts with ``cause``, ``subject``, ``detail``,
+    #: ``fix`` — the static eligibility walk's findings
+    #: (plan.rules.plan_pushdown) plus runtime causes recorded by
+    #: plan.ir.mark_pushdown_miss; read by TFG110.
+    pushdown_misses: Optional[Sequence[dict]] = None
     #: Ambient mesh for sharded programs (``analyze_frame`` passes the
     #: frame's mesh): TFG108's stability probes re-trace under it, so
     #: programs using collectives/sharding constraints lint instead of
@@ -635,6 +641,36 @@ def _rule_unfused_aggregate(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG110 — missed-aggregate-pushdown (plan-chain rule: lint_plan only)
+# ---------------------------------------------------------------------------
+
+def _rule_missed_pushdown(ctx: RuleContext) -> List[Diagnostic]:
+    """An aggregate sits above a join the adaptive optimizer could push
+    it below — the rows would then never match-expand — but a *fixable*
+    cause blocks the rewrite: an order-sensitive float fetch, a group
+    key set that does not cover the join key, fetches mixing both join
+    sides, an outer join, or (recorded at force time) duplicate
+    build-side keys. Each finding names the blocking column/fetch and
+    the fix. Mandatory exclusions (sharded/multi-process feeds,
+    ``TFTPU_REOPT=0``) are honest, not fixable, and never flagged."""
+    if not ctx.pushdown_misses:
+        return []
+    out: List[Diagnostic] = []
+    for m in ctx.pushdown_misses:
+        out.append(Diagnostic(
+            "TFG110", "warn",
+            "aggregate sits above a join it could push below, but "
+            f"{m.get('detail', m.get('cause', 'an unknown cause'))} — "
+            "so every row match-expands through the join and the "
+            "epilogue reduces the expanded table instead of the "
+            "pre-join partials",
+            subject=str(m.get("subject", "aggregate")),
+            fix=str(m.get("fix", "")),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # TFG108 — cache-fingerprint-unstable (persistent-cache miss storm)
 # ---------------------------------------------------------------------------
 
@@ -774,6 +810,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG107": _rule_fusion_barrier,
     "TFG108": _rule_fingerprint_unstable,
     "TFG109": _rule_unfused_aggregate,
+    "TFG110": _rule_missed_pushdown,
 }
 
 

@@ -131,6 +131,15 @@ class Config:
     # escape hatch back to per-stage execution (bit-identical results;
     # the fused path exists purely for speed).
     plan_fusion: bool = _env_bool("TFTPU_FUSION", True)
+    # Adaptive query optimizer (tensorframes_tpu/plan: aggregate
+    # pushdown below joins, multi-join reordering, and feedback
+    # re-optimization from the per-plan stats sidecar under
+    # TFTPU_COMPILE_CACHE). TFTPU_REOPT=0 is the escape hatch back to
+    # the PR 7 static cost model: no plan rewrite, no reordering, no
+    # stats recording or consultation — bit-identical results either
+    # way (the optimizer exists purely for speed; every rewrite is
+    # gated on reassoc_safe-style exactness).
+    plan_reopt: bool = _env_bool("TFTPU_REOPT", True)
     # Hung-dispatch watchdog (resilience/fleet.py): a dispatch — or a
     # fleet rendezvous barrier — that exceeds this wall-clock deadline
     # aborts with HungDispatchError plus a flight-recorder postmortem

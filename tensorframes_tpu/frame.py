@@ -353,6 +353,25 @@ class _JoinSpec:
         return cast
 
 
+def _key_union_col(lv, rv):
+    """Concatenate one key column's two sides into the array form the
+    group encoder accepts (host list / object columns promote to object
+    arrays). THE single union construction for key-membership encoding:
+    `_hash_join_cols` and the plan's pushed-down semi-join filter
+    (plan/lower.py) both build unions here, so their NaN/string
+    semantics — and with them the pushdown's bit-identity contract —
+    cannot drift apart."""
+    if isinstance(lv, list) or isinstance(rv, list) or (
+        getattr(lv, "dtype", None) == object
+        or getattr(rv, "dtype", None) == object
+    ):
+        u = np.empty(len(lv) + len(rv), dtype=object)
+        u[: len(lv)] = list(lv)
+        u[len(lv):] = list(rv)
+        return u
+    return np.concatenate([np.asarray(lv), np.asarray(rv)])
+
+
 def _hash_join_cols(
     lcols: Dict[str, object], rcols: Dict[str, object], spec: _JoinSpec
 ) -> Block:
@@ -423,16 +442,7 @@ def _hash_join_cols(
                     v.dtype,
                 )
         return out0
-    key_union = []
-    for k in keys:
-        lv, rv = lcols[k], rcols[k]
-        if isinstance(lv, list) or isinstance(rv, list):
-            u = np.empty(len(lv) + len(rv), dtype=object)
-            u[: len(lv)] = list(lv)
-            u[len(lv):] = list(rv)
-        else:
-            u = np.concatenate([lv, rv])
-        key_union.append(u)
+    key_union = [_key_union_col(lcols[k], rcols[k]) for k in keys]
     codes, _, num_codes = group_ids(key_union)
     l_codes, r_codes = codes[:nl], codes[nl:]
 
@@ -570,6 +580,30 @@ class TensorFrame:
     @property
     def num_rows(self) -> int:
         return sum(_block_num_rows(b) for b in self.blocks())
+
+    @property
+    def estimated_rows(self) -> Optional[int]:
+        """Row-count estimate that NEVER forces a lazy frame: exact for
+        materialized frames; a lazy chain rooted on a materialized
+        source estimates the source's rows when no recorded node can
+        change the row count (maps and selects preserve it; filters,
+        joins, and aggregates are data-dependent). None when unknowable
+        pre-force. The plan cost model's join-order decision records
+        this (schema-derived estimate, refined by the stats sidecar's
+        observed cardinalities — ISSUE 14)."""
+        if self.is_materialized:
+            return self.num_rows
+        node = getattr(self, "_plan", None)
+        if node is None:
+            return None
+        from .plan.ir import resolve_chain
+
+        source, nodes = resolve_chain(node)
+        if any(n.kind not in ("map", "select") for n in nodes):
+            return None
+        if getattr(source, "is_materialized", False):
+            return source.num_rows
+        return None
 
     @property
     def columns(self) -> List[str]:

@@ -16,6 +16,18 @@ filters, explicit materialization) split the plan honestly instead of
 changing semantics. ``TFTPU_FUSION=0`` / ``configure(plan_fusion=False)``
 disables planning entirely.
 
+Since ISSUE 14 the package is also the **adaptive query optimizer**:
+eligible aggregates push below joins (the join degenerates to a
+whole-group semi-join filter, so rows never match-expand), multi-join
+chains reorder by estimated — then observed — selectivity, and a
+per-plan-fingerprint stats sidecar (:mod:`.stats`, persisted under
+``TFTPU_COMPILE_CACHE``) feeds measured cardinalities back into the
+cost model so the second execution of a recurring pipeline picks
+better lowerings than the first (counted as ``reoptimized``
+decisions). Every rewrite is gated on reassoc-safe exactness and m=1
+joins, so results stay bit-identical; ``TFTPU_REOPT=0`` /
+``configure(plan_reopt=False)`` restores the static cost model.
+
 Importing this package registers the ``tftpu_plan_*`` metrics family,
 so expositions carry it from process start.
 """
@@ -26,40 +38,59 @@ from .ir import (  # noqa: F401
     explain_plan,
     fusion_enabled,
     mark_barrier,
+    mark_pushdown_miss,
     mark_unfused,
     node_for_parent,
     parent_is_fusable,
     program_has_callback,
+    pushdown_miss_log,
     resolve_chain,
     unfused_epilogues,
 )
 from .lower import execute_aggregate, execute_plan, lower_reduce  # noqa: F401
 from .rules import (  # noqa: F401
     Decision,
+    PushdownPlan,
     SegmentPlan,
     decide_epilogue,
     decide_fuse,
+    decide_join_order,
+    decide_pushdown,
     decide_segment_bucket,
+    plan_join_chain,
+    plan_pushdown,
     plan_segment,
     reassoc_safe,
     split_segments,
+)
+from .stats import (  # noqa: F401
+    chain_fingerprint,
+    reopt_enabled,
 )
 
 __all__ = [
     "Decision",
     "PlanNode",
+    "PushdownPlan",
     "SegmentPlan",
     "chain_barriers",
+    "chain_fingerprint",
     "decide_epilogue",
     "decide_fuse",
+    "decide_join_order",
+    "decide_pushdown",
     "decide_segment_bucket",
     "execute_aggregate",
     "execute_plan",
     "explain_plan",
     "fusion_enabled",
     "lower_reduce",
+    "plan_join_chain",
+    "plan_pushdown",
     "plan_segment",
+    "pushdown_miss_log",
     "reassoc_safe",
+    "reopt_enabled",
     "split_segments",
     "unfused_epilogues",
 ]

@@ -65,6 +65,8 @@ __all__ = [
     "mark_barrier",
     "mark_unfused",
     "unfused_epilogues",
+    "mark_pushdown_miss",
+    "pushdown_miss_log",
     "parent_is_fusable",
     "program_has_callback",
     "chain_barriers",
@@ -273,6 +275,29 @@ def unfused_epilogues(frame) -> List[dict]:
     """The TFG109 evidence recorded by :func:`mark_unfused` (empty when
     every epilogue fused, or nothing was recorded)."""
     return list(getattr(frame, "_plan_unfused", ()) or ())
+
+
+def mark_pushdown_miss(frame, miss: dict) -> None:
+    """Record that an aggregate sitting above a join missed the
+    pushdown rewrite for a *fixable* cause — the TFG110 evidence.
+    Static causes (order-sensitive float fetches, group keys not
+    covering the join key, mixed-side columns) are recorded at force
+    time from the eligibility walk; runtime causes (duplicate
+    build-side keys) append when the lowering's m=1 check fails.
+    Mandatory exclusions (sharded/multi-process feeds, TFTPU_REOPT=0)
+    are honest, not fixable, and are never recorded here."""
+    try:
+        log = getattr(frame, "_plan_pushdown_miss", None)
+        if log is None:
+            log = frame._plan_pushdown_miss = []
+        log.append(dict(miss))
+    except AttributeError:  # pragma: no cover - exotic frame-likes
+        pass
+
+
+def pushdown_miss_log(frame) -> List[dict]:
+    """The TFG110 evidence recorded by :func:`mark_pushdown_miss`."""
+    return list(getattr(frame, "_plan_pushdown_miss", ()) or ())
 
 
 def program_has_callback(program) -> bool:

@@ -49,6 +49,7 @@ timeline when tracing is on.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import OrderedDict
@@ -63,6 +64,7 @@ from ..utils import get_logger
 from ..utils import profiling
 from . import ir
 from . import rules as _rules
+from . import stats as _stats
 from .rules import SegmentPlan, plan_segment, split_segments
 
 logger = get_logger(__name__)
@@ -121,6 +123,13 @@ _COST_DECISIONS = {
         # decide_decode_attention / decide_ragged_gather
         "pallas_segment_reduce", "jit_segment_reduce",
         "pallas_decode_attn", "xla_decode_attn", "pallas_ragged_gather",
+        # adaptive optimizer (ISSUE 14): aggregate pushdown below
+        # joins, multi-join reordering, and stats-fed re-optimization
+        # (plan/rules.plan_pushdown / decide_pushdown /
+        # decide_join_order; TFTPU_REOPT=0 removes them all)
+        "pushdown_aggregate", "pushdown_ineligible",
+        "pushdown_skipped_selective", "reorder_joins",
+        "join_order_static", "reoptimized",
     )
 }
 
@@ -444,36 +453,46 @@ def _run_per_stage(source, plan: SegmentPlan):
 
 
 
-def _run_join(cur, plan: SegmentPlan):
+def _gather_right(plan: SegmentPlan) -> Dict[str, object]:
+    """Force + gather a join segment's (pruned) build side. The build
+    side is an INDEPENDENT pipeline: the select escapes the lowering
+    re-entrancy guard so it records on ITS plan and pushdown genuinely
+    prunes the build chain (a guarded select would take the legacy
+    pending path and force every build column first)."""
+    from ..frame import _merged_global_columns
+
+    right = plan.join_node.right
+    r_needed = set(plan.right_needed or [])
+    r_names = [n for n in right.schema.names if n in r_needed]
+    with ir.allow_planning():
+        if list(right.schema.names) != r_names:
+            right_p = right.select(r_names)
+        else:
+            right_p = right
+        return _merged_global_columns(right_p, r_names, "join")
+
+
+def _run_join(cur, plan: SegmentPlan, rcols: Optional[Dict] = None):
     """Execute a segment's trailing join node: gather the (pruned)
     probe side, force the (pruned) build side, and run the SAME hash
     join core the eager path runs (frame._hash_join_cols). Returns a
     one-block frame holding exactly the join outputs the consumer
     needs — build-side pushdown selects the right frame down to
     ``right_needed`` first, so a lazy right chain never computes (or
-    match-expands) dead columns."""
+    match-expands) dead columns. ``rcols`` passes pre-gathered build
+    columns (the join-chain path forces every build side up front and
+    must not force them twice)."""
     from ..frame import (
         TensorFrame,
         _block_num_rows,
         _hash_join_cols,
-        _merged_global_columns,
     )
+    from ..frame import _merged_global_columns
 
     jn = plan.join_node
     t0 = time.perf_counter()
-    right = jn.right
-    r_needed = set(plan.right_needed or [])
-    r_names = [n for n in right.schema.names if n in r_needed]
-    # the build side is an INDEPENDENT pipeline: escape the lowering
-    # re-entrancy guard so its select records on ITS plan and pushdown
-    # genuinely prunes the build chain (a guarded select would take the
-    # legacy pending path and force every build column first)
-    with ir.allow_planning():
-        if list(right.schema.names) != r_names:
-            right_p = right.select(r_names)
-        else:
-            right_p = right
-        rcols = _merged_global_columns(right_p, r_names, "join")
+    if rcols is None:
+        rcols = _gather_right(plan)
     lcols = _merged_global_columns(cur, list(cur.schema.names), "join")
     out = _hash_join_cols(lcols, rcols, jn.spec)
     keep = list(plan.join_out_names)
@@ -485,6 +504,228 @@ def _run_join(cur, plan: SegmentPlan):
     )
     _FUSED_EPILOGUES["join"].inc()
     return TensorFrame([out], jn.schema.select(keep))
+
+
+# ---------------------------------------------------------------------------
+# adaptive optimizer (ISSUE 14): join-chain reordering + aggregate
+# pushdown below joins + stats feedback. All of it gates on BOTH
+# ``plan_fusion`` and ``plan_reopt`` (TFTPU_REOPT=0 restores the PR 7
+# static lowering exactly), and every rewrite is bit-identical to the
+# unrewritten path by construction — see plan/rules.py eligibility.
+# ---------------------------------------------------------------------------
+
+def _strip_join(plan: SegmentPlan) -> SegmentPlan:
+    """A join segment's inner (pre-join) part as its own plan: the map
+    stages run, the probe columns project, the join itself does not."""
+    return dataclasses.replace(
+        plan, join_node=None, right_needed=None, join_out_names=None
+    )
+
+
+def _as_key_array(v):
+    """Key column → array form ``group_ids`` accepts (host list columns
+    become object arrays, the same convention as the join core)."""
+    if isinstance(v, list):
+        u = np.empty(len(v), dtype=object)
+        u[:] = v
+        return u
+    return np.asarray(v)
+
+
+def _union_key_arrays(a_cols, b_cols):
+    """Per-key union arrays for membership encoding — built by the SAME
+    helper the join core uses (``frame._key_union_col``), so NaN/string
+    semantics cannot drift from ``_hash_join_cols``."""
+    from ..frame import _key_union_col
+
+    return [_key_union_col(a, b) for a, b in zip(a_cols, b_cols)]
+
+
+def _keys_unique(rcols: Dict[str, object], keys: Sequence[str]) -> bool:
+    """True when the key tuple is unique per row (the m=1 condition
+    every adaptive join rewrite needs: with at most one match per key,
+    joins neither duplicate nor scale rows, so they commute and
+    degenerate to semi-join filters)."""
+    from ..frame import _block_num_rows
+    from ..ops.keys import group_ids
+
+    nr = _block_num_rows({k: rcols[k] for k in keys})
+    if nr == 0:
+        return True
+    _, _, ng = group_ids([_as_key_array(rcols[k]) for k in keys])
+    return ng == nr
+
+
+def _join_stat_key(index: int, keys: Sequence[str]) -> str:
+    """Stable per-level stats key inside one plan fingerprint."""
+    return f"{index}:{'+'.join(keys)}"
+
+
+def _note_reoptimized(why: str, details: Dict[str, object]) -> None:
+    """Count + trace one stats-informed (feedback) decision — the
+    ``reoptimized`` series the acceptance criteria key on."""
+    _note_decision(_rules.Decision("reoptimized", why, details))
+
+
+def _sequential_joins(cur, jplans: List[SegmentPlan], rights):
+    """Original-order join execution over pre-gathered build sides (the
+    runtime fallback when a chain's m=1 check fails after the build
+    sides were already forced)."""
+    for k, (p, rc) in enumerate(zip(jplans, rights)):
+        if k > 0:
+            cur = _pruned_source(cur, p.final_names)
+        cur = _run_join(cur, p, rcols=rc)
+    return cur
+
+
+def _run_join_chain(cur, jplans: List[SegmentPlan], fusion_on: bool,
+                    fp: Optional[str]):
+    """Execute a run of consecutive join segments, reordered by the
+    cost model where eligibility holds (plan/rules.plan_join_chain:
+    all-inner, base-rooted keys, no build-side callbacks; runtime m=1
+    via unique build keys). Ineligible chains run exactly as today;
+    eligible ones pre-rename every column to its final (output-schema)
+    name so the hash joins execute in any order without the rename
+    chains interfering — output rows are the base rows, in base order,
+    that match every build side, whatever the order."""
+    from ..frame import TensorFrame, _block_num_rows, _hash_join_cols
+    from ..frame import _JoinSpec, _merged_global_columns
+
+    chain, why_not = _rules.plan_join_chain(jplans)
+    if chain is None:
+        _note_decision(_rules.Decision(
+            "join_order_static",
+            f"multi-join chain keeps recorded order: {why_not}",
+            {"joins": len(jplans)},
+        ))
+        for p in jplans:
+            cur = _run_one_segment(cur, p, fusion_on)
+        return cur
+
+    estimates = [
+        getattr(p.join_node.right, "estimated_rows", None)
+        for p in jplans
+    ]
+    base = _run_one_segment(cur, _strip_join(jplans[0]), fusion_on)
+    rights = [_gather_right(p) for p in jplans]
+    for p, rc in zip(jplans, rights):
+        if not _keys_unique(rc, p.join_node.spec.keys):
+            _note_decision(_rules.Decision(
+                "join_order_static",
+                "build side has duplicate join keys — m>1 joins "
+                "duplicate rows positionally and do not commute",
+                {"joins": len(jplans)},
+            ))
+            return _sequential_joins(base, jplans, rights)
+
+    build_rows = [
+        _block_num_rows({k: rc[k] for k in p.join_node.spec.keys})
+        for p, rc in zip(jplans, rights)
+    ]
+    rec = _stats.lookup(fp) if fp else None
+    sels: List[Optional[float]] = []
+    for idx, lev in enumerate(chain["levels"]):
+        obs = ((rec or {}).get("joins") or {}).get(
+            _join_stat_key(idx, lev["keys"]), {}
+        )
+        sels.append(obs.get("row_sel"))
+    order, decision, used_stats = _rules.decide_join_order(
+        build_rows, sels, estimates
+    )
+    _note_decision(decision)
+    if used_stats:
+        _note_reoptimized(
+            "join order chosen from observed per-join selectivities "
+            "(stats sidecar) instead of build-side size",
+            {"order": list(order)},
+        )
+
+    base_rename = chain["base_rename"]
+    bcols = _merged_global_columns(
+        base, [n for n in base.schema.names if n in base_rename], "join"
+    )
+    lcols = {base_rename[n]: v for n, v in bcols.items()}
+    obs_joins: Dict[str, dict] = {}
+    all_finals = chain["all_finals"]
+    for idx in order:
+        lev = chain["levels"][idx]
+        rr = lev["right_rename"]
+        rcols_f = {rr[n]: v for n, v in rights[idx].items() if n in rr}
+        exec_keys = lev["exec_keys"]
+        espec = _JoinSpec(
+            keys=tuple(exec_keys),
+            how="inner",
+            lname=tuple(
+                (n, n) for n in all_finals
+                if n not in exec_keys and n not in lev["nonkey_finals"]
+            ),
+            rname=tuple((n, n) for n in lev["nonkey_finals"]),
+            fill_value=None,
+        )
+        t_j = time.perf_counter()
+        rows_in = _block_num_rows(lcols)
+        lcols = _hash_join_cols(lcols, rcols_f, espec)
+        rows_out = _block_num_rows(lcols)
+        profiling.record(
+            "join", time.perf_counter() - t_j,
+            rows_in + build_rows[idx],
+        )
+        _FUSED_EPILOGUES["join"].inc()
+        obs_joins[_join_stat_key(idx, lev["keys"])] = {
+            "build_rows": int(build_rows[idx]),
+            "row_sel": round(rows_out / rows_in, 6) if rows_in else 1.0,
+        }
+    if fp:
+        _stats.record_execution(fp, joins=obs_joins)
+    last = jplans[-1]
+    keep = list(last.join_out_names)
+    out = {n: lcols[n] for n in keep}
+    return TensorFrame([out], last.join_node.schema.select(keep))
+
+
+def _has_join_run(plans: Sequence[SegmentPlan]) -> bool:
+    """True when ``plans`` contains a run ``_execute_plans`` would hand
+    to the reordering path (>= 2 consecutive join segments, the later
+    ones bare) — the only execute_plan shape that consults stats, so
+    single-join pipelines skip the fingerprint work entirely."""
+    for i in range(len(plans) - 1):
+        if (
+            plans[i].has_join
+            and plans[i + 1].has_join
+            and not plans[i + 1].included
+            and not plans[i + 1].has_filter
+        ):
+            return True
+    return False
+
+
+def _execute_plans(cur, plans: Sequence[SegmentPlan], fusion_on: bool,
+                   fp: Optional[str] = None):
+    """Run a sequence of segment plans over ``cur``. With the adaptive
+    optimizer on, maximal runs of consecutive join segments (only the
+    first may carry map stages) route through the reordering path;
+    everything else — and everything under TFTPU_REOPT=0 /
+    TFTPU_FUSION=0 — executes segment-by-segment exactly as before."""
+    adaptive = fusion_on and _stats.reopt_enabled()
+    i, n = 0, len(plans)
+    while i < n:
+        j = i
+        if adaptive and plans[i].has_join:
+            while (
+                j + 1 < n
+                and plans[j + 1].has_join
+                and not plans[j + 1].included
+                and not plans[j + 1].has_filter
+            ):
+                j += 1
+        if j > i:
+            cur = _run_join_chain(cur, list(plans[i:j + 1]), fusion_on,
+                                  fp)
+            i = j + 1
+        else:
+            cur = _run_one_segment(cur, plans[i], fusion_on)
+            i += 1
+    return cur
 
 
 def _plan_segments(
@@ -573,11 +814,12 @@ def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
     # plan_fusion off before forcing (the knob exists to rule fusion
     # out — it must rule it out for already-built frames as well)
     fusion_on = bool(get_config().plan_fusion)
+    fp = None
+    if fusion_on and _stats.reopt_enabled() and _has_join_run(plans):
+        fp = _stats.chain_fingerprint(source, nodes)
     t_exec = time.perf_counter()
-    cur = source
     with ir.lowering():
-        for plan in plans:
-            cur = _run_one_segment(cur, plan, fusion_on)
+        cur = _execute_plans(source, plans, fusion_on, fp)
     if _events.TRACER.enabled:
         _events.TRACER.emit_complete(
             "plan.execute", t_exec, time.perf_counter() - t_exec,
@@ -810,9 +1052,60 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
         if not inner:
             return host_fallback(source, None)
         plans = _plan_segments(source, inner, need)
-        mid = source
-        for plan in plans[:-1]:
-            mid = _run_one_segment(mid, plan, fusion_on)
+        adaptive = fusion_on and _stats.reopt_enabled()
+        fp = _stats.chain_fingerprint(source, nodes) if adaptive else None
+
+        # ---- aggregate pushdown below a trailing join chain (the
+        # ISSUE 14 rewrite): eligible shapes run the partial aggregate
+        # BELOW the join(s) and filter whole groups above — rows never
+        # match-expand. Ineligible shapes keep today's path, counted,
+        # with the fixable causes recorded as TFG110 evidence. --------
+        if adaptive and plans[-1].has_join:
+            push, misses = _rules.plan_pushdown(
+                plans, keys, seg_info, node.schema
+            )
+            if push is None:
+                if misses:
+                    f_res = node.frame()
+                    if f_res is not None:
+                        for m in misses:
+                            ir.mark_pushdown_miss(f_res, m)
+                    _note_decision(_rules.Decision(
+                        "pushdown_ineligible", misses[0]["detail"],
+                        {"cause": misses[0]["cause"]},
+                    ))
+            else:
+                rec = _stats.lookup(fp)
+                do_push, decision, used_stats = _rules.decide_pushdown(
+                    push, rec
+                )
+                if used_stats:
+                    _note_reoptimized(
+                        "pushdown choice informed by observed row "
+                        "survival through the joins (stats sidecar)",
+                        {"decision": decision.kind},
+                    )
+                if do_push:
+                    mid_p = _execute_plans(
+                        source, plans[:push.start], fusion_on, fp
+                    )
+                    blocks = _pushdown_aggregate(
+                        mid_p, plans, push, node, seg_info, fusion_on,
+                        fp, decision, t_exec,
+                    )
+                    if blocks is not None:
+                        return blocks
+                    # runtime-ineligible (duplicate build keys, ragged
+                    # cells): finish exactly as the static path would,
+                    # from the already-computed prefix
+                    cur = _execute_plans(
+                        mid_p, plans[push.start:-1], fusion_on, fp
+                    )
+                    cur = _run_one_segment(cur, plans[-1], fusion_on)
+                    return host_fallback(cur, None)
+                _note_decision(decision)  # pushdown_skipped_selective
+
+        mid = _execute_plans(source, plans[:-1], fusion_on, fp)
         last = plans[-1]
 
         reason = None
@@ -860,6 +1153,20 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
         seg_ids, group_key_cols, num_groups = frame_group_ids(mid, keys)
 
         ops_key = tuple((x, op) for x, op, _ in seg_info)
+        # feedback: a recurring aggregate's observed group counts warm
+        # the segment-bucket history, so a fresh process that
+        # historically saw K proliferate buckets on its FIRST force
+        # instead of re-learning (and re-tracing) per distinct count
+        rec_agg = _stats.lookup(fp) if fp else None
+        if rec_agg:
+            hist = (rec_agg.get("agg") or {}).get("counts") or []
+            if hist:
+                _rules.warm_segment_bucket(ops_key, hist)
+                _note_reoptimized(
+                    "segment-bucket history warm-started from observed "
+                    "group counts (stats sidecar)",
+                    {"counts": [int(c) for c in hist]},
+                )
         ops_and_dtypes = [
             (op, _value_dtype(last, pruned.schema, x))
             for x, op, _ in seg_info
@@ -991,6 +1298,11 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
     block = dict(zip(keys, group_key_cols))
     block.update({x: out_cols[x] for x in out_names})
     profiling.record("aggregate", time.perf_counter() - t_exec, n_total)
+    if fp:
+        _stats.record_execution(
+            fp, agg={"num_groups": int(num_groups)},
+            wall_s=time.perf_counter() - t_exec,
+        )
     if _events.TRACER.enabled:
         _events.TRACER.emit_complete(
             "plan.execute", t_exec, time.perf_counter() - t_exec,
@@ -998,6 +1310,279 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
                   "epilogue": decision.kind}, cat="plan",
         )
     return [block]
+
+
+def _pushdown_aggregate(
+    mid, plans: Sequence[SegmentPlan], push, node, seg_info,
+    fusion_on: bool, fp: Optional[str], decision, t_exec: float,
+) -> Optional[List[Dict[str, object]]]:
+    """Execute an eligible aggregate-below-join rewrite: the partial
+    aggregate runs over the pushed side's full row set (maps fused, one
+    segment-reduce dispatch), and each pushed inner join degenerates to
+    a whole-group semi-join filter over the partial tables — rows never
+    match-expand through the join, and the build sides force only their
+    key columns (pure build-side value stages never compute; callback
+    stages still execute via the select path's keep rule).
+
+    Bit-identity holds by construction: group encoding is lexicographic
+    (row-order independent), a group's join key is functionally
+    determined by the group (keys ⊆ group keys), build keys are unique
+    (m=1 — verified here, BEFORE any probe-side stage runs, so the
+    static fallback never replays a stage), and every (op, dtype) is
+    reassoc-safe, making per-group partials exact whatever the backend.
+
+    Returns the result blocks, or None when a runtime condition fails —
+    the caller then finishes on the static path, counted."""
+    from ..frame import _merged_global_columns
+    from ..ops.keys import frame_group_ids, group_ids
+    from ..ops.verbs import (
+        _demote_cast,
+        _empty_agg_blocks,
+        _segment_reduce_best,
+    )
+
+    keys = list(node.keys)
+    out_names = list(node.out_names)
+    ops_key = tuple((x, op) for x, op, _ in seg_info)
+    base_plan = _strip_join(plans[push.start])
+
+    def runtime_miss(cause: str, subject: str, detail: str, fix: str):
+        f_res = node.frame()
+        if f_res is not None:
+            ir.mark_pushdown_miss(f_res, {
+                "cause": cause, "subject": subject, "detail": detail,
+                "fix": fix,
+            })
+        _note_decision(_rules.Decision(
+            "pushdown_ineligible", detail, {"cause": cause},
+        ))
+
+    level_keys: List[Optional[Dict[str, object]]] = [None] * len(
+        push.levels
+    )
+    if push.side == "left":
+        # a host callback in a build-side chain bars the rewrite: the
+        # key-column force here plus a later runtime fallback's full
+        # force would run the callback twice (a pure build chain just
+        # recomputes — cheap and side-effect free)
+        for lev in push.levels:
+            right = plans[lev.plan_index].join_node.right
+            rnode = getattr(right, "_plan", None)
+            if rnode is not None and not right.is_materialized:
+                _, rnodes = ir.resolve_chain(rnode)
+                if any(
+                    n.kind == "map"
+                    and ir.program_has_callback(n.program)
+                    for n in rnodes
+                ):
+                    runtime_miss(
+                        "build_callback", "+".join(lev.spec.keys),
+                        "a build-side stage contains a host callback; "
+                        "the pushdown's key-only force plus a runtime "
+                        "fallback would execute it twice",
+                        "keep host callbacks out of joined build "
+                        "chains, or materialize the build side first",
+                    )
+                    return None
+        # force every pushed build side down to its key columns and
+        # verify m=1 BEFORE any probe-side stage runs (the fallback
+        # must never replay a stage — callbacks execute exactly once);
+        # innermost level first, matching the static path's forcing
+        # order for build-side effects
+        for li in range(len(push.levels) - 1, -1, -1):
+            lev = push.levels[li]
+            spec = lev.spec
+            right = plans[lev.plan_index].join_node.right
+            kcols = list(spec.keys)
+            with ir.allow_planning():
+                rsel = (
+                    right.select(kcols)
+                    if list(right.schema.names) != kcols else right
+                )
+                rcols = _merged_global_columns(rsel, kcols, "join")
+            if not _keys_unique(rcols, spec.keys):
+                runtime_miss(
+                    "duplicate_build_keys", "+".join(spec.keys),
+                    f"build side of the join on {list(spec.keys)} has "
+                    "duplicate keys — m>1 matches scale group partials "
+                    "and bar the whole-group rewrite",
+                    "drop_duplicates the build side on its join keys, "
+                    "or accept the aggregate-above path",
+                )
+                return None
+            level_keys[li] = rcols
+        B = _run_one_segment(mid, base_plan, fusion_on)
+    else:  # side == 'right': aggregate the build frame below the join
+        lev = push.levels[0]
+        spec = lev.spec
+        jn = plans[lev.plan_index].join_node
+        right = jn.right
+        # a callback anywhere the fallback would replay (probe maps) or
+        # the pushed side would force twice bars the rewrite outright
+        if any(
+            ir.program_has_callback(n.program)
+            for n in base_plan.included
+        ):
+            runtime_miss(
+                "probe_callback", "+".join(spec.keys),
+                "a probe-side stage contains a host callback; a "
+                "runtime fallback after running it would execute the "
+                "callback twice",
+                "keep host callbacks out of aggregated join chains",
+            )
+            return None
+        for k in spec.keys:
+            if jn.schema[k].dtype.name != right.schema[k].dtype.name:
+                runtime_miss(
+                    "key_dtype_mismatch", k,
+                    f"join key {k!r} has dtype "
+                    f"{jn.schema[k].dtype.name} on the probe side but "
+                    f"{right.schema[k].dtype.name} on the build side — "
+                    "the output key column comes from the probe side",
+                    "cast the key columns to one dtype before joining",
+                )
+                return None
+        # probe side runs its maps (keys only — plan_segment pruned the
+        # probe requirement down to the join keys), then m=1 check
+        B_left = _run_one_segment(mid, base_plan, fusion_on)
+        lkcols = _merged_global_columns(
+            B_left, list(spec.keys), "join"
+        )
+        if not _keys_unique(lkcols, spec.keys):
+            runtime_miss(
+                "duplicate_build_keys", "+".join(spec.keys),
+                f"probe side of the join on {list(spec.keys)} has "
+                "duplicate keys — each build row would repeat once per "
+                "matching probe row",
+                "drop_duplicates the probe side on its join keys, or "
+                "accept the aggregate-above path",
+            )
+            return None
+        level_keys[0] = lkcols
+        rneed = list(dict.fromkeys(
+            list(push.key_base) + list(push.val_base.values())
+        ))
+        with ir.allow_planning():
+            B = (
+                right.select(rneed)
+                if list(right.schema.names) != rneed else right
+            )
+            B.blocks()
+
+    if B.num_rows == 0:
+        _note_decision(decision)
+        profiling.record("aggregate", time.perf_counter() - t_exec, 0)
+        return _empty_agg_blocks(node.schema)
+
+    # partial aggregate over the pushed side's full row set: cached key
+    # encode + ONE segment-reduce dispatch (backend per the cost model)
+    seg_ids, group_key_cols, num_groups = frame_group_ids(
+        B, push.key_base
+    )
+    val_cols = {}
+    for x in out_names:
+        vals = B.column_values(push.val_base[x])
+        if vals.dtype == object:
+            # the unrewritten path raises identically for ragged value
+            # cells — same contract, same wording
+            raise ValueError(
+                f"Column {push.val_base[x]!r} is ragged; aggregate "
+                "requires uniform cells (run analyze() first)."
+            )
+        val_cols[x] = _demote_cast(
+            vals, node.program.input(f"{x}_input")
+        )
+    out_cols = _segment_reduce_best(
+        ops_key, num_groups, val_cols, seg_ids
+    )
+
+    # each pushed inner join = a whole-group semi-join filter (the
+    # lexicographic group order is row-order independent, so the
+    # surviving groups keep exactly the unrewritten output order)
+    mask = np.ones(num_groups, dtype=bool)
+    for lev, rcols in zip(push.levels, level_keys):
+        if lev.how != "inner":
+            continue  # left joins keep every group
+        g_arrays = [
+            group_key_cols[keys.index(fin)] for fin in lev.key_finals
+        ]
+        r_arrays = [rcols[k] for k in lev.spec.keys]
+        codes, _, _ = group_ids(_union_key_arrays(g_arrays, r_arrays))
+        mask &= np.isin(codes[:num_groups], codes[num_groups:])
+
+    n_base = int(len(seg_ids))
+    counts = np.bincount(seg_ids, minlength=num_groups)
+    surviving_rows = int(counts[mask].sum())
+    survival = (surviving_rows / n_base) if n_base else 1.0
+    _note_decision(dataclasses.replace(decision, details={
+        **decision.details,
+        "num_groups": int(num_groups),
+        "groups_kept": int(mask.sum()),
+        "base_rows": n_base,
+        "survival": round(survival, 4),
+    }))
+    if fp:
+        _stats.record_execution(
+            fp,
+            push={"survival": round(survival, 6),
+                  "levels": len(push.levels)},
+            agg={"num_groups": int(num_groups)},
+            wall_s=time.perf_counter() - t_exec,
+        )
+    if not mask.any():
+        profiling.record(
+            "aggregate", time.perf_counter() - t_exec, n_base
+        )
+        return _empty_agg_blocks(node.schema)
+    surv = np.flatnonzero(mask)
+    block: Dict[str, object] = {}
+    for i, fin in enumerate(keys):
+        block[fin] = group_key_cols[i][surv]
+    for x in out_names:
+        block[x] = np.asarray(out_cols[x])[surv]
+    profiling.record("aggregate", time.perf_counter() - t_exec, n_base)
+    if _events.TRACER.enabled:
+        _events.TRACER.emit_complete(
+            "plan.execute", t_exec, time.perf_counter() - t_exec,
+            args={"segments": len(plans), "verb": "aggregate",
+                  "epilogue": "pushdown_below_join"}, cat="plan",
+        )
+    return [block]
+
+
+def pushdown_misses(frame) -> List[dict]:
+    """TFG110 evidence for ``lint_plan``: the fixable causes blocking
+    an aggregate-below-join pushdown on ``frame`` — the static
+    eligibility walk re-run over the recorded plan (pure; never forces
+    the frame, same contract as ``chain_barriers``) plus any runtime
+    causes the lowering recorded via ``ir.mark_pushdown_miss``
+    (duplicate build-side keys are only discoverable at force time)."""
+    out = list(ir.pushdown_miss_log(frame))
+    node = getattr(frame, "_plan", None)
+    if node is None or node.kind != "aggregate":
+        return out
+    source, nodes = ir.resolve_chain(node)
+    inner = [n for n in nodes if n is not node]
+    if not inner or not any(n.kind == "join" for n in inner):
+        return out
+    keys = list(node.keys)
+    need = list(dict.fromkeys(keys + list(node.out_names)))
+    try:
+        plans = _plan_segments(source, inner, need)
+        if not plans or not plans[-1].has_join:
+            return out
+        push, misses = _rules.plan_pushdown(
+            plans, keys, list(node.spec), node.schema
+        )
+    except Exception:  # pragma: no cover - lint must never raise
+        return out
+    if push is None:
+        seen = {(m.get("cause"), m.get("subject")) for m in out}
+        out.extend(
+            m for m in misses
+            if (m.get("cause"), m.get("subject")) not in seen
+        )
+    return out
 
 
 def lower_reduce(

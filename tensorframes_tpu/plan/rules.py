@@ -53,6 +53,14 @@ __all__ = [
     "decide_decode_attention",
     "decide_ragged_gather",
     "reassoc_safe",
+    "PushdownPlan",
+    "PushdownLevel",
+    "plan_pushdown",
+    "decide_pushdown",
+    "plan_join_chain",
+    "decide_join_order",
+    "warm_segment_bucket",
+    "PUSHDOWN_MIN_SURVIVAL",
 ]
 
 
@@ -496,6 +504,421 @@ def decide_ragged_gather(
         "transfers eliminated)",
         {"rows": int(n_rows), "shape_groups": int(n_groups)},
     )
+
+
+# ---------------------------------------------------------------------------
+# adaptive optimizer (ISSUE 14): aggregate pushdown below joins, join
+# reordering, and stats-fed re-optimization. Pure planning/decision
+# functions — the lowering executes and counts; TFTPU_REOPT=0 keeps
+# all of it off. Every rewrite here is gated on exactness: only
+# reassoc_safe (op, dtype) pairs push below a join, only m=1 joins
+# (unique build keys, verified at runtime by the lowering) rewrite at
+# all, so the rewritten plan is bit-identical to TFTPU_FUSION=0 by
+# construction — group encoding is lexicographic (ops/keys.py), hence
+# row-order independent, and the surviving-group filter preserves it.
+# ---------------------------------------------------------------------------
+
+#: Observed fraction of base rows surviving the pushed-below joins
+#: under which pushdown is re-optimized AWAY: aggregating everything
+#: below the join costs O(base rows), while highly selective joins
+#: leave the aggregate-above path with far fewer rows to reduce.
+PUSHDOWN_MIN_SURVIVAL = 0.05
+
+
+@dataclasses.dataclass
+class PushdownLevel:
+    """One join the aggregate pushes below (outermost level first)."""
+
+    plan_index: int          # index of the join's segment in ``plans``
+    spec: object             # the join's _JoinSpec
+    how: str
+    #: group-key OUTPUT names aligned 1:1 with ``spec.keys`` — the
+    #: lowering's semi-join filter reads these group key columns.
+    key_finals: List[str]
+
+
+@dataclasses.dataclass
+class PushdownPlan:
+    """Lowering-ready description of an aggregate-below-join rewrite."""
+
+    side: str                # 'left' (probe chain) | 'right' (build frame)
+    start: int               # plans index of the innermost pushed segment
+    levels: List[PushdownLevel]
+    key_base: List[str]      # group-key originals at the pushed side
+    val_base: Dict[str, str]  # fetch output name -> pushed-side original
+
+
+def _miss(cause: str, subject: str, detail: str, fix: str) -> Dict[str, str]:
+    return {"cause": cause, "subject": subject, "detail": detail,
+            "fix": fix}
+
+
+def plan_pushdown(plans, keys, seg_info, agg_schema):
+    """Static eligibility walk for aggregate pushdown below a trailing
+    join chain. Returns ``(PushdownPlan | None, misses)`` — ``misses``
+    holds the *fixable* blocking causes (the TFG110 evidence: each
+    names the blocking column/fetch and a fix). Pure: no execution, no
+    forcing; the runtime conditions (unique build-side keys, dense
+    value cells) are verified by the lowering, which falls back to the
+    static path when they fail.
+
+    Eligibility (every rewrite bit-identical to ``TFTPU_FUSION=0``):
+
+    * every fetch's (op, value dtype) is :func:`reassoc_safe` — the
+      order-sensitive float sums/means PR 7 already excludes from
+      tree-combining stay excluded here;
+    * walking joins outermost→inner, the group keys and every value
+      column map to ONE side (join keys live on both); the probe
+      (left) side may be descended through multiple bare join
+      segments, the build (right) side only at the outermost level
+      under ``how='inner'``;
+    * each pushed join's keys are covered by the group keys (the group
+      then functionally determines the join key, so a group is matched
+      or unmatched as a whole — the join degenerates to a semi-join
+      filter over whole groups);
+    * ``how`` is ``inner`` (groups filter to matched keys) or ``left``
+      (no filter) — ``outer`` appends fill-valued rows and never
+      pushes.
+    """
+    misses: List[Dict[str, str]] = []
+    L = len(plans)
+    if L == 0 or not plans[L - 1].has_join:
+        return None, misses
+    unsafe = []
+    for x, op, _ in seg_info:
+        np_dt = getattr(agg_schema[x].dtype, "np_dtype", None)
+        if np_dt is None or not reassoc_safe(op, np_dt):
+            unsafe.append((x, op))
+    if unsafe:
+        for x, op in unsafe:
+            misses.append(_miss(
+                "float_reassoc", x,
+                f"fetch {x!r} ({op}) reassociates: a float sum/mean "
+                "computed below the join is not bit-identical to the "
+                "unfused reduction over joined rows",
+                f"aggregate an integer-typed column, or accept the "
+                f"epilogue-above path for {x!r} (bit-identity is "
+                "mandatory, so order-sensitive float reductions never "
+                "push below joins)",
+            ))
+        return None, misses
+
+    # needs: final (aggregate-schema) name -> name at the current level
+    needs: Dict[str, str] = {
+        n: n for n in list(keys) + [x for x, _, _ in seg_info]
+    }
+    levels: List[PushdownLevel] = []
+    side: Optional[str] = None
+    i = L - 1
+    start = i
+    while i >= 0 and plans[i].has_join:
+        spec = plans[i].join_node.spec
+        inv_l = {out: orig for orig, out in spec.lname}
+        inv_r = {out: orig for orig, out in spec.rname}
+        cur_to_final = {cur: fin for fin, cur in needs.items()}
+        gcur = {needs[f] for f in keys}
+        missing = [k for k in spec.keys if k not in gcur]
+        if missing:
+            misses.append(_miss(
+                "key_not_grouped", missing[0],
+                f"join key(s) {missing} are not group keys, so a group "
+                "can span matched and unmatched join keys — the join "
+                "cannot degenerate to a whole-group semi-join filter",
+                f"group by {missing} as well (the join key then rides "
+                "the group), or aggregate before joining",
+            ))
+            break
+        mapped: Dict[str, str] = {}
+        left_cols, right_cols = [], []
+        for fin, cur in needs.items():
+            if cur in spec.keys:
+                mapped[fin] = cur
+            elif cur in inv_l:
+                mapped[fin] = inv_l[cur]
+                left_cols.append(fin)
+            elif cur in inv_r:
+                mapped[fin] = inv_r[cur]
+                right_cols.append(fin)
+        if right_cols and left_cols:
+            misses.append(_miss(
+                "mixed_sides", right_cols[0],
+                f"column(s) {sorted(left_cols)} come from the probe "
+                f"side but {sorted(right_cols)} from the build side — "
+                "a partial aggregate below either side cannot produce "
+                "both",
+                "restrict the group keys and fetches to one side of "
+                "the join (join keys count as either side)",
+            ))
+            break
+        if right_cols:
+            # build-side pushdown: outermost level only, inner only —
+            # unmatched probe rows under how='left' would inject fill
+            # values into the groups.
+            if levels:
+                misses.append(_miss(
+                    "mixed_sides", right_cols[0],
+                    f"column(s) {sorted(right_cols)} come from an "
+                    "inner join's build side below an already-pushed "
+                    "level",
+                    "restrict the fetches to the probe side, or "
+                    "aggregate before the outer joins",
+                ))
+                break
+            if spec.how != "inner":
+                misses.append(_miss(
+                    "outer_or_left_build", right_cols[0],
+                    f"how={spec.how!r} keeps unmatched probe rows "
+                    "whose build-side columns take fill values — fills "
+                    "would enter the pushed-down groups",
+                    "use an inner join, or aggregate probe-side "
+                    "columns instead",
+                ))
+                break
+            side = "right"
+            levels.append(PushdownLevel(
+                plan_index=i, spec=spec, how=spec.how,
+                key_finals=[cur_to_final[k] for k in spec.keys],
+            ))
+            needs = mapped
+            start = i
+            break
+        # probe-side descent
+        if spec.how not in ("inner", "left"):
+            misses.append(_miss(
+                "outer_join", "+".join(spec.keys),
+                f"how={spec.how!r} appends unmatched build rows with "
+                "fill-valued probe columns — fills would enter the "
+                "pushed-down groups",
+                "use an inner or left join, or aggregate before "
+                "joining",
+            ))
+            break
+        side = "left"
+        levels.append(PushdownLevel(
+            plan_index=i, spec=spec, how=spec.how,
+            key_finals=[cur_to_final[k] for k in spec.keys],
+        ))
+        needs = mapped
+        start = i
+        if plans[i].included or i == 0:
+            # this segment's own map stages compute below its join —
+            # it becomes the base level (maps run, aggregate above
+            # them, semi-join filters above that)
+            break
+        i -= 1
+    if not levels:
+        return None, misses
+    return PushdownPlan(
+        side=side,
+        start=start,
+        levels=levels,
+        key_base=[needs[f] for f in keys],
+        val_base={x: needs[x] for x, _, _ in seg_info},
+    ), misses
+
+
+def decide_pushdown(
+    push: PushdownPlan, stats_record: Optional[dict]
+) -> Tuple[bool, Decision, bool]:
+    """Push-vs-keep for an eligible aggregate-below-join rewrite.
+    Statically pushdown always wins (the join's match expansion and
+    gather disappear); the observed-survival feedback re-optimizes it
+    AWAY when a previous execution measured that the joins discard
+    almost every row (aggregating the full base side then costs more
+    than joining first). Returns ``(push?, decision, used_stats)``."""
+    details: Dict[str, object] = {
+        "levels": len(push.levels), "side": push.side,
+    }
+    survival = None
+    if stats_record:
+        survival = (stats_record.get("push") or {}).get("survival")
+    if survival is not None:
+        details["observed_survival"] = round(float(survival), 4)
+        if float(survival) < PUSHDOWN_MIN_SURVIVAL:
+            return False, Decision(
+                "pushdown_skipped_selective",
+                f"observed survival {float(survival):.3f} < "
+                f"{PUSHDOWN_MIN_SURVIVAL}: the joins discard nearly "
+                "every row, so aggregating above them reduces far "
+                "fewer rows than the full pushed-down side",
+                details,
+            ), True
+        return True, Decision(
+            "pushdown_aggregate",
+            f"{len(push.levels)} join(s) degenerate to whole-group "
+            "semi-join filters (observed survival "
+            f"{float(survival):.3f}): partial aggregate runs below, "
+            "rows never match-expand",
+            details,
+        ), True
+    return True, Decision(
+        "pushdown_aggregate",
+        f"{len(push.levels)} join(s) degenerate to whole-group "
+        "semi-join filters: partial aggregate runs below, rows never "
+        "match-expand through the join",
+        details,
+    ), False
+
+
+# ---------------------------------------------------------------------------
+# multi-join reordering
+# ---------------------------------------------------------------------------
+
+def plan_join_chain(jplans) -> Tuple[Optional[dict], str]:
+    """Static eligibility + rename maps for reordering a run of
+    consecutive join segments. Returns ``(chain_info, reason)`` —
+    ``chain_info`` is None when ineligible (``reason`` says why).
+
+    Eligibility (reordering must be bit-identical, like every rewrite):
+
+    * every join is ``inner`` (left/outer fills depend on position);
+    * every join's keys trace back to the BASE probe frame (a key
+      produced by an earlier join's build side pins that order);
+    * no build-side chain contains a host callback (reordering would
+      reorder its side effects);
+    * with the runtime m=1 condition (unique build keys, checked by
+      the lowering), inner joins then commute: the output rows are the
+      base rows, in base order, that match EVERY build side — the same
+      set whatever the order.
+
+    ``chain_info`` maps every column to its FINAL (output-schema) name
+    so the lowering can pre-rename both sides and execute the joins in
+    any order without rename chains interfering:
+
+    * ``base_rename``: base column -> final name;
+    * per level: ``exec_keys`` (final key names), ``right_rename``
+      (build column -> final, key columns included), ``key_base``
+      (base-frame names of the keys, for stats/selectivity).
+    """
+    from .ir import program_has_callback, resolve_chain
+
+    for p in jplans:
+        if p.join_node.spec.how != "inner":
+            return None, f"how={p.join_node.spec.how!r} join pins its " \
+                         "position (only inner joins commute)"
+    for p in jplans:
+        right = p.join_node.right
+        node = getattr(right, "_plan", None)
+        if node is not None and not right.is_materialized:
+            _, rnodes = resolve_chain(node)
+            if any(
+                n.kind == "map" and program_has_callback(n.program)
+                for n in rnodes
+            ):
+                return None, "a build-side chain contains a host " \
+                             "callback (reordering would reorder its " \
+                             "side effects)"
+
+    base_names = list(jplans[0].final_names)
+    live: Dict[str, Tuple[str, object]] = {
+        n: ("base", n) for n in base_names
+    }
+    levels: List[dict] = []
+    for i, p in enumerate(jplans):
+        spec = p.join_node.spec
+        lname = dict(spec.lname)
+        key_base = []
+        for k in spec.keys:
+            if k not in live:
+                return None, f"join key {k!r} is not visible on the " \
+                             "pruned probe side"
+            tag, orig = live[k]
+            if tag != "base":
+                return None, f"join key {k!r} comes from an earlier " \
+                             "join's build side — that join must run " \
+                             "first"
+            key_base.append(orig)
+        new_live: Dict[str, Tuple[str, object]] = {}
+        for n, origin in live.items():
+            if n in spec.keys:
+                new_live[n] = origin
+            elif n in lname:
+                new_live[lname[n]] = origin
+            else:  # pragma: no cover - lname covers the full schema
+                return None, f"column {n!r} has no rename entry at " \
+                             f"join {i}"
+        needed_r = set(p.right_needed or [])
+        for orig, out in spec.rname:
+            if orig in needed_r:
+                new_live[out] = (f"right{i}", orig)
+        levels.append({"spec": spec, "keys": tuple(spec.keys),
+                       "key_base": key_base})
+        live = new_live
+
+    finals = list(live)
+    if len(set(finals)) != len(finals):  # pragma: no cover - defensive
+        return None, "final column names collide"
+    base_rename = {orig: fin for fin, (tag, orig) in live.items()
+                   if tag == "base"}
+    for i, (lev, p) in enumerate(zip(levels, jplans)):
+        spec = lev["spec"]
+        rr = {orig: fin for fin, (tag, orig) in live.items()
+              if tag == f"right{i}"}
+        for k, kb in zip(lev["keys"], lev["key_base"]):
+            rr[k] = base_rename[kb]
+        lev["right_rename"] = rr
+        lev["exec_keys"] = tuple(
+            base_rename[kb] for kb in lev["key_base"]
+        )
+        lev["nonkey_finals"] = tuple(
+            fin for fin, (tag, _) in live.items() if tag == f"right{i}"
+        )
+    return {
+        "base_rename": base_rename,
+        "levels": levels,
+        "all_finals": finals,
+    }, ""
+
+
+def decide_join_order(
+    build_rows: Sequence[int],
+    observed_sels: Sequence[Optional[float]],
+    estimates: Sequence[Optional[int]] = (),
+) -> Tuple[List[int], Decision, bool]:
+    """Execution order for an eligible join run. Static rule: smallest
+    build side first (a smaller hash table probes cheaper and — on
+    star schemas — correlates with selectivity). Feedback rule: once a
+    previous execution observed per-join row selectivity, the most
+    selective join runs first so later joins probe fewer rows.
+    Returns ``(order, decision, used_stats)``."""
+    n = len(build_rows)
+    details: Dict[str, object] = {
+        "build_rows": [int(b) for b in build_rows],
+    }
+    if estimates:
+        details["estimated_rows"] = [
+            (int(e) if e is not None else None) for e in estimates
+        ]
+    used_stats = all(s is not None for s in observed_sels) and n > 0
+    if used_stats:
+        details["observed_sel"] = [round(float(s), 4)
+                                  for s in observed_sels]
+        order = sorted(
+            range(n),
+            key=lambda i: (float(observed_sels[i]), int(build_rows[i]), i),
+        )
+        why = "observed per-join row selectivity (stats sidecar): " \
+              "most selective join first, later joins probe fewer rows"
+    else:
+        order = sorted(range(n), key=lambda i: (int(build_rows[i]), i))
+        why = "estimated build-side size: smallest hash table first"
+    details["order"] = list(order)
+    if order == list(range(n)):
+        return order, Decision(
+            "join_order_static",
+            "recorded order already optimal by " + why, details,
+        ), used_stats
+    return order, Decision("reorder_joins", why, details), used_stats
+
+
+def warm_segment_bucket(ops_key: tuple, counts: Sequence[int]) -> None:
+    """Warm-start the segment-bucketing history from observed group
+    counts (the stats sidecar): a fresh process that historically saw
+    K proliferate starts bucketing on its FIRST aggregate instead of
+    re-learning (and re-tracing) per distinct count."""
+    with _K_LOCK:
+        seen = _K_HISTORY.setdefault(ops_key, set())
+        seen.update(int(c) for c in counts)
 
 
 # Segment-count bucketing history: per (ops fingerprint), the distinct

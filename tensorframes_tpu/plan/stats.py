@@ -1,0 +1,298 @@
+"""Per-plan-fingerprint execution statistics: the feedback half of the
+adaptive optimizer (ROADMAP #4, HiFrames-style).
+
+The PR 7 cost model makes static, one-shot decisions. This module
+closes the loop: every adaptive lowering records what it OBSERVED —
+per-join build-side cardinalities and row selectivities, aggregate
+group counts, segment wall-clock — keyed by a stable **plan
+fingerprint** (a content hash of the logical chain: node kinds, stage
+program signatures, join specs, aggregate ops — no ``id()``/``hash()``,
+so the same pipeline rebuilt in a new process keys identically). The
+next execution of the same pipeline consults the record and picks a
+better lowering — join order by observed selectivity instead of build
+size, pushdown skipped when the joins are observed to discard most
+rows, segment-bucket history warm-started — each consultation counted
+as a ``reoptimized`` decision in ``tftpu_plan_cost_decisions_total``.
+
+Persistence: records live in memory and, when ``TFTPU_COMPILE_CACHE``
+names a directory, as one JSON sidecar per fingerprint under
+``<cache>/planstats/`` (write-temp → ``os.replace``, same durability
+discipline as the AOT store). Sidecar problems follow the AOT store's
+contract exactly: a corrupt, stale, or unreadable record is counted,
+quarantined (unlinked), and the decision falls back to static — a
+stats problem can never fail a dispatch or change results (stats are
+hints; correctness never depends on them).
+
+``TFTPU_REOPT=0`` (``config.plan_reopt``) disables the whole adaptive
+layer: :func:`lookup` returns None, :func:`record_execution` no-ops,
+and the lowering keeps the PR 7 static paths bit-identically.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..observability.metrics import counter as _counter
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "FORMAT_VERSION",
+    "reopt_enabled",
+    "chain_fingerprint",
+    "lookup",
+    "record_execution",
+    "clear_memory",
+    "sidecar_dir",
+]
+
+#: Sidecar record format; a version bump quarantines old records.
+FORMAT_VERSION = 1
+
+# Registered at import (TFL003): the sidecar family expositions from
+# process start even when re-optimization never engages.
+_SIDECAR_EVENTS = {
+    event: _counter(
+        "tftpu_plan_reopt_sidecar_total",
+        "Plan-stats sidecar operations (the adaptive optimizer's "
+        "feedback store under TFTPU_COMPILE_CACHE), by event",
+        labels={"event": event},
+    )
+    for event in ("load", "store", "quarantine")
+}
+
+_LOCK = threading.Lock()
+_MEM: "OrderedDict[str, dict]" = OrderedDict()
+_MEM_MAX = 256
+#: Bound on per-record observation lists (recent distinct group counts).
+_OBS_MAX = 16
+
+
+def reopt_enabled() -> bool:
+    """True when the adaptive optimizer may rewrite plans and consult
+    or record stats (``TFTPU_REOPT=0`` / ``configure(plan_reopt=False)``
+    is the escape hatch back to the static cost model)."""
+    from ..config import get_config
+
+    return bool(get_config().plan_reopt)
+
+
+def sidecar_dir() -> Optional[str]:
+    """The sidecar directory under the compile cache root, or None when
+    no cache dir is configured (stats then stay in-memory only)."""
+    from ..config import get_config
+
+    root = get_config().compilation_cache_dir
+    if not root:
+        return None
+    return os.path.join(root, "planstats")
+
+
+# ---------------------------------------------------------------------------
+# plan fingerprinting: a stable content key for one logical chain
+# ---------------------------------------------------------------------------
+
+def _program_sig(program) -> object:
+    """Stable signature of a stage/reduce Program: named input/output
+    specs (dtype + cell dims). Deliberately NOT the jaxpr — the
+    fingerprint must be cheap enough to compute per force, and a
+    collision only merges two pipelines' stats (hints, not keys for
+    correctness)."""
+    try:
+        ins = [
+            (s.name, s.dtype.name, [str(d) for d in s.shape.dims])
+            for s in (program.inputs or [])
+        ]
+        outs = [
+            (s.name, s.dtype.name, [str(d) for d in s.shape.dims])
+            for s in (program.outputs or [])
+        ]
+        return {"in": ins, "out": outs}
+    except Exception:  # pragma: no cover - exotic program-likes
+        return {"in": [], "out": []}
+
+
+def _frame_sig(frame) -> object:
+    try:
+        return [(c.name, c.dtype.name) for c in frame.schema]
+    except Exception:  # pragma: no cover
+        return []
+
+
+def _node_sig(node) -> object:
+    sig: Dict[str, object] = {"kind": node.kind}
+    if node.kind == "map":
+        sig["rows"] = bool(node.rows)
+        sig["out"] = list(node.out_names)
+        sig["program"] = _program_sig(node.program)
+    elif node.kind == "select":
+        sig["names"] = list(node.names)
+    elif node.kind == "filter":
+        sig["mask"] = node.mask_name
+    elif node.kind == "join":
+        spec = node.spec
+        sig["keys"] = list(spec.keys)
+        sig["how"] = spec.how
+        sig["lname"] = [list(p) for p in spec.lname]
+        sig["rname"] = [list(p) for p in spec.rname]
+        sig["right"] = _frame_sig(node.right)
+    elif node.kind == "aggregate":
+        sig["keys"] = list(node.keys)
+        sig["ops"] = [[x, op] for x, op, _ in (node.spec or ())]
+    elif node.kind == "reduce":
+        sig["mode"] = str(node.spec)
+        sig["out"] = list(node.out_names)
+    return sig
+
+
+def chain_fingerprint(source, nodes) -> str:
+    """sha256 content key of one resolved plan chain (source schema +
+    per-node signatures). Stable across processes for the same rebuilt
+    pipeline — the property the sidecar's survives-restarts contract
+    needs."""
+    payload = {
+        "v": FORMAT_VERSION,
+        "source": _frame_sig(source),
+        "nodes": [_node_sig(n) for n in nodes],
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# the stats table: in-memory cache over the on-disk sidecar
+# ---------------------------------------------------------------------------
+
+def _sidecar_path(fp: str) -> Optional[str]:
+    d = sidecar_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"{fp}.json")
+
+
+def _valid(rec: object, fp: str) -> bool:
+    """A sidecar record is usable only when it is structurally what
+    this version writes AND names the fingerprint it sits under —
+    anything else (corrupt JSON handled by the caller, a format bump,
+    a file copied under the wrong name) is stale and quarantines."""
+    return (
+        isinstance(rec, dict)
+        and rec.get("v") == FORMAT_VERSION
+        and rec.get("fp") == fp
+        and isinstance(rec.get("execs"), int)
+    )
+
+
+def _quarantine(path: str, why: str) -> None:
+    _SIDECAR_EVENTS["quarantine"].inc()
+    logger.warning(
+        "plan-stats sidecar %s is %s; quarantining (static decisions "
+        "continue — stats are hints, never correctness)", path, why,
+    )
+    try:
+        os.unlink(path)
+    except OSError:  # pragma: no cover - already gone / perms
+        pass
+
+
+def lookup(fp: str) -> Optional[dict]:
+    """The stats record for one plan fingerprint, or None (no history,
+    re-optimization disabled, or a quarantined sidecar). Never raises."""
+    if not reopt_enabled():
+        return None
+    with _LOCK:
+        hit = _MEM.get(fp)
+        if hit is not None:
+            _MEM.move_to_end(fp)
+            # deep copy: the record nests dicts that record_execution
+            # merges into — a shallow copy would let a concurrent merge
+            # mutate what this caller is reading outside the lock
+            return copy.deepcopy(hit)
+    path = _sidecar_path(fp)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r") as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        _quarantine(path, f"unreadable ({type(e).__name__})")
+        return None
+    if not _valid(rec, fp):
+        _quarantine(path, "stale (format/fingerprint mismatch)")
+        return None
+    _SIDECAR_EVENTS["load"].inc()
+    with _LOCK:
+        _MEM[fp] = rec
+        while len(_MEM) > _MEM_MAX:
+            _MEM.popitem(last=False)
+    return copy.deepcopy(rec)
+
+
+def _merge(rec: dict, *, agg: Optional[dict], joins: Optional[dict],
+           push: Optional[dict], wall_s: Optional[float]) -> dict:
+    rec["execs"] = int(rec.get("execs", 0)) + 1
+    if agg:
+        a = rec.setdefault("agg", {})
+        a.update({k: v for k, v in agg.items() if k != "num_groups"})
+        if "num_groups" in agg:
+            a["num_groups"] = int(agg["num_groups"])
+            counts = [int(c) for c in a.get("counts", [])]
+            if int(agg["num_groups"]) not in counts:
+                counts.append(int(agg["num_groups"]))
+            a["counts"] = counts[-_OBS_MAX:]
+    if joins:
+        j = rec.setdefault("joins", {})
+        for key, obs in joins.items():
+            j.setdefault(key, {}).update(obs)
+    if push:
+        rec.setdefault("push", {}).update(push)
+    if wall_s is not None:
+        rec["wall_s"] = round(float(wall_s), 6)
+    return rec
+
+
+def record_execution(fp: str, *, agg: Optional[dict] = None,
+                     joins: Optional[dict] = None,
+                     push: Optional[dict] = None,
+                     wall_s: Optional[float] = None) -> None:
+    """Merge one execution's observations into the record and persist
+    the sidecar (best-effort: a write failure logs and moves on)."""
+    if not reopt_enabled():
+        return
+    with _LOCK:
+        rec = _MEM.get(fp)
+        if rec is None:
+            rec = {"v": FORMAT_VERSION, "fp": fp, "execs": 0}
+        # deep copy before merging: _merge mutates nested dicts, and
+        # records handed out by lookup() must stay frozen snapshots
+        rec = _merge(copy.deepcopy(rec), agg=agg, joins=joins,
+                     push=push, wall_s=wall_s)
+        _MEM[fp] = rec
+        _MEM.move_to_end(fp)
+        while len(_MEM) > _MEM_MAX:
+            _MEM.popitem(last=False)
+    path = _sidecar_path(fp)
+    if path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, sort_keys=True)
+        os.replace(tmp, path)
+        _SIDECAR_EVENTS["store"].inc()
+    except OSError as e:  # pragma: no cover - disk-full etc.
+        logger.debug("plan-stats sidecar write failed: %s", e)
+
+
+def clear_memory() -> None:
+    """Drop the in-memory table (tests; the sidecar is untouched)."""
+    with _LOCK:
+        _MEM.clear()

@@ -1,0 +1,597 @@
+"""Adaptive query optimizer (ISSUE 14): aggregate pushdown below
+joins, multi-join reordering, and stats-sidecar re-optimization must be
+BIT-IDENTICAL to the ``TFTPU_FUSION=0`` per-stage replay across join
+orders × hows × key dtypes × fetch shapes; ineligible shapes must keep
+the static path (counted, TFG110-diagnosed); and the feedback loop
+must record ``reoptimized`` decisions on a recurring pipeline's second
+execution without changing a single bit.
+
+Like tests/test_relational_pipeline.py, the equivalence sweeps honor
+the AMBIENT ``TFTPU_REOPT`` configuration — under the CI REOPT=0 smoke
+leg the same assertions pin the static path. Tests that assert the
+adaptive machinery ENGAGED skip when re-optimization is off."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.observability.metrics import REGISTRY
+from tensorframes_tpu.plan import stats as plan_stats
+
+
+@pytest.fixture(autouse=True)
+def _fusion_on():
+    """Pin fusion on (the sweeps compare against the escape hatch);
+    leave plan_reopt at its AMBIENT value so the CI REOPT=0 leg
+    exercises the static decisions through the same assertions."""
+    cfg = tfs.configure()
+    before = (cfg.plan_fusion, cfg.plan_reopt)
+    tfs.configure(plan_fusion=True)
+    yield
+    tfs.configure(plan_fusion=before[0], plan_reopt=before[1])
+
+
+_reopt_only = pytest.mark.skipif(
+    not tfs.configure().plan_reopt,
+    reason="adaptive optimizer disabled (TFTPU_REOPT=0)",
+)
+
+
+def _unfused(build):
+    tfs.configure(plan_fusion=False)
+    try:
+        return build()
+    finally:
+        tfs.configure(plan_fusion=True)
+
+
+def _count(kind):
+    for d in REGISTRY.snapshot():
+        if (
+            d["name"] == "tftpu_plan_cost_decisions_total"
+            and d["labels"].get("decision") == kind
+        ):
+            return float(d.get("value", 0.0))
+    return 0.0
+
+
+def _sidecar_count(event):
+    for d in REGISTRY.snapshot():
+        if (
+            d["name"] == "tftpu_plan_reopt_sidecar_total"
+            and d["labels"].get("event") == event
+        ):
+            return float(d.get("value", 0.0))
+    return 0.0
+
+
+def _rows_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra.keys() == rb.keys()
+        for k in ra:
+            va, vb = np.asarray(ra[k]), np.asarray(rb[k])
+            assert va.dtype == vb.dtype, (k, va.dtype, vb.dtype)
+            np.testing.assert_array_equal(va, vb)
+
+
+def _fact(n=240, key_kind="int", num_blocks=3, seed=3):
+    rng = np.random.default_rng(seed)
+    k1 = rng.integers(0, 8, n)
+    k2 = rng.integers(0, 6, n)
+    cols = {
+        "x": (np.arange(n) % 7).astype(np.int64),
+        "dead": np.ones(n, np.float32),
+    }
+    if key_kind == "str":
+        rows = [
+            {"k1": f"g{int(a)}", "k2": int(b), "x": int(c),
+             "dead": 1.0}
+            for a, b, c in zip(k1, k2, cols["x"])
+        ]
+        return tfs.frame_from_rows(rows, num_blocks=num_blocks)
+    cols["k1"] = k1.astype(np.int32)
+    cols["k2"] = k2.astype(np.int32)
+    return tfs.frame_from_arrays(cols, num_blocks=num_blocks)
+
+
+def _dim(key, values, extra_name, n_extra_dtype=np.int64,
+         key_kind="int"):
+    if key_kind == "str":
+        rows = [
+            {key: f"g{int(v)}", extra_name: int(v) * 10}
+            for v in values
+        ]
+        return tfs.frame_from_rows(rows, num_blocks=1)
+    return tfs.frame_from_arrays({
+        key: np.asarray(values, dtype=np.int32),
+        extra_name: (np.asarray(values) * 10).astype(n_extra_dtype),
+    }, num_blocks=1)
+
+
+def _agg_over_join(fact, dims, group_keys, op="reduce_sum",
+                   hows=None, fills=None):
+    """map → join(s) → aggregate(sum/min/max/mean of the mapped probe
+    column) — the canonical pushdown shape."""
+    f1 = tfs.map_blocks(lambda x: {"z": x * x}, fact)
+    j = f1
+    for i, dim in enumerate(dims):
+        how = (hows or ["inner"] * len(dims))[i]
+        fill = (fills or [None] * len(dims))[i]
+        on = list(dim.schema.names)[0]
+        j = j.join(dim, on=on, how=how, fill_value=fill)
+    with tfs.with_graph():
+        z_in = tfs.block(j, "z", tf_name="z_input")
+        red = {
+            "reduce_sum": tfs.reduce_sum,
+            "reduce_min": tfs.reduce_min,
+            "reduce_max": tfs.reduce_max,
+            "reduce_mean": tfs.reduce_mean,
+        }[op]
+        agg = tfs.aggregate(
+            red(z_in, axis=0, name="z"), j.group_by(*group_keys)
+        )
+    return agg
+
+
+# ---------------------------------------------------------------------------
+# equivalence property sweep: join orders × hows × key dtypes × fetches
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("key_kind", ["int", "str"])
+@pytest.mark.parametrize("how,fill", [
+    ("inner", None), ("left", -1), ("right", -1), ("outer", -1),
+])
+@pytest.mark.parametrize(
+    "op", ["reduce_sum", "reduce_min", "reduce_max", "reduce_mean"]
+)
+def test_agg_over_join_equivalence(key_kind, how, fill, op):
+    fact = _fact(key_kind=key_kind)
+    dim = _dim("k1", range(0, 8, 2), "w1", key_kind=key_kind)
+
+    def build():
+        return _agg_over_join(
+            fact, [dim], ["k1"], op=op, hows=[how], fills=[fill]
+        ).collect()
+
+    _rows_equal(build(), _unfused(build))
+
+
+@pytest.mark.parametrize("dim_order", [(0, 1), (1, 0)])
+@pytest.mark.parametrize("op", ["reduce_sum", "reduce_min"])
+def test_two_join_agg_equivalence_across_orders(dim_order, op):
+    fact = _fact()
+    dims = [
+        _dim("k1", range(0, 8, 2), "w1"),
+        _dim("k2", range(6), "w2"),
+    ]
+    ordered = [dims[i] for i in dim_order]
+
+    def build():
+        return _agg_over_join(
+            fact, ordered, ["k1", "k2"], op=op
+        ).collect()
+
+    _rows_equal(build(), _unfused(build))
+
+
+@pytest.mark.parametrize("value_dtype", [np.float32, np.float64])
+def test_float_fetch_keeps_static_path_and_matches(value_dtype):
+    """Order-sensitive float sums never push below joins — and the
+    result still matches the escape hatch exactly."""
+    n = 120
+    fact = tfs.frame_from_arrays({
+        "k1": (np.arange(n) % 5).astype(np.int32),
+        "x": (np.arange(n) % 9).astype(value_dtype),
+    }, num_blocks=2)
+    dim = _dim("k1", range(5), "w1")
+
+    def build():
+        return _agg_over_join(fact, [dim], ["k1"]).collect()
+
+    before = _count("pushdown_aggregate")
+    got = build()
+    assert _count("pushdown_aggregate") == before
+    _rows_equal(got, _unfused(build))
+
+
+def test_nonalgebraic_fetch_stays_generic_and_matches():
+    fact = _fact(n=60)
+    dim = _dim("k1", range(8), "w1")
+
+    def build():
+        f1 = tfs.map_blocks(lambda x: {"z": x * x}, fact)
+        j = f1.join(dim, on="k1")
+
+        with tfs.with_graph():
+            z_in = tfs.block(j, "z", tf_name="z_input")
+            # non-algebraic: sum of squares has no segment lowering
+            fetch = tfs.reduce_sum(z_in * z_in, axis=0, name="z")
+            agg = tfs.aggregate(fetch, j.group_by("k1"))
+        return agg.collect()
+
+    _rows_equal(build(), _unfused(build))
+
+
+# ---------------------------------------------------------------------------
+# the adaptive paths engage (and are counted)
+# ---------------------------------------------------------------------------
+
+@_reopt_only
+def test_pushdown_engages_and_is_counted():
+    fact = _fact()
+    dim = _dim("k1", range(0, 8, 2), "w1")  # selective inner join
+    before = _count("pushdown_aggregate")
+    agg = _agg_over_join(fact, [dim], ["k1"])
+    got = agg.collect()
+    assert _count("pushdown_aggregate") == before + 1
+    # inner join on half the key space drops the odd groups
+    assert {r["k1"] for r in got} == {0, 2, 4, 6}
+
+
+@_reopt_only
+def test_multilevel_pushdown_below_two_joins():
+    fact = _fact()
+    dims = [_dim("k1", range(8), "w1"), _dim("k2", range(0, 6, 2), "w2")]
+    before = _count("pushdown_aggregate")
+
+    def build():
+        return _agg_over_join(fact, dims, ["k1", "k2"]).collect()
+
+    got = build()
+    assert _count("pushdown_aggregate") == before + 1
+    _rows_equal(got, _unfused(build))
+
+
+@_reopt_only
+def test_build_side_pushdown_with_unique_probe_keys():
+    """Group keys + values from the build side push when the probe's
+    keys are unique (each build row joins at most once)."""
+    probe = tfs.frame_from_arrays({
+        "k1": np.arange(0, 8, 2, dtype=np.int32),
+        "junk": np.ones(4, np.float32),
+    }, num_blocks=1)
+    rng = np.random.default_rng(5)
+    big = tfs.frame_from_arrays({
+        "k1": rng.integers(0, 8, 100).astype(np.int32),
+        "w1": np.arange(100, dtype=np.int64),
+    }, num_blocks=1)
+
+    def build():
+        j = probe.join(big, on="k1")
+        with tfs.with_graph():
+            w_in = tfs.block(j, "w1", tf_name="w1_input")
+            agg = tfs.aggregate(
+                tfs.reduce_max(w_in, axis=0, name="w1"),
+                j.group_by("k1"),
+            )
+        return agg.collect()
+
+    before = _count("pushdown_aggregate")
+    got = build()
+    assert _count("pushdown_aggregate") == before + 1
+    _rows_equal(got, _unfused(build))
+
+
+@_reopt_only
+def test_duplicate_build_keys_fall_back_counted_and_match():
+    fact = _fact()
+    dup = tfs.frame_from_arrays({
+        "k1": np.asarray([0, 0, 2, 4], np.int32),
+        "w1": np.asarray([1, 2, 3, 4], np.int64),
+    }, num_blocks=1)
+
+    def build():
+        return _agg_over_join(fact, [dup], ["k1"]).collect()
+
+    before_push = _count("pushdown_aggregate")
+    before_inel = _count("pushdown_ineligible")
+    got = build()
+    assert _count("pushdown_aggregate") == before_push
+    assert _count("pushdown_ineligible") == before_inel + 1
+    _rows_equal(got, _unfused(build))
+
+
+@_reopt_only
+def test_join_chain_reorders_by_build_size_and_matches():
+    rng = np.random.default_rng(7)
+    n = 600
+    fact = tfs.frame_from_arrays({
+        "k1": rng.integers(0, 32, n).astype(np.int32),
+        "k2": rng.integers(0, 8, n).astype(np.int32),
+        "x": (np.arange(n) % 5).astype(np.int64),
+    }, num_blocks=2)
+    big_dim = _dim("k1", range(32), "w1")     # bigger build, keeps all
+    small_dim = _dim("k2", range(0, 8, 2), "w2")  # smaller, selective
+
+    def build():
+        f1 = tfs.map_blocks(lambda x: {"z": x + 1}, fact)
+        out = f1.join(big_dim, on="k1").join(small_dim, on="k2")
+        return out.select(["k1", "k2", "z", "w1", "w2"]).collect()
+
+    before = _count("reorder_joins")
+    got = build()
+    # smaller build side (small_dim) should run first: a reorder
+    assert _count("reorder_joins") == before + 1
+    _rows_equal(got, _unfused(build))
+
+
+@_reopt_only
+def test_left_join_chain_keeps_order_and_matches():
+    """Reordering is inner-only: a left join in the chain keeps the
+    recorded order (counted static) and stays bit-identical."""
+    fact = _fact(n=100)
+    d1 = _dim("k1", range(0, 8, 2), "w1")
+    d2 = _dim("k2", range(6), "w2")
+
+    def build():
+        f1 = tfs.map_blocks(lambda x: {"z": x + 1}, fact)
+        out = f1.join(d1, on="k1", how="left", fill_value=-1).join(
+            d2, on="k2"
+        )
+        return out.select(["k1", "k2", "z", "w1", "w2"]).collect()
+
+    before = _count("join_order_static")
+    got = build()
+    assert _count("join_order_static") >= before + 1
+    _rows_equal(got, _unfused(build))
+
+
+# ---------------------------------------------------------------------------
+# the feedback loop: second execution re-optimizes, bit-identically
+# ---------------------------------------------------------------------------
+
+@_reopt_only
+def test_second_execution_records_reoptimized_and_is_bit_identical():
+    plan_stats.clear_memory()
+    fact = _fact(seed=11)
+    dims = [_dim("k1", range(0, 8, 2), "w1"), _dim("k2", range(6), "w2")]
+
+    def build():
+        return _agg_over_join(fact, dims, ["k1", "k2"]).collect()
+
+    r0 = _count("reoptimized")
+    first = build()
+    first_delta = _count("reoptimized") - r0
+    r1 = _count("reoptimized")
+    second = build()
+    assert _count("reoptimized") > r1, (
+        "second execution of a recurring pipeline must record "
+        "reoptimized decisions"
+    )
+    assert first_delta == 0 or first_delta <= _count("reoptimized") - r1
+    _rows_equal(first, second)
+    _rows_equal(second, _unfused(build))
+
+
+@_reopt_only
+def test_observed_selectivity_reoptimizes_join_order():
+    """First run orders by build size; the second consults the
+    sidecar's observed selectivities (counted reoptimized) and still
+    matches the escape hatch bit-for-bit."""
+    plan_stats.clear_memory()
+    rng = np.random.default_rng(13)
+    n = 400
+    fact = tfs.frame_from_arrays({
+        "k1": rng.integers(0, 4, n).astype(np.int32),
+        "k2": rng.integers(0, 16, n).astype(np.int32),
+        "x": (np.arange(n) % 5).astype(np.int64),
+    }, num_blocks=2)
+    # small build that keeps everything vs larger build that is
+    # selective: static (size) order is wrong, observed order fixes it
+    keep_all = _dim("k1", range(4), "w1")
+    selective = _dim("k2", range(0, 16, 4), "w2")
+
+    def build():
+        f1 = tfs.map_blocks(lambda x: {"z": x + 1}, fact)
+        out = f1.join(keep_all, on="k1").join(selective, on="k2")
+        return out.select(["k1", "k2", "z", "w1", "w2"]).collect()
+
+    first = build()
+    r0 = _count("reoptimized")
+    second = build()
+    assert _count("reoptimized") > r0
+    _rows_equal(first, second)
+    _rows_equal(second, _unfused(build))
+
+
+@_reopt_only
+def test_pushdown_reoptimized_away_when_joins_are_selective(tmp_path):
+    """Observed survival below the threshold flips the second run to
+    the aggregate-above path — a genuinely different lowering, still
+    bit-identical."""
+    plan_stats.clear_memory()
+    n = 400
+    fact = tfs.frame_from_arrays({
+        "k1": np.arange(n, dtype=np.int32),  # keys 0..n-1
+        "x": (np.arange(n) % 5).astype(np.int64),
+    }, num_blocks=2)
+    # build side matches 2 of 400 keys: survival ~0.005 < threshold
+    dim = _dim("k1", [0, 1], "w1")
+
+    def build():
+        return _agg_over_join(fact, [dim], ["k1"]).collect()
+
+    p0 = _count("pushdown_aggregate")
+    first = build()
+    assert _count("pushdown_aggregate") == p0 + 1
+    s0 = _count("pushdown_skipped_selective")
+    second = build()
+    assert _count("pushdown_skipped_selective") == s0 + 1
+    _rows_equal(first, second)
+    _rows_equal(second, _unfused(build))
+
+
+# ---------------------------------------------------------------------------
+# stats-sidecar hygiene: corrupt/stale records quarantine, never fail
+# ---------------------------------------------------------------------------
+
+@_reopt_only
+def test_sidecar_roundtrip_corruption_and_stale_quarantine(tmp_path):
+    import json
+
+    was = tfs.configure().compilation_cache_dir
+    tfs.configure(compilation_cache_dir=str(tmp_path))
+    try:
+        plan_stats.clear_memory()
+        fact = _fact(seed=17)
+        dim = _dim("k1", range(0, 8, 2), "w1")
+
+        def build():
+            return _agg_over_join(fact, [dim], ["k1"]).collect()
+
+        first = build()
+        files = glob.glob(str(tmp_path / "planstats" / "*.json"))
+        assert len(files) == 1, "one sidecar record per plan fingerprint"
+        rec = json.load(open(files[0]))
+        assert rec["v"] == plan_stats.FORMAT_VERSION
+        assert rec["execs"] >= 1 and "push" in rec
+
+        # corrupt record: quarantined (counted + unlinked), decisions
+        # fall back to static, results unchanged — never a failure
+        plan_stats.clear_memory()
+        with open(files[0], "w") as f:
+            f.write("{definitely not json")
+        q0 = _sidecar_count("quarantine")
+        second = build()
+        assert _sidecar_count("quarantine") == q0 + 1
+        _rows_equal(first, second)
+        # the run after quarantine re-recorded a fresh sidecar
+        assert os.path.exists(files[0])
+
+        # stale record (format bump): same contract
+        plan_stats.clear_memory()
+        rec2 = json.load(open(files[0]))
+        rec2["v"] = plan_stats.FORMAT_VERSION + 999
+        json.dump(rec2, open(files[0], "w"))
+        q1 = _sidecar_count("quarantine")
+        third = build()
+        assert _sidecar_count("quarantine") == q1 + 1
+        _rows_equal(first, third)
+    finally:
+        tfs.configure(compilation_cache_dir=was)
+        plan_stats.clear_memory()
+
+
+def test_reopt_off_disables_recording_and_rewrites(tmp_path):
+    was_reopt = tfs.configure().plan_reopt
+    was_dir = tfs.configure().compilation_cache_dir
+    tfs.configure(plan_reopt=False, compilation_cache_dir=str(tmp_path))
+    try:
+        plan_stats.clear_memory()
+        fact = _fact(seed=19)
+        dim = _dim("k1", range(0, 8, 2), "w1")
+
+        def build():
+            return _agg_over_join(fact, [dim], ["k1"]).collect()
+
+        p0 = _count("pushdown_aggregate")
+        r0 = _count("reorder_joins")
+        o0 = _count("reoptimized")
+        first = build()
+        second = build()
+        assert _count("pushdown_aggregate") == p0
+        assert _count("reorder_joins") == r0
+        assert _count("reoptimized") == o0
+        assert not glob.glob(str(tmp_path / "planstats" / "*.json"))
+        _rows_equal(first, second)
+        _rows_equal(second, _unfused(build))
+    finally:
+        tfs.configure(plan_reopt=was_reopt,
+                      compilation_cache_dir=was_dir)
+
+
+# ---------------------------------------------------------------------------
+# TFG110 — missed-aggregate-pushdown diagnostics
+# ---------------------------------------------------------------------------
+
+def test_tfg110_float_fetch_names_the_blocking_fetch():
+    n = 60
+    fact = tfs.frame_from_arrays({
+        "k1": (np.arange(n) % 4).astype(np.int32),
+        "x": (np.arange(n) % 7).astype(np.float32),
+    }, num_blocks=2)
+    dim = _dim("k1", range(4), "w1")
+    agg = _agg_over_join(fact, [dim], ["k1"])
+    rep = tfs.lint_plan(agg)
+    found = rep.by_code("TFG110")
+    assert found, "float fetch above a join must flag TFG110"
+    assert found[0].subject == "z"
+    assert "fix:" in found[0].explain()
+
+
+def test_tfg110_key_not_grouped_names_the_join_key():
+    fact = _fact(n=60)
+    dim = _dim("k2", range(6), "w2")
+    agg = _agg_over_join(fact, [dim], ["k1"])  # groups miss join key k2
+    rep = tfs.lint_plan(agg)
+    found = rep.by_code("TFG110")
+    assert found
+    assert found[0].subject == "k2"
+
+
+def test_tfg110_clean_for_eligible_and_joinless_shapes():
+    fact = _fact(n=60)
+    dim = _dim("k1", range(8), "w1")
+    agg = _agg_over_join(fact, [dim], ["k1"])  # eligible: no finding
+    assert not tfs.lint_plan(agg).by_code("TFG110")
+    f1 = tfs.map_blocks(lambda x: {"z": x * x}, fact)
+    with tfs.with_graph():
+        z_in = tfs.block(f1, "z", tf_name="z_input")
+        plain = tfs.aggregate(
+            tfs.reduce_sum(z_in, axis=0, name="z"), f1.group_by("k1")
+        )
+    assert not tfs.lint_plan(plain).by_code("TFG110")
+
+
+@_reopt_only
+def test_tfg110_runtime_duplicate_keys_recorded_after_force():
+    fact = _fact(n=60)
+    dup = tfs.frame_from_arrays({
+        "k1": np.asarray([0, 0, 2], np.int32),
+        "w1": np.asarray([1, 2, 3], np.int64),
+    }, num_blocks=1)
+    agg = _agg_over_join(fact, [dup], ["k1"])
+    agg.collect()
+    rep = tfs.lint_plan(agg)
+    found = rep.by_code("TFG110")
+    assert found
+    assert any(
+        "duplicate" in d.message for d in found
+    ), [d.message for d in found]
+
+
+def test_tfg110_counter_preregistered():
+    prom = REGISTRY.to_prometheus()
+    assert 'tftpu_analysis_diagnostics_total{code="TFG110"}' in prom
+
+
+def test_decision_counters_preregistered():
+    prom = REGISTRY.to_prometheus()
+    for kind in (
+        "pushdown_aggregate", "pushdown_ineligible",
+        "pushdown_skipped_selective", "reorder_joins",
+        "join_order_static", "reoptimized",
+    ):
+        assert (
+            f'tftpu_plan_cost_decisions_total{{decision="{kind}"}}'
+            in prom
+        ), kind
+    for event in ("load", "store", "quarantine"):
+        assert (
+            f'tftpu_plan_reopt_sidecar_total{{event="{event}"}}' in prom
+        ), event
+
+
+def test_estimated_rows_never_forces():
+    fact = _fact(n=60)
+    assert fact.estimated_rows == 60
+    f1 = tfs.map_blocks(lambda x: {"z": x * x}, fact)
+    assert f1.estimated_rows == 60
+    assert not f1.is_materialized
+    flt = f1.filter(lambda z: {"keep": z > 3})
+    assert flt.estimated_rows is None  # data-dependent row count
+    assert not flt.is_materialized
