@@ -192,6 +192,17 @@ run_step "Serving fleet smoke (kill -9 a replica under open-loop load)" bash -c 
   test -s '$WORK/obs/serving_fleet_trace.json'
 "
 
+# ci.yml's out-of-core smoke (ISSUE 15): a CSV dataset ~5x the enforced
+# block budget streams a fused map→filter→aggregate chain through the
+# blockstore partitioner — exits nonzero when peak RSS outgrows the
+# 3.5x-budget cap, when nothing spilled, or when the streamed results
+# diverge from the in-memory path; tftpu_blockstore_* metrics ride the
+# observability artifacts
+run_step "Out-of-core smoke (5x-budget CSV stream, bounded RSS)" bash -c "
+  env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.out_of_core_main()\" &&
+  test -s '$WORK/obs/out_of_core_metrics.jsonl'
+"
+
 # ci.yml's fleet chaos-drill step: kill-rank + hung-collective +
 # drop-heartbeat on a 2-process CPU fleet, with the flight black box
 # spooled next to the other observability artifacts

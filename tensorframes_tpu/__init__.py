@@ -122,6 +122,13 @@ from . import observability  # noqa: E402,F401
 from .observability import StepTelemetry  # noqa: E402,F401
 from . import compilecache  # noqa: E402,F401  (registers tftpu_compilecache_* metrics)
 from .compilecache import WarmupReport, warmup  # noqa: E402,F401
+from . import blockstore  # noqa: E402,F401  (registers tftpu_blockstore_* metrics)
+from .blockstore import (  # noqa: E402,F401
+    BlockStore,
+    SpilledFrame,
+    stream_chain,
+)
+from .io import scan_csv, scan_parquet  # noqa: E402,F401
 from . import serving  # noqa: E402,F401  (registers tftpu_serving_* metrics)
 from .serving import (  # noqa: E402,F401
     DecodeConfig,
@@ -185,6 +192,13 @@ __all__ = [
     "frame_to_arrow",
     "read_parquet",
     "write_parquet",
+    "scan_csv",
+    "scan_parquet",
+    # out-of-core data plane
+    "blockstore",
+    "BlockStore",
+    "SpilledFrame",
+    "stream_chain",
     # dsl / placeholder helpers
     "Node",
     "block",

@@ -214,7 +214,8 @@ def analyze_frame(
 
 def lint_plan(frame) -> DiagnosticReport:
     """Lint a frame's *logical plan* (TFG107 fusion-barrier, TFG109
-    unfused-aggregate, TFG110 missed-aggregate-pushdown): warn when a
+    unfused-aggregate, TFG110 missed-aggregate-pushdown, TFG111
+    larger-than-budget materialization): warn when a
     chain's otherwise-fusable map stages are split by a barrier — a
     host-callback stage, a ``to_host``/``to_numpy`` materialization or
     repartition between maps, a trim map, or ragged source cells —
@@ -223,11 +224,14 @@ def lint_plan(frame) -> DiagnosticReport:
     chained stage, ragged value cells), and when an aggregate sits
     above a join it could push below but for a fixable cause (an
     order-sensitive float fetch, group keys not covering the join key,
-    mixed-side fetches, an outer join, duplicate build keys). Each
+    mixed-side fetches, an outer join, duplicate build keys), and when
+    a forced ``to_host``/``to_numpy`` materialized an estimated byte
+    volume past the block-store budget (the fix names the streaming
+    out-of-core alternative, docs/dataplane.md). Each
     finding's ``explain()`` names the cause. Purely static over the
     recorded plan chain — never forces a lazy frame."""
     from ..plan.ir import chain_barriers, unfused_epilogues
-    from ..plan.lower import pushdown_misses
+    from ..plan.lower import oversized_materializations, pushdown_misses
 
     n_maps, barriers = chain_barriers(frame)
     ctx = RuleContext(
@@ -235,8 +239,9 @@ def lint_plan(frame) -> DiagnosticReport:
         plan_barriers=barriers,
         unfused_epilogues=unfused_epilogues(frame),
         pushdown_misses=pushdown_misses(frame),
+        oversized_materializations=oversized_materializations(frame),
     )
-    diags = run_rules(ctx, codes=["TFG107", "TFG109", "TFG110"])
+    diags = run_rules(ctx, codes=["TFG107", "TFG109", "TFG110", "TFG111"])
     return DiagnosticReport(
         diags, subject=f"plan({n_maps} map stage(s))"
     )

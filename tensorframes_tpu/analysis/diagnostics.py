@@ -52,6 +52,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "TFG108": ("cache-fingerprint-unstable", "warn"),
     "TFG109": ("unfused-aggregate", "warn"),
     "TFG110": ("missed-aggregate-pushdown", "warn"),
+    "TFG111": ("larger-than-budget-materialization", "warn"),
 }
 
 # Pre-register the full counter family at import: one series per code,

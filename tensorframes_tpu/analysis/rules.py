@@ -36,6 +36,13 @@ Every rule is grounded in a hazard this codebase has already paid for:
   cache's content hash differs across two identical rebuilds of the
   program (non-deterministically serialized captures): every process
   start misses the store and recompiles — a miss storm.
+* **TFG111 larger-than-budget materialization** — a forced
+  ``to_host``/``to_numpy`` whose estimated bytes
+  (``estimated_rows`` × the schema row width) exceed the block-store
+  budget (``TFTPU_BLOCK_BUDGET_MB``): the whole table lands in host
+  RAM at once where the out-of-core data plane
+  (:mod:`tensorframes_tpu.blockstore`) would stream it with bounded
+  peak RSS. ``lint_plan`` only, like TFG107/109/110.
 
 Rules never execute or compile anything: they read specs, the traced
 jaxpr, and config. Tracing itself (``jax.make_jaxpr``) happens once in
@@ -89,6 +96,11 @@ class RuleContext:
     #: (plan.rules.plan_pushdown) plus runtime causes recorded by
     #: plan.ir.mark_pushdown_miss; read by TFG110.
     pushdown_misses: Optional[Sequence[dict]] = None
+    #: Forced to_host/to_numpy materializations whose estimated bytes
+    #: exceed the block-store budget (lint_plan only): dicts with
+    #: ``reason``, ``estimated_bytes``, ``budget_bytes``, ``rows`` —
+    #: plan.lower.oversized_materializations; read by TFG111.
+    oversized_materializations: Optional[Sequence[dict]] = None
     #: Ambient mesh for sharded programs (``analyze_frame`` passes the
     #: frame's mesh): TFG108's stability probes re-trace under it, so
     #: programs using collectives/sharding constraints lint instead of
@@ -671,6 +683,41 @@ def _rule_missed_pushdown(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG111 — larger-than-budget materialization (plan-chain rule: lint_plan)
+# ---------------------------------------------------------------------------
+
+def _rule_oversized_materialization(ctx: RuleContext) -> List[Diagnostic]:
+    """A forced ``to_host``/``to_numpy`` materialized an estimated byte
+    volume past the block-store budget (``TFTPU_BLOCK_BUDGET_MB``):
+    the whole table landed in host RAM at once, which is exactly the
+    workload the out-of-core data plane streams with bounded peak RSS
+    instead (docs/dataplane.md)."""
+    if not ctx.oversized_materializations:
+        return []
+    out: List[Diagnostic] = []
+    for m in ctx.oversized_materializations:
+        est_mb = m["estimated_bytes"] / (1 << 20)
+        bud_mb = m["budget_bytes"] / (1 << 20)
+        out.append(Diagnostic(
+            "TFG111", "warn",
+            f"forced materialization ({m['reason']}) holds an estimated "
+            f"{est_mb:.0f} MiB ({m['rows']:,} rows) in host RAM at once "
+            f"— past the {bud_mb:.0f} MiB block-store budget "
+            "(TFTPU_BLOCK_BUDGET_MB)",
+            subject="to_host",
+            fix="stream instead of materializing: walk the chain with "
+                "blockstore.stream_chain(io.scan_csv/scan_parquet(...), "
+                "chain_fn, fold_fn=...) — results spill to the block "
+                "store as they complete and peak RSS stays under the "
+                "budget — or spill the frame explicitly with "
+                "frame.spill_to(BlockStore()); raise "
+                "TFTPU_BLOCK_BUDGET_MB only if the host genuinely has "
+                "the RAM",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # TFG108 — cache-fingerprint-unstable (persistent-cache miss storm)
 # ---------------------------------------------------------------------------
 
@@ -811,6 +858,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG108": _rule_fingerprint_unstable,
     "TFG109": _rule_unfused_aggregate,
     "TFG110": _rule_missed_pushdown,
+    "TFG111": _rule_oversized_materialization,
 }
 
 

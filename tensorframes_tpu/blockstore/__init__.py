@@ -1,0 +1,32 @@
+"""Out-of-core data plane (ROADMAP #3): spillable columnar block
+store, streaming partitioner, and the file-based distributed shuffle.
+
+Three layers, bottom up:
+
+* :mod:`.store` — :class:`BlockStore`: frame blocks under a resident-
+  bytes budget (``TFTPU_BLOCK_BUDGET_MB``), LRU spill to CRC-checked
+  disk segments with atomic publish and quarantine-on-corruption (the
+  compile-store durability contract applied to data).
+* :mod:`.partitioner` — :func:`stream_chain` / :class:`SpilledFrame`:
+  a lazy verb chain over a frame larger than RAM, walked block by
+  block through a double-buffered pipeline, results spilling as they
+  complete; peak RSS stays bounded by the budget, never the frame.
+* :mod:`.shuffle` — hash-partitioned exchange of partial tables
+  through per-rank spill files in the shared rendezvous dir, replacing
+  the multi-process aggregate's host-gather merge; deadline-bounded
+  waits name dead ranks (the PR 8 watchdog contract), CRC + retries
+  ride the resilience registry.
+
+Importing this package pre-registers every ``tftpu_blockstore_*``
+metric, so expositions carry the data-plane telemetry from process
+start.
+"""
+
+from .partitioner import SpilledFrame, stream_chain
+from .store import BlockCorruptionError, BlockRef, BlockStore
+from . import shuffle
+
+__all__ = [
+    "BlockStore", "BlockRef", "BlockCorruptionError",
+    "SpilledFrame", "stream_chain", "shuffle",
+]

@@ -1,0 +1,482 @@
+"""Spillable columnar block store: the out-of-core data plane's bottom
+layer (ROADMAP #3).
+
+A :class:`BlockStore` holds frame blocks (``{column: ndarray | list}``,
+the same ``Block`` shape ``TensorFrame`` partitions into) under a
+configurable resident-bytes budget (``TFTPU_BLOCK_BUDGET_MB`` /
+``configure(block_budget_bytes=)``). Blocks past the budget spill to
+disk least-recently-used; spilled segments reload on demand — CRC-checked
+by default, or as zero-read ``np.memmap`` views for whole-frame rebuilds
+where the OS page cache owns residency.
+
+Durability follows the compile-store contract (compilecache/store.py):
+
+* segments publish via write-temp → fsync → atomic rename, so a crash
+  mid-spill can never leave a half-written block under the live name;
+* every dense column and the host pickle carry a CRC32 in the
+  manifest; a corrupt/truncated reload is **counted**, the segment is
+  **quarantined** (renamed aside, never silently re-read), and
+  :meth:`BlockStore.get_or_recompute` falls back to recomputing the
+  block from its lineage instead of serving bad bytes.
+
+Consumers: ``TensorFrame.spill_to`` (frame.py), the chunked
+``read_csv``/``read_parquet`` ingest (io.py), the streaming partitioner
+(partitioner.py), the distributed shuffle's per-rank spill files
+(shuffle.py), and ``serving.kvpool.PagedKVPool.spill`` (the KV pool's
+host-swap tier). Fault site ``blockstore.spill`` (+ delay semantics)
+rides the resilience registry; ``blockstore.*`` flight records land in
+the crash black box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..config import get_config
+from ..observability import flight as _flight
+from ..observability.metrics import counter as _counter
+from ..observability.metrics import gauge as _gauge
+from ..observability.metrics import histogram as _histogram
+from ..resilience.faults import delay_point, fault_point
+from ..utils import get_logger
+from ..utils.npz import decode_array, encode_array
+
+logger = get_logger(__name__)
+
+# Data-plane telemetry, pre-registered at import (the blockstore module
+# is imported by the package root, so every exposition carries these
+# even before the first spill).
+RESIDENT_BYTES = _gauge(
+    "tftpu_blockstore_resident_bytes",
+    "Bytes of block data currently held in host RAM across live block "
+    "stores (delta-tracked, like the decode free-pages gauge: several "
+    "stores share the one process-wide series)",
+)
+SPILLED_BYTES = _gauge(
+    "tftpu_blockstore_spilled_bytes",
+    "Bytes of block data currently spilled to disk segments across "
+    "live block stores (delta-tracked)",
+)
+SPILL_SECONDS = _histogram(
+    "tftpu_blockstore_spill_seconds",
+    "Wall-clock to publish one block's spill segment (encode + fsync + rename)",
+)
+RELOAD_SECONDS = _histogram(
+    "tftpu_blockstore_reload_seconds",
+    "Wall-clock to reload + CRC-check one spilled block",
+)
+QUARANTINES = _counter(
+    "tftpu_blockstore_quarantines_total",
+    "Spilled segments failing CRC/manifest checks on reload, renamed aside",
+)
+HOSTGATHER_BYTES = _counter(
+    "tftpu_blockstore_hostgather_bytes_total",
+    "Bytes of partial tables host-gathered by multi-process aggregates "
+    "(the pre-shuffle path; zero when the file shuffle carries the merge)",
+)
+
+_MANIFEST = "manifest.json"
+_HOST_PKL = "host.pkl"
+_FORMAT_VERSION = 1
+
+
+class BlockCorruptionError(RuntimeError):
+    """A spilled segment failed its CRC/manifest check on reload. The
+    segment has already been quarantined and counted; callers holding
+    lineage should recompute (:meth:`BlockStore.get_or_recompute`)."""
+
+
+@dataclass(frozen=True)
+class BlockRef:
+    """Handle to one block in a :class:`BlockStore` (stable across
+    spill/reload; hashable so callers can keep ref → lineage maps)."""
+
+    block_id: int
+    nbytes: int
+    num_rows: int
+
+
+class _Entry:
+    __slots__ = ("ref", "block", "spilled", "pinned", "disk_bytes")
+
+    def __init__(self, ref: BlockRef, block: Dict[str, object]):
+        self.ref = ref
+        self.block = block          # None once spilled-and-dropped
+        self.spilled = False        # a clean on-disk segment exists
+        self.pinned = False
+        self.disk_bytes = 0         # payload bytes of the live segment
+
+
+def _block_nbytes(block: Dict[str, object]) -> int:
+    total = 0
+    for v in block.values():
+        if isinstance(v, np.ndarray) and v.dtype != object:
+            total += int(v.nbytes)
+        else:
+            # host cells (strings / ragged) — estimate via pickle on
+            # spill; pre-spill use a cheap proxy so budget accounting
+            # stays O(1)
+            total += 64 * max(1, len(v))
+    return total
+
+
+def _block_rows(block: Dict[str, object]) -> int:
+    for v in block.values():
+        return len(v)
+    return 0
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class BlockStore:
+    """Spillable block container with an LRU resident-bytes budget.
+
+    ``root`` is the spill directory (created; a private temp dir by
+    default). ``budget_bytes`` bounds the bytes held in RAM across all
+    resident blocks (``TFTPU_BLOCK_BUDGET_MB`` default); ``put`` spills
+    the least-recently-used residents past it. Thread-safe: the
+    streaming partitioner's loader thread puts while the consumer gets.
+    """
+
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        budget_bytes: Optional[int] = None,
+    ):
+        cfg = get_config()
+        if root is None:
+            # a private spill dir per store (segment ids are store-local,
+            # so two stores must never share one directory); the
+            # configured parent (TFTPU_BLOCKSTORE_DIR — fast local SSD
+            # in production) just hosts it
+            parent = cfg.blockstore_dir or None
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            root = tempfile.mkdtemp(prefix="tftpu-blockstore-", dir=parent)
+            self._owns_root = True
+        else:
+            os.makedirs(root, exist_ok=True)
+            self._owns_root = False
+        self.root = root
+        self.budget_bytes = (
+            cfg.block_budget_bytes if budget_bytes is None else int(budget_bytes)
+        )
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._next_id = 0
+        self._resident = 0
+        self._spilled_bytes = 0
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def resident_bytes(self) -> int:
+        return self._resident
+
+    @property
+    def spilled_bytes(self) -> int:
+        return self._spilled_bytes
+
+    def _account(self, d_resident: int, d_spilled: int) -> None:
+        self._resident += d_resident
+        self._spilled_bytes += d_spilled
+        # delta-tracked: the gauges aggregate over every live store in
+        # the process (a set() here would clobber sibling stores);
+        # close()/drop() run the same deltas in reverse, so a store's
+        # contribution leaves with it
+        RESIDENT_BYTES.inc(float(d_resident))
+        SPILLED_BYTES.inc(float(d_spilled))
+
+    def _seg_dir(self, block_id: int) -> str:
+        return os.path.join(self.root, f"blk-{block_id:08d}")
+
+    # -- write side ---------------------------------------------------------
+    def put(self, block: Dict[str, object], pin: bool = False) -> BlockRef:
+        """Register one block; spill LRU residents past the budget.
+        ``pin=True`` exempts the block from LRU spilling (it can still
+        be spilled explicitly via :meth:`spill`)."""
+        nbytes = _block_nbytes(block)
+        with self._lock:
+            ref = BlockRef(self._next_id, nbytes, _block_rows(block))
+            self._next_id += 1
+            e = _Entry(ref, dict(block))
+            e.pinned = pin
+            self._entries[ref.block_id] = e
+            self._account(+nbytes, 0)
+            self._enforce_budget()
+        return ref
+
+    def _enforce_budget(self) -> None:
+        # called under the lock; oldest-touched first (OrderedDict
+        # order). budget <= 0 is the degenerate disk-only store: every
+        # unpinned block spills on arrival.
+        for bid in list(self._entries):
+            if self._resident <= self.budget_bytes:
+                return
+            e = self._entries[bid]
+            if e.block is None or e.pinned:
+                continue
+            self._spill_entry(e)
+
+    def spill(self, ref: BlockRef) -> None:
+        """Explicitly spill one block (no-op if already on disk only)."""
+        with self._lock:
+            e = self._require(ref)
+            if e.block is not None:
+                self._spill_entry(e)
+
+    def spill_all(self) -> None:
+        with self._lock:
+            for e in list(self._entries.values()):
+                if e.block is not None:
+                    self._spill_entry(e)
+
+    def _spill_entry(self, e: _Entry) -> None:
+        """Publish the block's segment (if not already clean on disk)
+        and drop the in-RAM copy. Atomic: temp dir → fsync → rename."""
+        t0 = time.perf_counter()
+        if not e.spilled:
+            delay_point("blockstore.spill")
+            fault_point("blockstore.spill")
+            seg = self._seg_dir(e.ref.block_id)
+            tmp = f"{seg}.tmp.{os.getpid()}"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            cols, host = [], {}
+            disk_bytes = 0
+            try:
+                for name, v in e.block.items():
+                    if isinstance(v, np.ndarray) and v.dtype != object:
+                        raw, meta = encode_array(v)
+                        fn = f"c{len(cols)}.bin"
+                        data = raw.tobytes()
+                        with open(os.path.join(tmp, fn), "wb") as f:
+                            f.write(data)
+                            f.flush()
+                            os.fsync(f.fileno())
+                        cols.append({
+                            "name": name, "kind": "dense", "file": fn,
+                            "dtype": meta["dtype"], "shape": meta["shape"],
+                            "crc32": zlib.crc32(data), "nbytes": len(data),
+                        })
+                        disk_bytes += len(data)
+                    else:
+                        host[name] = list(v)
+                if host:
+                    payload = pickle.dumps(
+                        host, protocol=pickle.HIGHEST_PROTOCOL
+                    )
+                    with open(os.path.join(tmp, _HOST_PKL), "wb") as f:
+                        f.write(payload)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    cols.append({
+                        "kind": "host", "file": _HOST_PKL,
+                        "names": sorted(host),
+                        "crc32": zlib.crc32(payload), "nbytes": len(payload),
+                    })
+                    disk_bytes += len(payload)
+                manifest = {
+                    "format_version": _FORMAT_VERSION,
+                    "block_id": e.ref.block_id,
+                    "num_rows": e.ref.num_rows,
+                    "columns": cols,
+                }
+                with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                    json.dump(manifest, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                shutil.rmtree(seg, ignore_errors=True)
+                os.rename(tmp, seg)
+                _fsync_dir(self.root)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            e.spilled = True
+            e.disk_bytes = disk_bytes
+            self._account(0, +disk_bytes)
+            _flight.record(
+                "blockstore.spill", block_id=e.ref.block_id,
+                nbytes=e.ref.nbytes, disk_bytes=disk_bytes,
+            )
+        e.block = None
+        self._account(-e.ref.nbytes, 0)
+        SPILL_SECONDS.observe(time.perf_counter() - t0)
+
+    # -- read side ----------------------------------------------------------
+    def _require(self, ref: BlockRef) -> _Entry:
+        e = self._entries.get(ref.block_id)
+        if e is None:
+            raise KeyError(f"block {ref.block_id} is not in this store")
+        self._entries.move_to_end(ref.block_id)  # LRU touch
+        return e
+
+    def get(self, ref: BlockRef, mmap: bool = False) -> Dict[str, object]:
+        """Return one block. Resident blocks come back as-is; spilled
+        blocks reload from their segment — **CRC-checked** by default
+        (the full segment is read once), or as ``np.memmap`` views with
+        ``mmap=True`` (zero read up front; the OS page cache owns
+        residency — for whole-frame rebuilds where eager CRC reads
+        would defeat out-of-core loading; manifest + segment sizes are
+        still validated). Reloading does NOT re-admit the block into
+        the resident budget: the caller owns the returned dict's
+        lifetime, and dropping it frees the memory (munmap for views).
+        """
+        with self._lock:
+            e = self._require(ref)
+            if e.block is not None:
+                return e.block
+        t0 = time.perf_counter()
+        block = self._load_segment(ref, verify=not mmap, mmap=mmap)
+        RELOAD_SECONDS.observe(time.perf_counter() - t0)
+        return block
+
+    def get_or_recompute(
+        self,
+        ref: BlockRef,
+        recompute: Callable[[], Dict[str, object]],
+        mmap: bool = False,
+    ) -> Dict[str, object]:
+        """:meth:`get`, healing corruption from lineage: a quarantined
+        reload recomputes the block, re-publishes the segment, and
+        returns the fresh copy (the checkpoint/compile-store recovery
+        contract applied to data blocks)."""
+        try:
+            return self.get(ref, mmap=mmap)
+        except BlockCorruptionError:
+            block = recompute()
+            with self._lock:
+                e = self._require(ref)
+                e.block = dict(block)
+                e.spilled = False
+                self._account(+ref.nbytes, 0)
+                self._spill_entry(e)
+            return self.get(ref, mmap=mmap)
+
+    def _load_segment(
+        self, ref: BlockRef, verify: bool, mmap: bool
+    ) -> Dict[str, object]:
+        seg = self._seg_dir(ref.block_id)
+        try:
+            with open(os.path.join(seg, _MANIFEST)) as f:
+                manifest = json.load(f)
+            if manifest.get("format_version", 0) > _FORMAT_VERSION:
+                raise ValueError(
+                    f"segment format {manifest.get('format_version')} > "
+                    f"{_FORMAT_VERSION}"
+                )
+            block: Dict[str, object] = {}
+            for col in manifest["columns"]:
+                path = os.path.join(seg, col["file"])
+                if col["kind"] == "host":
+                    with open(path, "rb") as f:
+                        payload = f.read()
+                    if zlib.crc32(payload) != col["crc32"]:
+                        raise ValueError(f"host pickle CRC mismatch ({path})")
+                    block.update(pickle.loads(payload))
+                    continue
+                if os.path.getsize(path) != col["nbytes"]:
+                    raise ValueError(f"segment size mismatch ({path})")
+                if verify:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                    if zlib.crc32(data) != col["crc32"]:
+                        raise ValueError(f"column CRC mismatch ({path})")
+                    raw = np.frombuffer(data, np.uint8)
+                else:
+                    raw = np.memmap(path, dtype=np.uint8, mode="r")
+                block[col["name"]] = decode_array(
+                    raw, {"dtype": col["dtype"], "shape": col["shape"]}
+                )
+            return block
+        except (OSError, ValueError, KeyError, json.JSONDecodeError,
+                pickle.UnpicklingError, EOFError) as err:
+            self._quarantine(ref, seg, err)
+            raise BlockCorruptionError(
+                f"block {ref.block_id} segment failed verification "
+                f"({type(err).__name__}: {err}); segment quarantined — "
+                "recompute from lineage (get_or_recompute)"
+            ) from err
+
+    def _quarantine(self, ref: BlockRef, seg: str, err: BaseException) -> None:
+        QUARANTINES.inc()
+        _flight.record(
+            "blockstore.quarantine", block_id=ref.block_id,
+            error=type(err).__name__, message=str(err)[:200],
+        )
+        with self._lock:
+            e = self._entries.get(ref.block_id)
+            if e is not None:
+                e.spilled = False
+                self._account(0, -e.disk_bytes)
+                e.disk_bytes = 0
+        aside = f"{seg}.quarantine.{os.getpid()}"
+        try:
+            shutil.rmtree(aside, ignore_errors=True)
+            os.rename(seg, aside)
+        except OSError:  # pragma: no cover - already gone/raced
+            pass
+        logger.warning(
+            "blockstore: quarantined segment for block %d (%s)",
+            ref.block_id, err,
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def drop(self, ref: BlockRef) -> None:
+        """Forget one block and delete its segment."""
+        with self._lock:
+            e = self._entries.pop(ref.block_id, None)
+            if e is None:
+                return
+            if e.block is not None:
+                self._account(-e.ref.nbytes, 0)
+            if e.spilled:
+                shutil.rmtree(self._seg_dir(ref.block_id), ignore_errors=True)
+                self._account(0, -e.disk_bytes)
+
+    def refs(self) -> List[BlockRef]:
+        with self._lock:
+            return [e.ref for e in self._entries.values()]
+
+    def close(self) -> None:
+        """Drop everything; delete the root if this store created it."""
+        with self._lock:
+            for ref in list(self.refs()):
+                self.drop(ref)
+            if self._owns_root:
+                shutil.rmtree(self.root, ignore_errors=True)
+
+    def __enter__(self) -> "BlockStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"BlockStore(root={self.root!r}, blocks={len(self._entries)}, "
+            f"resident={self._resident}, spilled={self._spilled_bytes}, "
+            f"budget={self.budget_bytes})"
+        )
+
+
+__all__ = ["BlockStore", "BlockRef", "BlockCorruptionError"]

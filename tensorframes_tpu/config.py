@@ -140,6 +140,20 @@ class Config:
     # way (the optimizer exists purely for speed; every rewrite is
     # gated on reassoc_safe-style exactness).
     plan_reopt: bool = _env_bool("TFTPU_REOPT", True)
+    # Out-of-core data plane (tensorframes_tpu/blockstore): resident-
+    # bytes budget of a BlockStore — blocks past it spill to disk
+    # least-recently-used, and the streaming partitioner's peak RSS is
+    # bounded by (pipeline depth x chunk bytes + this budget) instead
+    # of the frame size. Also the TFG111 threshold: a forced
+    # to_host/to_numpy materialization estimated past this budget is
+    # flagged by lint_plan with the streaming alternative named.
+    block_budget_bytes: int = _env_int("TFTPU_BLOCK_BUDGET_MB", 512) * (1 << 20)
+    # Default spill directory for block stores (empty = a private temp
+    # dir per store, deleted with it). Point at fast local SSD in
+    # production; the shuffle's per-rank spill files use the shared
+    # rendezvous dir (TFTPU_SHUFFLE_DIR / TFTPU_FLEET_DIR) instead —
+    # those must be visible to every rank, this need not be.
+    blockstore_dir: str = os.environ.get("TFTPU_BLOCKSTORE_DIR", "")
     # Hung-dispatch watchdog (resilience/fleet.py): a dispatch — or a
     # fleet rendezvous barrier — that exceeds this wall-clock deadline
     # aborts with HungDispatchError plus a flight-recorder postmortem

@@ -606,6 +606,42 @@ class TensorFrame:
         return None
 
     @property
+    def estimated_bytes(self) -> Optional[int]:
+        """Host-byte estimate of materializing this frame (never forces
+        a lazy chain): ``estimated_rows`` × the schema's per-row dense
+        width (Unknown cell dims count as 1 — a lower bound). None when
+        the row count is unknowable pre-force. TFG111 compares this
+        against the block-store budget to flag larger-than-budget
+        ``to_host``/``to_numpy`` materializations."""
+        from .plan.lower import estimate_materialized_bytes
+
+        return estimate_materialized_bytes(self)
+
+    def spill_to(self, store) -> "object":
+        """Spill this frame's blocks into a
+        :class:`~tensorframes_tpu.blockstore.BlockStore` and return the
+        :class:`~tensorframes_tpu.blockstore.SpilledFrame` handle
+        (blocks past the store's budget land on disk; ``to_frame``
+        rebuilds over memmap views). Forces a lazy chain block by
+        block's result — multi-host global arrays are refused exactly
+        like ``save_frame`` (no process can materialize them alone)."""
+        from .blockstore.partitioner import SpilledFrame
+
+        refs = []
+        for b in self.blocks():
+            host_b = {}
+            for name, v in b.items():
+                if _non_addressable(v):
+                    raise ValueError(
+                        f"spill_to: column {name!r} spans non-addressable "
+                        "devices (multi-host global array); use "
+                        "save_frame_sharded instead"
+                    )
+                host_b[name] = v if isinstance(v, list) else np.asarray(v)
+            refs.append(store.put(host_b))
+        return SpilledFrame(store, refs, self.schema)
+
+    @property
     def columns(self) -> List[str]:
         return self.schema.names
 

@@ -11,6 +11,7 @@ double buffering, the standard input-pipeline recipe.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -112,11 +113,6 @@ def prefetch_to_device(
     :class:`~tensorframes_tpu.resilience.RetryPolicy` to each
     host→device transfer, absorbing transient device-put faults.
     """
-    q: "queue.Queue" = queue.Queue(maxsize=size)
-    stop = threading.Event()
-    done = threading.Event()
-    err: List[Optional[BaseException]] = [None]
-
     def put(batch):
         def xfer():
             fault_point("io.prefetch.device_put")
@@ -126,6 +122,38 @@ def prefetch_to_device(
 
         return retry_call(xfer, policy=retry, describe="prefetch.device_put")
 
+    return pipeline_iter(
+        batches, stage=put, size=size, join_timeout=join_timeout,
+        observe=True, thread_name="tfs-prefetch",
+    )
+
+
+def pipeline_iter(
+    items: Iterable,
+    stage=None,
+    size: int = 2,
+    join_timeout: float = 5.0,
+    observe: bool = False,
+    thread_name: str = "tfs-pipeline",
+) -> Iterator:
+    """The generalized double-buffered pipeline under
+    :func:`prefetch_to_device`: a worker thread pulls ``items``, applies
+    ``stage`` (identity by default — pure read-ahead), and stages up to
+    ``size`` results for the consumer. The streaming partitioner
+    (``blockstore.stream_chain``) uses it to overlap the next chunk's
+    disk read/parse with the current chunk's compute; failure and
+    shutdown semantics are exactly prefetch_to_device's (parked worker
+    exceptions, liveness polling, bounded join). ``observe=True`` wires
+    the prefetch telemetry instruments (only prefetch_to_device should
+    — the histograms describe the host→device pipeline).
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    done = threading.Event()
+    err: List[Optional[BaseException]] = [None]
+    if stage is None:
+        stage = lambda item: item  # noqa: E731 - identity read-ahead
+
     def enqueue(item) -> bool:
         # bounded put that aborts when the consumer is gone, so an
         # abandoned iterator can't pin the worker (and its staged HBM
@@ -134,7 +162,7 @@ def prefetch_to_device(
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
-                if item is not _SENTINEL:
+                if item is not _SENTINEL and observe:
                     _PRODUCER_WAIT.observe(time.perf_counter() - t0)
                     _PREFETCH_DEPTH.set(q.qsize())
                 return True
@@ -144,8 +172,8 @@ def prefetch_to_device(
 
     def worker():
         try:
-            for batch in batches:
-                if stop.is_set() or not enqueue(put(batch)):
+            for item in items:
+                if stop.is_set() or not enqueue(stage(item)):
                     return
         except BaseException as e:  # parked for the consumer thread —
             # BaseException too: a KeyboardInterrupt/SystemExit dying in
@@ -156,7 +184,7 @@ def prefetch_to_device(
             done.set()
             enqueue(_SENTINEL)
 
-    t = threading.Thread(target=worker, daemon=True, name="tfs-prefetch")
+    t = threading.Thread(target=worker, daemon=True, name=thread_name)
     t.start()
 
     try:
@@ -184,9 +212,10 @@ def prefetch_to_device(
                 if err[0] is not None:
                     raise err[0]
                 return
-            _CONSUMER_WAIT.observe(time.perf_counter() - wait_t0)
-            _PREFETCH_DEPTH.set(q.qsize())
-            _PREFETCH_BATCHES.inc()
+            if observe:
+                _CONSUMER_WAIT.observe(time.perf_counter() - wait_t0)
+                _PREFETCH_DEPTH.set(q.qsize())
+                _PREFETCH_BATCHES.inc()
             yield item
             wait_t0 = time.perf_counter()
     finally:
@@ -201,13 +230,14 @@ def prefetch_to_device(
                 q.get_nowait()
         except queue.Empty:
             pass
-        _PREFETCH_DEPTH.set(0)
+        if observe:
+            _PREFETCH_DEPTH.set(0)
         t.join(timeout=join_timeout)
         if t.is_alive():  # pragma: no cover - requires a wedged transfer
             logger.warning(
-                "prefetch_to_device: worker still running %.1fs after "
-                "shutdown (stuck transfer?); leaving daemon thread behind",
-                join_timeout,
+                "%s: worker still running %.1fs after shutdown (stuck "
+                "stage?); leaving daemon thread behind",
+                thread_name, join_timeout,
             )
 
 
@@ -516,10 +546,11 @@ def _infer_csv_types(sample_rows, ncols):
 
 
 def read_csv(
-    path: str,
+    path,
     delimiter: str = ",",
     dtypes: Optional[Dict[str, str]] = None,
     num_blocks: Optional[int] = None,
+    rows_per_chunk: int = 262_144,
 ):
     """Read a header-ed CSV into a frame: int64/float64 columns for
     numeric data (types inferred from a sample; empty numeric fields →
@@ -530,7 +561,53 @@ def read_csv(
     builds without the native module take the csv-module path with the
     same semantics. ``dtypes`` ({column: "int64"|"float64"|"string"})
     overrides inference per column.
+
+    ``path`` may also be a **directory or a list of part files** (each
+    with its own header). Parts then ingest chunk by chunk through a
+    spillable :class:`~tensorframes_tpu.blockstore.BlockStore` instead
+    of materializing the whole table: peak ingest RSS is bounded by the
+    largest single part plus the ``TFTPU_BLOCK_BUDGET_MB`` budget, and
+    the returned frame's dense blocks are zero-read ``np.memmap`` views
+    over the spilled segments (the OS page cache owns residency; host
+    string columns still load eagerly). Column types are inferred from
+    the FIRST part and applied to the rest — pass ``dtypes`` when parts
+    could infer differently. ``num_blocks`` is honored via an explicit
+    ``repartition`` (which materializes — leave it None to stay
+    out-of-core; block structure then mirrors the ingest chunks).
+    For frames that must never materialize at all, walk
+    :func:`scan_csv` with ``blockstore.stream_chain`` instead.
     """
+    if isinstance(path, (list, tuple)) or os.path.isdir(path):
+        frame = _frame_via_store(
+            scan_csv(
+                path, delimiter=delimiter, dtypes=dtypes,
+                rows_per_chunk=rows_per_chunk,
+            ),
+            what=f"read_csv({path!r})",
+        )
+        if frame is None:
+            # every part was header-only: the single-file empty path
+            # builds the correctly-typed zero-row frame (scan_csv
+            # yields only non-empty blocks, and empty string columns
+            # cannot round-trip through frame_from_arrays)
+            [first, *_] = _part_files(path, (".csv", ".tsv", ".txt"))
+            return _read_csv_single(
+                first, delimiter=delimiter, dtypes=dtypes,
+                num_blocks=num_blocks,
+            )
+        return frame.repartition(num_blocks) if num_blocks else frame
+    return _read_csv_single(
+        path, delimiter=delimiter, dtypes=dtypes, num_blocks=num_blocks
+    )
+
+
+def _read_csv_single(
+    path: str,
+    delimiter: str = ",",
+    dtypes: Optional[Dict[str, str]] = None,
+    num_blocks: Optional[int] = None,
+):
+    """One CSV file → frame (the pre-dataplane ``read_csv`` body)."""
     import csv as _csv
     import re
 
@@ -628,6 +705,152 @@ def read_csv(
             else:
                 cols[n] = vals
     return frame_from_arrays(cols, num_blocks=num_blocks)
+
+
+# ---------------------------------------------------------------------------
+# Chunked multi-part ingest through the block store (ROADMAP #3)
+# ---------------------------------------------------------------------------
+
+def _part_files(paths, exts) -> List[str]:
+    """Resolve a directory (sorted, extension-filtered) or an explicit
+    list (caller order preserved — it IS the row order) to part files."""
+    if isinstance(paths, (list, tuple)):
+        out = [os.fspath(p) for p in paths]
+        missing = [p for p in out if not os.path.isfile(p)]
+        if missing:
+            raise FileNotFoundError(f"part file(s) not found: {missing}")
+        if not out:
+            raise ValueError("empty part-file list")
+        return out
+    out = []
+    for name in sorted(os.listdir(paths)):
+        full = os.path.join(paths, name)
+        if name.startswith((".", "_")) or not os.path.isfile(full):
+            continue
+        if os.path.splitext(name)[1].lower() in exts:
+            out.append(full)
+    if not out:
+        raise ValueError(
+            f"no part files matching {sorted(exts)} under {paths!r}"
+        )
+    return out
+
+
+def _iter_row_chunks(block: Dict[str, object], rows_per_chunk: int):
+    n = 0
+    for v in block.values():
+        n = len(v)
+        break
+    for lo in range(0, n, max(1, rows_per_chunk)):
+        hi = min(n, lo + rows_per_chunk)
+        yield {k: v[lo:hi] for k, v in block.items()}
+
+
+def scan_csv(
+    paths,
+    delimiter: str = ",",
+    dtypes: Optional[Dict[str, str]] = None,
+    rows_per_chunk: int = 262_144,
+) -> Iterator[Dict[str, object]]:
+    """Chunked CSV scan: yield ``{column: array|list}`` blocks of at
+    most ``rows_per_chunk`` rows from a directory / list of part files,
+    one part in memory at a time — the block source for
+    ``blockstore.stream_chain`` (multi-TB scans never materialize).
+    Types are inferred from the first part WITH rows and pinned as
+    overrides for the rest (pass ``dtypes`` to pin them yourself); a
+    part whose values cannot parse under the pinned types raises —
+    parts must be type-consistent. Only non-empty blocks are yielded
+    (header-only parts contribute nothing)."""
+    overrides: Dict[str, str] = dict(dtypes or {})
+    pinned = False
+    for part in _part_files(paths, (".csv", ".tsv", ".txt")):
+        f = _read_csv_single(
+            part, delimiter=delimiter,
+            dtypes=(overrides or None), num_blocks=1,
+        )
+        if not pinned and f.num_rows > 0:
+            # pin from the first part WITH rows: a header-only part
+            # infers float64 everywhere and would poison the overrides
+            for info in f.schema:
+                overrides.setdefault(info.name, info.dtype.name)
+            pinned = True
+        if f.num_rows == 0:
+            continue  # header-only part: nothing to yield (and see the
+            # pinning guard above — its float defaults must not stick)
+        [block] = f.blocks()
+        yield from _iter_row_chunks(block, rows_per_chunk)
+
+
+def scan_parquet(
+    paths, rows_per_chunk: int = 262_144
+) -> Iterator[Dict[str, object]]:
+    """Chunked Parquet scan (via pyarrow's batch reader): yield blocks
+    of at most ``rows_per_chunk`` rows from a directory / list of part
+    files without materializing any full table — the block source for
+    ``blockstore.stream_chain``."""
+    pa = _require_pyarrow()
+    import pyarrow.parquet as pq
+
+    for part in _part_files(paths, (".parquet", ".pq")):
+        pf = pq.ParquetFile(part)
+        for batch in pf.iter_batches(batch_size=max(1, rows_per_chunk)):
+            if batch.num_rows == 0:
+                continue
+            f = frame_from_arrow(
+                pa.Table.from_batches([batch]), num_blocks=1
+            )
+            [block] = f.blocks()
+            yield block
+
+
+def _frame_via_store(blocks_iter, what: str):
+    """Ingest a block stream through a spillable BlockStore and rebuild
+    a TensorFrame over memmap views of the spilled segments. The store
+    is pinned to the frame (dropped with it); ingest RSS is bounded by
+    the resident budget, not the table."""
+    import weakref
+
+    from .blockstore import BlockStore
+    from .blockstore.partitioner import SpilledFrame
+    from .frame import frame_from_arrays
+
+    store = BlockStore()
+    refs, schema, sig = [], None, None
+    try:
+        for block in blocks_iter:
+            f = frame_from_arrays(block, num_blocks=1)
+            fsig = [(i.name, i.dtype.name) for i in f.schema]
+            if schema is None:
+                schema, sig = f.schema, fsig
+            elif fsig != sig:
+                raise ValueError(
+                    f"{what}: part schema drifted — first part "
+                    f"{sig}, this chunk {fsig}; pass dtypes= to pin "
+                    "column types across parts"
+                )
+            [b] = f.blocks()
+            refs.append(store.put(b))
+    except BaseException:
+        store.close()
+        raise
+    if schema is None:
+        # zero non-empty chunks: the caller owns the typed empty-frame
+        # fallback (scan_* yield only non-empty blocks)
+        store.close()
+        return None
+    spilled = SpilledFrame(store, refs, schema, owns_store=True)
+    frame = spilled.to_frame(mmap=True)
+    # pin the spill segments to the frame's lifetime (deleted with it;
+    # on Linux open memmaps stay valid over the unlink)
+    frame._data_plane = spilled
+    weakref.finalize(frame, spilled.drop)
+    logger.info(
+        "%s: ingested %d chunk(s), %d rows via block store "
+        "(resident=%d spilled=%d)",
+        what, len(refs), spilled.num_rows, store.resident_bytes,
+        store.spilled_bytes,
+    )
+    return frame
 
 
 def write_csv(frame, path: str, delimiter: str = ",") -> None:
@@ -737,11 +960,32 @@ def frame_to_arrow(frame):
     return pa.table(arrays)
 
 
-def read_parquet(path: str, num_blocks: Optional[int] = None):
-    """Read a parquet file into a frame (via pyarrow)."""
+def read_parquet(
+    path, num_blocks: Optional[int] = None, rows_per_chunk: int = 262_144
+):
+    """Read a parquet file into a frame (via pyarrow).
+
+    ``path`` may also be a directory or a list of part files: parts
+    then ingest batch by batch through a spillable block store (same
+    contract as the multi-part ``read_csv`` — bounded ingest RSS,
+    memmap-backed dense blocks, ``num_blocks`` honored only via an
+    explicit materializing repartition). For never-materialize scans,
+    walk :func:`scan_parquet` with ``blockstore.stream_chain``."""
     _require_pyarrow()
     import pyarrow.parquet as pq
 
+    if isinstance(path, (list, tuple)) or os.path.isdir(path):
+        frame = _frame_via_store(
+            scan_parquet(path, rows_per_chunk=rows_per_chunk),
+            what=f"read_parquet({path!r})",
+        )
+        if frame is None:  # all parts empty: the single-file path owns
+            # the typed zero-row frame (see read_csv)
+            [first, *_] = _part_files(path, (".parquet", ".pq"))
+            return frame_from_arrow(
+                pq.read_table(first), num_blocks=num_blocks
+            )
+        return frame.repartition(num_blocks) if num_blocks else frame
     return frame_from_arrow(pq.read_table(path), num_blocks=num_blocks)
 
 

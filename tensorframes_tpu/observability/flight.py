@@ -14,7 +14,12 @@ injections, checkpoint IO — and, since the fleet-supervision layer, the
 ``fleet.restart``; since the serving layer, the ``serving.*`` family:
 ``serving.start`` / ``serving.drain`` / ``serving.stop``,
 ``serving.flush``, ``serving.reject``, ``serving.deadline``,
-``serving.error``) and turns it into a redacted JSONL dump at the
+``serving.error``; since the out-of-core data plane, the
+``blockstore.*`` family: ``blockstore.spill``,
+``blockstore.quarantine``, and the ``shuffle.*`` family:
+``shuffle.exchange``, ``shuffle.quarantine``, ``shuffle.hang`` — the
+last dumped as a postmortem naming the missing ranks when a peer dies
+mid-exchange) and turns it into a redacted JSONL dump at the
 moment of death, so ``read_blackbox()`` shows the whole fleet's history
 after a crash.
 

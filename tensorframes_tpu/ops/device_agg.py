@@ -196,6 +196,12 @@ def _allgather_dicts(local_cols: List[np.ndarray]) -> Tuple[List[np.ndarray], in
     padded = np.zeros(width, np.uint8)
     padded[: payload.size] = payload
     bufs = np.asarray(mh.process_allgather(padded)).reshape(len(sizes), width)
+    # every rank received every rank's dictionary — the host-gather
+    # volume the file shuffle exists to eliminate (asserted zero in the
+    # shuffled-aggregate tests)
+    from ..blockstore.store import HOSTGATHER_BYTES
+
+    HOSTGATHER_BYTES.inc(float(bufs.nbytes))
     dicts = [
         pickle.loads(bufs[p, : int(sizes[p])].tobytes())
         for p in range(len(sizes))

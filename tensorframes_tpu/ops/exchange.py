@@ -316,6 +316,50 @@ def _exchange_bytes(parts: List[bytes]) -> List[bytes]:
     return [bytes(b) for b in recv]
 
 
+def _file_shuffle_ctx():
+    """The file-transport shuffle context (blockstore.shuffle), or None
+    when no shuffle dir is armed — the exchange then rides the XLA
+    collective. A context whose world disagrees with an initialized
+    multi-process jax fleet is IGNORED (a stale/foreign shuffle env
+    must not hijack the fleet: callers partition rows by
+    ``jax.process_count()``, and a smaller file world would silently
+    drop the excess partitions). Lazy import: the exchange must not
+    pull the blockstore package into processes that never shuffle."""
+    import jax
+
+    from ..blockstore import shuffle as _fs
+
+    ctx = _fs.context() if _fs.enabled() else None
+    if (
+        ctx is not None
+        and jax.process_count() > 1
+        and ctx.nprocs != jax.process_count()
+    ):
+        return None
+    return ctx
+
+
+def _exchange_bytes_files(parts: List[bytes], ctx) -> List[bytes]:
+    """File-transport twin of :func:`_exchange_bytes`: per-rank spill
+    files in the shared shuffle dir (blockstore.shuffle.exchange) —
+    CRC-framed, deadline-bounded, no collective involved, so it works
+    on backends without multi-process collectives and between plain OS
+    processes. Keeps ``last_exchange_stats`` populated for the same
+    observability."""
+    global last_exchange_stats
+    from ..blockstore import shuffle as _fs
+
+    recv = _fs.exchange(parts, name="exchange_rows", ctx=ctx)
+    last_exchange_stats = {
+        "sent": [len(p) for p in parts],
+        "received": [len(b) for b in recv],
+        "rounds": 1,
+        "chunk": max((len(p) for p in parts), default=0),
+        "transport": "files",
+    }
+    return recv
+
+
 def exchange_rows(
     cols: Dict[str, object], part: np.ndarray
 ) -> Dict[str, object]:
@@ -324,10 +368,18 @@ def exchange_rows(
     row order — deterministic). ``cols`` maps names to process-local
     numpy arrays or cell lists; ``part`` holds each row's destination
     process. Everything serializes through pickle so string/object and
-    multi-dim columns exchange the same way."""
+    multi-dim columns exchange the same way.
+
+    Transport: the chunked ``lax.all_to_all`` collective by default;
+    per-rank spill files (:mod:`tensorframes_tpu.blockstore.shuffle`)
+    when a shuffle dir is armed (``TFTPU_SHUFFLE_DIR``, or
+    ``TFTPU_SHUFFLE_TRANSPORT=files`` on a rendezvous-dir fleet) —
+    rank/world then come from the shuffle context, so file-fleet
+    processes without ``jax.distributed`` exchange the same way."""
     import jax
 
-    procs = jax.process_count()
+    fctx = _file_shuffle_ctx()
+    procs = fctx.nprocs if fctx is not None else jax.process_count()
     names = list(cols)
     as_arr = {
         n: (
@@ -344,7 +396,11 @@ def exchange_rows(
         payloads.append(
             pickle.dumps(sub, protocol=pickle.HIGHEST_PROTOCOL)
         )
-    received = _exchange_bytes(payloads)
+    received = (
+        _exchange_bytes_files(payloads, fctx)
+        if fctx is not None
+        else _exchange_bytes(payloads)
+    )
     chunks = [pickle.loads(b) for b in received]
     out: Dict[str, object] = {}
     for i, n in enumerate(names):

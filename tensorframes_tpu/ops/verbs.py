@@ -1390,6 +1390,9 @@ def _allgather_rows(arr: np.ndarray, ks: Optional[np.ndarray] = None) -> np.ndar
     padded[: arr.shape[0]] = arr
     gathered = np.asarray(mh.process_allgather(padded))
     gathered = gathered.reshape((len(ks), kmax) + arr.shape[1:])
+    from ..blockstore.store import HOSTGATHER_BYTES
+
+    HOSTGATHER_BYTES.inc(float(gathered.nbytes))
     return np.concatenate([gathered[p, : int(ks[p])] for p in range(len(ks))])
 
 
@@ -1445,7 +1448,21 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
         if ok:
             n_local = len(cols[keys[0]])
             ok = all(len(cols[c]) == n_local for c in cols)
-    if not uniform_ok(ok):
+    from .exchange import _file_shuffle_ctx
+
+    fctx = _file_shuffle_ctx()
+    if fctx is not None and fctx.nprocs != jax.process_count():
+        fctx = None  # a stale/foreign shuffle dir must not hijack a fleet
+    if fctx is not None and fctx.nprocs > 1:
+        # the eligibility vote goes through spill files too: with the
+        # file transport armed, XLA collectives may be unavailable
+        # entirely (that is the transport's reason to exist)
+        from ..blockstore import shuffle as _fs
+
+        agree = _fs.vote_all(ok, name="agg.ok")
+    else:
+        agree = uniform_ok(ok)
+    if not agree:
         return None
 
     if len(cols[keys[0]]):
@@ -1463,6 +1480,14 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
     partials = _batched_compaction(
         program, val_local, ids_local, k_local, out_names,
     )
+    if fctx is not None and fctx.nprocs > 1:
+        # file-shuffle merge (ROADMAP #3): ZERO host-gathered partial
+        # tables — partials hash-partition by group key through per-rank
+        # spill files, each rank combines only its key partition, and
+        # only the small finals are shared back
+        return _merge_partials_shuffled(
+            program, frame, keys, out_names, list(local_dict), partials,
+        )
     from jax.experimental import multihost_utils as mh
 
     union_key_cols, _ = _allgather_dicts(list(local_dict))
@@ -1475,6 +1500,54 @@ def _aggregate_multiprocess_generic(program, frame, keys, out_names):
     union_ids, group_key_cols, K = group_ids(union_key_cols)
     out_cols = _batched_compaction(
         program, union_vals, union_ids, K, out_names
+    )
+    return assemble_key_cols(frame, keys, group_key_cols), out_cols
+
+
+def _merge_partials_shuffled(
+    program, frame, keys, out_names, local_dict, partials
+):
+    """Merge per-rank partial aggregation tables through the file
+    shuffle (blockstore.shuffle) instead of allgathering them: the
+    combine work distributes over ranks, no rank ever holds every
+    rank's partials, and the exchange needs no XLA collective. Returns
+    the same replicated ``(key_cols, out_cols)`` as the allgather
+    path, groups in lexicographic key order."""
+    from ..blockstore import shuffle as _fs
+    from .device_agg import assemble_key_cols
+    from .exchange import partition_by_hash
+    from .keys import group_ids
+
+    key_names = [f"__k{i}" for i in range(len(local_dict))]
+    table = {n: np.asarray(a) for n, a in zip(key_names, local_dict)}
+    for x in out_names:
+        table[x] = np.asarray(partials[x])
+    nprocs = _fs.context().nprocs
+    part = partition_by_hash([table[n] for n in key_names], nprocs)
+    mine = _fs.shuffle_rows(table, part, name="agg.partials")
+    kcols = [np.asarray(mine[n]) for n in key_names]
+    if len(kcols[0]):
+        ids, gk, K = group_ids(kcols)
+        combined = _batched_compaction(
+            program, {x: np.asarray(mine[x]) for x in out_names},
+            ids.astype(np.int64), K, out_names,
+        )
+    else:
+        gk = [a[:0] for a in kcols]
+        combined = {x: np.asarray(mine[x])[:0] for x in out_names}
+    final = {n: np.asarray(g) for n, g in zip(key_names, gk)}
+    for x in out_names:
+        final[x] = np.asarray(combined[x])
+    union = _fs.allshare_table(final, name="agg.finals")
+    union_key_cols = [
+        np.asarray(union[n], dtype=object)
+        if isinstance(union[n], list) else np.asarray(union[n])
+        for n in key_names
+    ]
+    union_ids, group_key_cols, K = group_ids(union_key_cols)
+    out_cols = _batched_compaction(
+        program, {x: np.asarray(union[x]) for x in out_names},
+        union_ids.astype(np.int64), K, out_names,
     )
     return assemble_key_cols(frame, keys, group_key_cols), out_cols
 

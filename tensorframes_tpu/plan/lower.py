@@ -1585,6 +1585,65 @@ def pushdown_misses(frame) -> List[dict]:
     return out
 
 
+def estimate_materialized_bytes(frame) -> Optional[int]:
+    """Host-byte estimate of materializing ``frame``: ``estimated_rows``
+    (never forces a lazy chain) × the schema's dense per-row width.
+    Unknown cell dims count as 1 and host columns as a pointer-sized
+    cell — a deliberate LOWER bound, so TFG111's larger-than-budget
+    finding never fires on an estimate that could legitimately be
+    smaller. None when the row count is unknowable pre-force."""
+    rows = frame.estimated_rows
+    if rows is None:
+        return None
+    per_row = 0
+    for info in frame.schema:
+        if info.is_device:
+            elems = 1
+            for d in info.cell_shape.dims:
+                if isinstance(d, int):
+                    elems *= max(1, d)
+            per_row += elems * np.dtype(info.dtype.np_dtype).itemsize
+        else:
+            per_row += 8
+    return int(rows) * per_row
+
+
+def oversized_materializations(frame) -> List[dict]:
+    """TFG111 evidence for ``lint_plan``: forced ``to_host``/
+    ``to_numpy`` materializations on ``frame``'s chain whose estimated
+    bytes exceed the block-store budget
+    (``config.block_budget_bytes`` / ``TFTPU_BLOCK_BUDGET_MB``) — the
+    workload the streaming partitioner exists for. Checks the frame
+    itself and its chain source (the two places ``ir.mark_barrier``
+    records the materialization); pure, never forces a lazy frame."""
+    from ..config import get_config
+
+    budget = get_config().block_budget_bytes
+    if budget <= 0:
+        return []
+    out: List[dict] = []
+    node = getattr(frame, "_plan", None)
+    source = ir.resolve_chain(node)[0] if node is not None else None
+    seen = set()
+    for f in (frame, source):
+        if f is None or id(f) in seen:
+            continue
+        seen.add(id(f))
+        reason = getattr(f, "_fusion_barrier", None)
+        if not reason or "to_host" not in str(reason):
+            continue
+        est = estimate_materialized_bytes(f)
+        if est is None or est <= budget:
+            continue
+        out.append({
+            "reason": str(reason),
+            "estimated_bytes": int(est),
+            "budget_bytes": int(budget),
+            "rows": int(f.estimated_rows or 0),
+        })
+    return out
+
+
 def lower_reduce(
     frame, program, out_names: Sequence[str], mode: str
 ) -> Optional[tuple]:

@@ -281,6 +281,26 @@ register_site(
     "injected errors crash the loop into the nonzero-exit path",
 )
 
+# Out-of-core data-plane chaos sites (ISSUE 15). Registered centrally
+# for the same reason as the serving sites: drills must see them even
+# before the blockstore package loads.
+register_site(
+    "blockstore.spill",
+    "blockstore/store.py _spill_entry, before the segment publish — an "
+    "injected error fails that block's spill (the put raises; resident "
+    "accounting is untouched); an injected Delay stalls the spill, "
+    "back-pressuring the streaming partitioner deterministically",
+)
+register_site(
+    "shuffle.exchange",
+    "blockstore/shuffle.py exchange/allshare entry and every framed "
+    "payload read — an injected Delay stalls this rank's exchange so "
+    "peers' deadline waits (and the hung-shuffle postmortem naming this "
+    "rank) are drillable; an injected transient OSError exercises the "
+    "CRC-framed read's retry policy; a persistent one quarantines the "
+    "payload and raises ShuffleCorruptionError",
+)
+
 
 @contextmanager
 def inject(

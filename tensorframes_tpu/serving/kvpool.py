@@ -220,6 +220,69 @@ class PagedKVPool:
                 f"phantom pages: {sorted(have - want)}"
             )
 
+    # -- host-swap tier (ROADMAP #3 data plane) ------------------------------
+
+    def spill(self, store) -> Dict[str, object]:
+        """Snapshot the whole pool into a
+        :class:`~tensorframes_tpu.blockstore.BlockStore`: the device
+        columns land as ONE spilled block (explicitly pushed to disk —
+        a pool snapshot is cold by definition, it must not consume the
+        store's resident budget) plus the host bookkeeping (free list,
+        ownership) in the returned snapshot dict. This is the KV pool's
+        host-swap tier: a served model's KV state survives an engine
+        restart through the same CRC-checked segments frame blocks
+        spill to, and :meth:`restore` brings it back bit-identically.
+        Per-sequence swap (evict one sequence's pages to host instead
+        of recompute-replay) remains the named follow-up."""
+        block = {k: np.asarray(v) for k, v in self.columns.items()}
+        ref = store.put(block)
+        store.spill(ref)
+        return {
+            "ref": ref,
+            "free": list(self._free),
+            "owned": {int(s): list(p) for s, p in self._owned.items()},
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "max_pages_per_seq": self.max_pages_per_seq,
+        }
+
+    def restore(self, store, snapshot: Dict[str, object]) -> None:
+        """Rehydrate pool state from a :meth:`spill` snapshot:
+        CRC-checked reload of the column block (corruption raises
+        ``BlockCorruptionError`` — counted + quarantined by the store,
+        never silently served), ``device_put`` back to the default
+        device, and the page accounting restored exactly. Geometry
+        mismatches raise before anything is touched."""
+        import jax
+
+        for field in ("num_pages", "page_size", "max_pages_per_seq"):
+            if int(snapshot[field]) != int(getattr(self, field)):
+                raise PoolAccountingError(
+                    f"restore into a pool with different {field}: "
+                    f"snapshot {snapshot[field]}, pool {getattr(self, field)}"
+                )
+        block = store.get(snapshot["ref"])
+        if set(block) != set(self.columns):
+            raise PoolAccountingError(
+                f"snapshot columns {sorted(block)} != pool columns "
+                f"{sorted(self.columns)}"
+            )
+        new_cols = {
+            k: jax.device_put(np.asarray(v)) for k, v in block.items()
+        }
+        old_free = len(self._free)
+        self.columns = new_cols
+        self._free = collections.deque(int(p) for p in snapshot["free"])
+        self._owned = {
+            int(s): [int(p) for p in pages]
+            for s, pages in dict(snapshot["owned"]).items()
+        }
+        self.check()
+        if not self._closed:
+            from . import metrics as m
+
+            m.DECODE_FREE_PAGES.inc(len(self._free) - old_free)
+
     # -- frame view ---------------------------------------------------------
 
     def as_frame(self):

@@ -509,3 +509,250 @@ def test_four_process_psum(tmp_path):
     Generous timeout: each worker pays the full jax import + compile,
     and the suite may be sharing the machine."""
     _run_workers(tmp_path, 4, timeout=420)
+
+
+# ---------------------------------------------------------------------------
+# file-shuffle fleet (ISSUE 15): the distributed data plane WITHOUT jax
+# collectives — ranks exchange hash-partitioned partial tables through
+# per-rank spill files in a shared shuffle dir. Unlike the psum fleets
+# above, these workers need no coordinator and no cross-process XLA
+# collectives, so they run on every jaxlib (including ones whose
+# multi-process CPU collectives are missing).
+# ---------------------------------------------------------------------------
+
+_SHUFFLE_WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+sys.path.insert(0, {repo!r})
+rank = int(sys.argv[1])
+os.environ["TFTPU_SHUFFLE_RANK"] = str(rank)
+os.environ["TFTPU_SHUFFLE_NPROCS"] = "2"
+import numpy as np
+import tensorframes_tpu as tfs
+from tensorframes_tpu.blockstore import shuffle
+from tensorframes_tpu.blockstore.store import HOSTGATHER_BYTES
+
+# the shared dataset recipe (seed-deterministic): rank r holds half the
+# rows, so the union across ranks IS the oracle's frame
+rng = np.random.default_rng(7)
+N = 4000
+k_i64 = rng.integers(0, 50, size=N).astype(np.int64)
+k_i64[: N // 2] = 7  # skewed: one hot key owns half the rows
+k_f64 = (k_i64 % 11).astype(np.float64) / 2.0
+vals = rng.integers(0, 1000, size=N).astype(np.float64)  # int-valued: exact sums
+k_str = [f"g{{int(x) % 5}}" for x in k_i64]
+lo, hi = (0, N // 2) if rank == 0 else (N // 2, N)
+local = tfs.frame_from_arrays(
+    {{"k": k_i64[lo:hi], "kf": k_f64[lo:hi], "v": vals[lo:hi],
+      "s": k_str[lo:hi]}}
+)
+
+def agg_sum(key):
+    def fn(f):
+        with tfs.with_graph():
+            v_in = tfs.block(f, "v", tf_name="v_input")
+            return tfs.aggregate(
+                tfs.reduce_sum(v_in, axis=0, name="v"), f.group_by(key)
+            )
+    return fn
+
+def agg_min(key):
+    def fn(f):
+        with tfs.with_graph():
+            v_in = tfs.block(f, "v", tf_name="v_input")
+            return tfs.aggregate(
+                tfs.reduce_min(v_in, axis=0, name="v"), f.group_by(key)
+            )
+    return fn
+
+# shuffled aggregates across every key dtype (+ the skewed int key)
+r_i = shuffle.distributed_aggregate(local, ["k"], agg_sum("k"), name="a-i64")
+r_f = shuffle.distributed_aggregate(local, ["kf"], agg_min("kf"), name="a-f64")
+r_s = shuffle.distributed_aggregate(local, ["s"], agg_sum("s"), name="a-str")
+
+# shuffled join: rank-local right side, union across ranks = full dim table
+right = tfs.frame_from_arrays(
+    {{"k": np.arange(rank * 25, rank * 25 + 25, dtype=np.int64),
+      "w": np.arange(25, dtype=np.float64) + rank * 100}}
+)
+jcols = shuffle.distributed_join(
+    local.select(["k", "v"]), right, on="k", name="j"
+)
+
+# THE acceptance gate: zero host-gathered partial tables anywhere
+assert HOSTGATHER_BYTES.value == 0.0, HOSTGATHER_BYTES.value
+
+if rank == 0:
+    np.savez(
+        {out!r},
+        k=r_i.column_values("k"), v=r_i.column_values("v"),
+        fk=r_f.column_values("kf"), fv=r_f.column_values("v"),
+        sk=np.asarray(r_s.column_values("s"), dtype=object),
+        sv=r_s.column_values("v"),
+        jk=np.asarray(jcols["k"]), jv=np.asarray(jcols["v"]),
+        jw=np.asarray(jcols["w"]),
+        allow_pickle=True,
+    )
+print("SHUFFLE_WORKER_OK", rank, flush=True)
+'''
+
+
+def _shuffle_env(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["TFTPU_SHUFFLE_DIR"] = str(tmp_path / "shuffle")
+    env.pop("TFTPU_FLEET_DIR", None)
+    return env
+
+
+def test_two_process_file_shuffle_matches_single_process_oracle(tmp_path):
+    """2 real OS processes, NO jax.distributed: shuffled aggregate
+    (int64 / float64 / string keys, one hot key owning half the rows)
+    and shuffled join, all bit-identical to the single-process oracle —
+    with the host-gather metric asserted ZERO in every worker."""
+    import numpy as np
+
+    out = str(tmp_path / "rank0.npz")
+    script = tmp_path / "shuffle_worker.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script.write_text(_SHUFFLE_WORKER.format(repo=repo, out=out))
+    env = _shuffle_env(tmp_path)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for r in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
+        assert f"SHUFFLE_WORKER_OK {r}" in o, o[-2000:]
+
+    # the single-process oracle over the union of both ranks' rows
+    import tensorframes_tpu as tfs
+
+    rng = np.random.default_rng(7)
+    N = 4000
+    k_i64 = rng.integers(0, 50, size=N).astype(np.int64)
+    k_i64[: N // 2] = 7
+    k_f64 = (k_i64 % 11).astype(np.float64) / 2.0
+    vals = rng.integers(0, 1000, size=N).astype(np.float64)
+    k_str = [f"g{int(x) % 5}" for x in k_i64]
+    full = tfs.frame_from_arrays(
+        {"k": k_i64, "kf": k_f64, "v": vals, "s": k_str}
+    )
+
+    def agg(key, red):
+        with tfs.with_graph():
+            v_in = tfs.block(full, "v", tf_name="v_input")
+            return tfs.aggregate(
+                red(v_in, axis=0, name="v"), full.group_by(key)
+            )
+
+    z = np.load(str(tmp_path / "rank0.npz"), allow_pickle=True)
+    oi = agg("k", tfs.reduce_sum)
+    np.testing.assert_array_equal(z["k"], oi.column_values("k"))
+    np.testing.assert_array_equal(z["v"], oi.column_values("v"))
+    of = agg("kf", tfs.reduce_min)
+    np.testing.assert_array_equal(z["fk"], of.column_values("kf"))
+    np.testing.assert_array_equal(z["fv"], of.column_values("v"))
+    os_ = agg("s", tfs.reduce_sum)
+    assert list(z["sk"]) == list(os_.column_values("s"))
+    np.testing.assert_array_equal(z["sv"], os_.column_values("v"))
+    # join: same multiset of rows, bit-identical after canonical sort
+    right = tfs.frame_from_arrays({
+        "k": np.arange(50, dtype=np.int64),
+        "w": np.concatenate(
+            [np.arange(25.0), np.arange(25.0) + 100]
+        ),
+    })
+    oj = full.select(["k", "v"]).join(right, on="k", how="inner")
+
+    def canon(cols):
+        arrs = [np.asarray(cols[c]) for c in ("k", "v", "w")]
+        order = np.lexsort(arrs[::-1])
+        return [a[order] for a in arrs]
+
+    got = canon({"k": z["jk"], "v": z["jv"], "w": z["jw"]})
+    want = canon({c: oj.column_values(c) for c in ("k", "v", "w")})
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+_SHUFFLE_KILL_WORKER = r'''
+import os, signal, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+sys.path.insert(0, {repo!r})
+rank = int(sys.argv[1])
+os.environ["TFTPU_SHUFFLE_RANK"] = str(rank)
+os.environ["TFTPU_SHUFFLE_NPROCS"] = "2"
+from tensorframes_tpu.blockstore import shuffle
+from tensorframes_tpu.resilience.fleet import HungDispatchError
+
+if rank == 1:
+    # die MID-shuffle: part files published, done-marker never lands —
+    # the torn state a real kill -9 leaves behind
+    _orig = shuffle._publish
+    def _dying(path, payload):
+        if "src-00001.done" in path:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return _orig(path, payload)
+    shuffle._publish = _dying
+try:
+    shuffle.exchange([b"a", b"b"], name="killdrill", timeout=10.0)
+    print("NO_ABORT", flush=True)
+except HungDispatchError as e:
+    assert "[1]" in str(e), str(e)
+    print("WATCHDOG_ABORT_NAMED", flush=True)
+'''
+
+
+def test_kill9_mid_shuffle_watchdog_abort_names_the_rank(tmp_path):
+    """kill -9 of rank 1 between its part files and its done marker:
+    rank 0's deadline-bounded wait raises HungDispatchError NAMING rank
+    1 (never an indefinite hang), and the flight recorder's disk spool
+    holds the shuffle.hang postmortem."""
+    import glob
+
+    script = tmp_path / "kill_worker.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script.write_text(_SHUFFLE_KILL_WORKER.format(repo=repo))
+    env = _shuffle_env(tmp_path)
+    env["TFTPU_FLIGHT_DIR"] = str(tmp_path / "flight")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for r in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert procs[1].returncode == -9, outs[1][-1000:]  # really SIGKILLed
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert "WATCHDOG_ABORT_NAMED" in outs[0], outs[0][-2000:]
+    # the black box survived: a postmortem naming the hang is on disk
+    dumps = glob.glob(str(tmp_path / "flight" / "postmortem_*.jsonl"))
+    assert dumps, os.listdir(str(tmp_path / "flight"))
+    joined = "".join(open(d).read() for d in dumps)
+    assert "shuffle.hang" in joined
