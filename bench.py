@@ -381,6 +381,7 @@ def _bench_multijoin(n_rows: int = 1_000_000, iters: int = 4,
         opt_s = wall(iters)
         pushdowns = int(_counter_value("pushdown_aggregate") - p0)
         opt_rows = run_once()
+        flips = _flip_smoke(run_once, opt_rows, _counter_value)
         tfs.configure(plan_reopt=False)  # the TFTPU_REOPT=0 path
         static_s = wall(iters)
         static_rows = run_once()
@@ -418,7 +419,68 @@ def _bench_multijoin(n_rows: int = 1_000_000, iters: int = 4,
             "pushdown_aggregate decision — the adaptive path did not "
             "engage"
         )
-    return opt_s, static_s, unfused_s, pushdowns
+    return opt_s, static_s, unfused_s, pushdowns, flips
+
+
+def _flip_smoke(run_once, baseline_rows, counter_value) -> int:
+    """Latency-driven decision-flip smoke (ISSUE 17), hard-gated:
+    invert the observed fuse-vs-per-stage walls in the stats sidecar
+    and require the NEXT execution to (a) choose the per-stage replay
+    (``split_single_stage`` decisions recorded where ``fuse`` was), (b)
+    count each flip as ``reoptimized``, and (c) stay bit-identical —
+    the replay IS the TFTPU_FUSION=0 path. The injected walls are
+    dropped afterwards so no later leg (or a sidecar-sharing real run)
+    acts on synthetic evidence."""
+    from tensorframes_tpu.plan import stats as _pstats
+    from tensorframes_tpu.plan.stats import STRATEGY_WALL_MIN_SAMPLES
+
+    walls = _pstats.strategy_walls("fuse")
+    if not walls.get("fuse", {}).get("n"):
+        raise AssertionError(
+            "multijoin flip: the warm executions never observed a "
+            "'fuse' strategy wall — the latency feedback loop is dark"
+        )
+    try:
+        # invert: the fused dispatch "measured" 10s, the per-stage
+        # replay 0.1ms — enough samples on both sides to clear the
+        # flip's hysteresis margin
+        for _ in range(max(2, STRATEGY_WALL_MIN_SAMPLES) * 2):
+            _pstats.observe_strategy_wall("fuse", "fuse", 10.0)
+            _pstats.observe_strategy_wall("fuse", "split_single_stage",
+                                          1e-4)
+        s0 = counter_value("split_single_stage")
+        r0 = counter_value("reoptimized")
+        flip_rows = run_once()
+        flipped = int(counter_value("split_single_stage") - s0)
+        reopts = int(counter_value("reoptimized") - r0)
+    finally:
+        _pstats.reset_strategy_walls()
+    if flipped <= 0:
+        raise AssertionError(
+            "multijoin flip: execution after inverted walls still "
+            "chose the fused dispatch — the latency-driven decision "
+            "never engaged"
+        )
+    if reopts <= 0:
+        raise AssertionError(
+            "multijoin flip: the flip engaged but was not counted as "
+            "a reoptimized decision"
+        )
+    if len(flip_rows) != len(baseline_rows):
+        raise AssertionError(
+            "multijoin flip: block count changed across the flip — "
+            "the bit-identical contract is broken"
+        )
+    for fb, bb in zip(flip_rows, baseline_rows):
+        for name in fb:
+            if not np.array_equal(np.asarray(fb[name]),
+                                  np.asarray(bb[name])):
+                raise AssertionError(
+                    "multijoin flip: outputs differ in column "
+                    f"{name!r} across the flip — the bit-identical "
+                    "contract is broken"
+                )
+    return reopts
 
 
 def _bench_inception(n_rows: int = 512, iters: int = 4, channel_scale: float = 1.0,
@@ -1806,10 +1868,10 @@ def main():
         )
     (
         multijoin_opt_s, multijoin_static_s, multijoin_unfused_s,
-        multijoin_pushdowns,
+        multijoin_pushdowns, multijoin_flips,
     ) = _try(
         "multijoin", _bench_multijoin,
-        (float("nan"), float("nan"), float("nan"), 0),
+        (float("nan"), float("nan"), float("nan"), 0, 0),
         metric_keys=(
             "multijoin_opt_1M_wall_s", "multijoin_static_1M_wall_s",
             "multijoin_unfused_1M_wall_s",
@@ -1822,12 +1884,13 @@ def main():
         print(
             "# plan | multijoin opt={:.4f}s static={:.4f}s "
             "unfused={:.4f}s ratio={:.2f}x pushdowns={} "
-            "bit_identical=True (acceptance: >= 1.5x opt vs "
-            "TFTPU_REOPT=0)".format(
+            "latency_flips={} bit_identical=True (acceptance: >= 1.5x "
+            "opt vs TFTPU_REOPT=0, >= 1 counted flip after inverted "
+            "walls)".format(
                 multijoin_opt_s, multijoin_static_s,
                 multijoin_unfused_s,
                 multijoin_static_s / multijoin_opt_s,
-                multijoin_pushdowns,
+                multijoin_pushdowns, multijoin_flips,
             )
         )
     try:

@@ -209,6 +209,21 @@ run_step "Out-of-core smoke (5x-budget CSV stream, bounded RSS)" bash -c "
 run_step "Fleet chaos drill (kill-rank + hung-collective + drop-heartbeat)" \
   env TFTPU_FLIGHT_DIR="$WORK/obs/flight" bash "$CLONE/dev/resilience_drill.sh" --only fleet-chaos
 
+# ci.yml's plan-profile step (ISSUE 17): a tier-1 slice + the multijoin
+# pipeline against a pinned compile cache; hard gates are the counted
+# latency-driven decision flip (asserted inside _bench_multijoin) and
+# at least one EXPLAIN ANALYZE profile sidecar, with the rendered
+# report landing next to the other observability artifacts
+run_step "Plan-profile sidecars + latency-driven decision-flip smoke (EXPLAIN ANALYZE)" bash -c "
+  export TFTPU_COMPILE_CACHE='$WORK/cc-profile' &&
+  python -m pytest tests/test_plan_adaptive.py tests/test_relational_pipeline.py -q &&
+  python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench._bench_multijoin(n_rows=200000, iters=1)\" &&
+  ls '$WORK/cc-profile/planstats/'*.json >/dev/null &&
+  mkdir -p '$WORK/obs/planstats' &&
+  cp '$WORK/cc-profile/planstats/'*.json '$WORK/obs/planstats/' &&
+  python -m tensorframes_tpu.observability report --profile '$WORK/cc-profile/planstats' | tee '$WORK/obs/plan_profile_report.txt'
+"
+
 run_step "Resilience drill (kill–resume, corrupted restore, fault injection)" \
   bash "$CLONE/dev/resilience_drill.sh" --skip fleet-chaos
 

@@ -1895,6 +1895,15 @@ class TensorFrame:
         """≙ ``explainTensors`` (dsl/Implicits.scala:77-79)."""
         return explain(self)
 
+    def explain(self, detailed: bool = False,
+                analyze: bool = False) -> str:
+        """Schema + tensor metadata rendering; ``detailed=True`` adds
+        the physical layout. ``analyze=True`` is EXPLAIN ANALYZE
+        (ISSUE 17): appends the plan tree annotated with the per-stage
+        profile recorded by the frame's last adaptive execution (see
+        :func:`tensorframes_tpu.explain_plan`)."""
+        return explain(self, detailed=detailed, analyze=analyze)
+
     def group_by(self, *keys: str) -> "GroupedData":
         """Group rows by key column(s) for keyed ``aggregate``
         (≙ ``df.groupBy("key")`` feeding ``tfs.aggregate``, core.py:401-419)."""
@@ -2224,13 +2233,21 @@ def append_shape(frame: TensorFrame, col: str, shape) -> TensorFrame:
     return TensorFrame(None, frame.schema.replace(new_info), pending=compute)
 
 
-def explain(frame: TensorFrame, detailed: bool = False) -> str:
+def explain(frame: TensorFrame, detailed: bool = False,
+            analyze: bool = False) -> str:
     """Schema rendering with tensor metadata (≙ ``OperationsInterface.explain``,
     DebugRowOps.scala:535-552). With ``detailed=True`` adds the physical
     layout — block row counts, storage kinds, device placement
     (≙ ``explainDetailed``, ExperimentalOperations.scala:26-37) —
-    materializing the frame if needed."""
+    materializing the frame if needed. With ``analyze=True`` appends
+    the EXPLAIN ANALYZE view: the plan tree annotated with the
+    per-stage profile, decisions, and TFG cross-references recorded by
+    the frame's last adaptive execution (ISSUE 17)."""
     base = frame.schema.explain()
+    if analyze:
+        from .plan import explain_plan as _explain_plan
+
+        base = base + "\n\n" + _explain_plan(frame, analyze=True)
     if not detailed:
         return base
     lines = [base, ""]

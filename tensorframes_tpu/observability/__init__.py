@@ -40,6 +40,7 @@ from . import flight  # noqa: F401
 from . import latency  # noqa: F401
 from . import merge  # noqa: F401
 from . import metrics  # noqa: F401
+from . import profile  # noqa: F401
 from .events import TRACER, Tracer  # noqa: F401
 from .flight import FlightRecorder, RECORDER  # noqa: F401
 from .merge import merge_traces  # noqa: F401
@@ -63,6 +64,7 @@ __all__ = [
     "latency",
     "merge",
     "metrics",
+    "profile",
     "Tracer",
     "TRACER",
     "FlightRecorder",

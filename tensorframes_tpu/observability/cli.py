@@ -6,6 +6,10 @@ The operational face of the observability layer:
   artifact this repo produces (bench snapshot, ``BENCH_r*.json`` round,
   bench stdout, or a metrics-registry JSONL export), with latency
   quantiles derived where histograms are present.
+  ``report --profile <sidecar-dir>`` instead renders the plan-profile
+  sidecars (``plan/stats.py``): top-N slowest recorded plan stages
+  across all fingerprints + the per-strategy observed-wall tables
+  feeding the latency-driven ``decide_*`` flips.
 * ``merge -o merged.json <shards...>`` — combine per-process trace
   shards (``events.save_shard``) from a multi-process run into one
   JSON-valid Chrome/Perfetto trace with per-process tracks. ``--dir``
@@ -32,6 +36,15 @@ __all__ = ["main"]
 
 
 def _cmd_report(args) -> int:
+    if args.profile:
+        from . import profile as _profile
+
+        print(_profile.render_report(args.profile, top=args.top))
+        return 0
+    if not args.path:
+        print("report: pass an artifact path or --profile <sidecar-dir>",
+              file=sys.stderr)
+        return 2
     metrics, meta = _snapshot.load_metrics(args.path)
     print(f"# source: {meta.get('source')} ({args.path})")
     if not metrics:
@@ -158,8 +171,16 @@ def build_parser() -> argparse.ArgumentParser:
     rp = sub.add_parser(
         "report", help="summarize a telemetry artifact (metrics + quantiles)"
     )
-    rp.add_argument("path", help="snapshot / BENCH_r*.json / bench stdout "
-                                 "/ metrics JSONL")
+    rp.add_argument("path", nargs="?",
+                    help="snapshot / BENCH_r*.json / bench stdout "
+                         "/ metrics JSONL")
+    rp.add_argument("--profile", metavar="SIDECAR_DIR",
+                    help="render plan-profile sidecars instead: top-N "
+                         "slowest recorded stages + per-strategy "
+                         "observed-wall tables")
+    rp.add_argument("--top", type=int, default=10,
+                    help="with --profile: how many stages (default "
+                         "%(default)s)")
     rp.set_defaults(fn=_cmd_report)
 
     mp = sub.add_parser(

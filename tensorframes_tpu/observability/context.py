@@ -41,6 +41,13 @@ __all__ = [
     "bind",
     "snapshot",
     "child_env",
+    "TRACE_HEADER",
+    "bind_request",
+    "clear_request",
+    "current_request",
+    "request_scope",
+    "trace_header_value",
+    "parse_trace_header",
 ]
 
 _lock = threading.Lock()
@@ -156,6 +163,76 @@ def child_env(index: Optional[int] = None) -> Dict[str, str]:
     return env
 
 
+# ---------------------------------------------------------------------------
+# cross-hop request tracing (ISSUE 17): one request id from Router
+# ingress through a remote replica's batcher flush
+# ---------------------------------------------------------------------------
+
+#: HTTP header carrying ``<request_id>;run=<run_id>`` across the
+#: Router → replica hop. The request id is the Router's idempotency key
+#: — STABLE across a redrive, so a redriven request still shows as one
+#: id in the merged timeline.
+TRACE_HEADER = "X-Tftpu-Trace"
+
+_request_tls = threading.local()
+
+
+def bind_request(request_id: Optional[str]) -> None:
+    """Bind the current thread's request id (None unbinds). The serving
+    layer binds at submit/dispatch and stamps the id into every trace
+    span it emits on this thread; batcher/decode threads carry it via
+    the explicit per-request slots instead (one flush serves many
+    requests — a thread-local could only name one)."""
+    _request_tls.request_id = request_id or None
+
+
+def clear_request() -> None:
+    _request_tls.request_id = None
+
+
+def current_request() -> Optional[str]:
+    """The request id bound to this thread, or None."""
+    return getattr(_request_tls, "request_id", None)
+
+
+class request_scope:
+    """``with request_scope(rid):`` — bind/restore around one request's
+    handling on this thread (exception-safe)."""
+
+    def __init__(self, request_id: Optional[str]):
+        self._rid = request_id
+
+    def __enter__(self):
+        self._prev = current_request()
+        bind_request(self._rid)
+        return self._rid
+
+    def __exit__(self, *exc):
+        bind_request(self._prev)
+        return False
+
+
+def trace_header_value(request_id: str) -> str:
+    """Serialize the trace context the Router stamps onto the hop."""
+    return f"{request_id};run={run_id()}"
+
+
+def parse_trace_header(value: Optional[str]):
+    """``(request_id, run_id)`` from a received header value; both None
+    when the header is absent/garbled (tracing degrades to per-process
+    timelines, never to an error — telemetry must not fail a request)."""
+    if not value or not isinstance(value, str) or len(value) > 256:
+        return None, None
+    head, _, rest = value.partition(";")
+    rid = head.strip() or None
+    run = None
+    for part in rest.split(";"):
+        k, _, v = part.partition("=")
+        if k.strip() == "run" and v.strip():
+            run = v.strip()
+    return rid, run
+
+
 def _reset_for_tests() -> None:
     """Forget bound/minted context (test hygiene only)."""
     global _run_id, _process_index, _num_processes
@@ -163,6 +240,7 @@ def _reset_for_tests() -> None:
         _run_id = None
         _process_index = None
         _num_processes = None
+    clear_request()
 
 
 def _after_fork_in_child() -> None:

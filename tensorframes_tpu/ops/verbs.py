@@ -556,9 +556,11 @@ def _ragged_gather_plan(cols, input_names, n, program, group_list):
     if len({c.dtype for c in cells}) != 1:
         return None
     from ..plan import rules as _prules
+    from ..plan import stats as _pstats
 
     decision = _prules.decide_ragged_gather(
-        n, len(group_list), cells[0].dtype
+        n, len(group_list), cells[0].dtype,
+        observed_walls=_pstats.strategy_walls("ragged_gather"),
     )
     if decision is None:
         return None
@@ -676,8 +678,11 @@ def _ragged_rows_outs(
     from collections import deque as _deque
 
     outs_list: List[Dict[str, np.ndarray]] = []
+    from ..plan.lower import observe_strategy_wall as _obs_wall
+
     for wave in waves:
         if gather is not None:
+            t_stage = time.perf_counter()
             try:
                 # padded batches materialize ON DEVICE (one flat
                 # buffer moved once, above); rows already bucket-padded
@@ -700,8 +705,21 @@ def _ragged_rows_outs(
                 staged = jax.device_put(
                     [group_feeds(idx) for idx in wave]
                 )
+            else:
+                _obs_wall(
+                    "ragged_gather", "pallas_ragged_gather",
+                    time.perf_counter() - t_stage,
+                )
         else:
+            t_stage = time.perf_counter()
             staged = jax.device_put([group_feeds(idx) for idx in wave])
+            if len(input_names) == 1:
+                # only the single-ragged-column case competes with the
+                # pallas gather — keep the wall table apples-to-apples
+                _obs_wall(
+                    "ragged_gather", "host_stack",
+                    time.perf_counter() - t_stage,
+                )
         in_flight_r: _deque = _deque()
         for f in staged:
             # freshly-transferred private copies: donation-safe
@@ -1153,20 +1171,32 @@ def _segment_reduce_best(ops_key, num_groups, val_cols, seg_ids):
     (fused-cache invalidation included) and falls through to the
     jitted scatter — the PR 7 recovery contract."""
     from . import segment as _segment
-    from ..plan.lower import _note_decision
+    from ..plan import stats as _pstats
+    from ..plan.lower import _note_decision, _note_flip, observe_strategy_wall
     from ..plan.rules import decide_segment_reduce
 
-    decision = decide_segment_reduce(ops_key, val_cols, num_groups)
+    decision = decide_segment_reduce(
+        ops_key, val_cols, num_groups,
+        observed_walls=_pstats.strategy_walls("segment_reduce"),
+    )
     _note_decision(decision)
+    _note_flip(decision)
     if decision.kind == "host_segment_reduce":
-        return _segment.segment_reduce_host(
+        t0 = time.perf_counter()
+        out = _segment.segment_reduce_host(
             ops_key, num_groups, val_cols, seg_ids
         )
+        observe_strategy_wall(
+            "segment_reduce", "host_segment_reduce",
+            time.perf_counter() - t0,
+        )
+        return out
     if decision.kind == "pallas_segment_reduce":
         from ..kernels import segment_reduce as _ksr
 
+        t0 = time.perf_counter()
         try:
-            return _ksr.segment_reduce_pallas(
+            out = _ksr.segment_reduce_pallas(
                 ops_key, num_groups, val_cols, seg_ids
             )
         except Exception as e:
@@ -1178,13 +1208,24 @@ def _segment_reduce_best(ops_key, num_groups, val_cols, seg_ids):
                 f"{type(e).__name__} in segment-reduce kernel"
             )
             _ksr._pallas_fn_for.cache_clear()
+        else:
+            observe_strategy_wall(
+                "segment_reduce", "pallas_segment_reduce",
+                time.perf_counter() - t0,
+            )
+            return out
+    t0 = time.perf_counter()
     seg_vals = {x: jnp.asarray(val_cols[x]) for x, _ in ops_key}
     # int32 ids: halves the host→HBM id-column transfer (the hot cost
     # on relay-attached chips); group counts can't exceed int32 — the
     # id space is bounded by row count long before 2^31
     sids = jnp.asarray(np.asarray(seg_ids).astype(np.int32))
     res = run_segment_fast(ops_key, num_groups, seg_vals, sids)
-    return {x: np.asarray(res[x]) for x, _ in ops_key}
+    out = {x: np.asarray(res[x]) for x, _ in ops_key}
+    observe_strategy_wall(
+        "segment_reduce", "jit_segment_reduce", time.perf_counter() - t0
+    )
+    return out
 
 
 def run_segment_fast(ops_key, num_groups, seg_vals, sids):

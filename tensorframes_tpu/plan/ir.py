@@ -396,19 +396,31 @@ def chain_barriers(frame):
     return len(maps), barriers
 
 
-def explain_plan(frame) -> str:
+def explain_plan(frame, analyze: bool = False) -> str:
     """Render a frame's logical plan, one node per line (source first).
-    Frames without a plan render as a single ``source`` line."""
+    Frames without a plan render as a single ``source`` line. With
+    ``analyze=True`` (EXPLAIN ANALYZE, ISSUE 17) the tree is followed
+    by the per-stage profile the plan's last execution recorded into
+    the stats sidecar — wall, rows, bytes, chosen strategy, compile
+    split — plus observed join selectivities, pushdown history, and
+    TFG-diagnostic cross-references (rendered by
+    ``observability/profile.py``)."""
     node = getattr(frame, "_plan", None)
     if node is None:
         state = "materialized" if frame.is_materialized else "lazy"
-        return f"source ({state}, {len(frame.schema.names)} column(s))"
-    source, nodes = resolve_chain(node)
-    lines = [
-        "source ("
-        + ("materialized" if source.is_materialized else "lazy")
-        + f", columns={list(source.schema.names)})"
-    ]
-    for n in nodes:
-        lines.append(f"  -> {n!r}")
+        lines = [f"source ({state}, {len(frame.schema.names)} column(s))"]
+    else:
+        source, nodes = resolve_chain(node)
+        lines = [
+            "source ("
+            + ("materialized" if source.is_materialized else "lazy")
+            + f", columns={list(source.schema.names)})"
+        ]
+        for n in nodes:
+            lines.append(f"  -> {n!r}")
+    if analyze:
+        from ..observability import profile as _profile
+
+        lines.append("")
+        lines.extend(_profile.profile_lines(frame))
     return "\n".join(lines)

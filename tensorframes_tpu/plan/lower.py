@@ -158,6 +158,134 @@ def _lowering_seconds_mean() -> Optional[float]:
         pass
     return None
 
+
+# -- per-stage / per-strategy wall observation (ISSUE 17) -------------------
+# EXPLAIN ANALYZE needs every executed plan stage to leave a profile
+# entry (wall, rows, bytes, strategy, compile-vs-run split), and the
+# latency-driven decide_* feedback needs every strategy dispatch to
+# land in the stats sidecar's EWMA table. Both series pre-register at
+# import with CLOSED label sets (TFL003): stage kinds here, strategy
+# kinds as the decide_* kinds they mirror.
+
+#: Closed stage-kind set for tftpu_plan_stage_wall_seconds.
+_STAGE_KINDS = (
+    "fused", "per_stage", "join", "join_chain", "aggregate",
+    "pushdown", "reduce",
+)
+_STAGE_WALL = {
+    s: _histogram(
+        "tftpu_plan_stage_wall_seconds",
+        "Observed wall-clock of one executed plan stage, by stage kind "
+        "(the metric shadow of the EXPLAIN ANALYZE per-stage profile)",
+        labels={"stage": s},
+    )
+    for s in _STAGE_KINDS
+}
+
+#: Closed (decision, strategy) pairs for tftpu_plan_strategy_wall_seconds.
+_STRATEGY_WALL_PAIRS = (
+    ("fuse", "fuse"), ("fuse", "split_single_stage"),
+    ("epilogue", "epilogue_per_block"), ("epilogue", "epilogue_concat"),
+    ("segment_reduce", "host_segment_reduce"),
+    ("segment_reduce", "pallas_segment_reduce"),
+    ("segment_reduce", "jit_segment_reduce"),
+    ("ragged_gather", "pallas_ragged_gather"),
+    ("ragged_gather", "host_stack"),
+    ("decode_attention", "pallas_decode_attn"),
+    ("decode_attention", "xla_decode_attn"),
+)
+_STRATEGY_WALL = {
+    pair: _histogram(
+        "tftpu_plan_strategy_wall_seconds",
+        "Observed wall-clock of one strategy's dispatch, by (decision, "
+        "strategy) — the histogram shadow of the EWMA table that feeds "
+        "latency-driven plan decisions",
+        labels={"decision": pair[0], "strategy": pair[1]},
+    )
+    for pair in _STRATEGY_WALL_PAIRS
+}
+
+
+#: Decisions whose strategies include a pallas kernel: their walls are
+#: unrepresentative under TFTPU_PALLAS_FORCE (the CPU interpreter runs
+#: the kernel orders of magnitude slower than any real backend), so
+#: forced runs must not feed the EWMA table a later unforced run (or a
+#: sidecar-sharing real run) would act on.
+_KERNEL_DECISIONS = ("segment_reduce", "ragged_gather", "decode_attention")
+
+
+def observe_strategy_wall(decision: str, strategy: str,
+                          wall_s: float) -> None:
+    """Record one observed strategy dispatch wall: the pre-registered
+    histogram plus the stats sidecar's per-(decision, strategy) EWMA
+    table — the feedback input the decide_* functions consult."""
+    h = _STRATEGY_WALL.get((decision, strategy))
+    if h is not None:
+        h.observe(wall_s)
+    if decision in _KERNEL_DECISIONS:
+        from .. import kernels as _kernels
+
+        if _kernels.force_active():
+            return
+    _stats.observe_strategy_wall(decision, strategy, wall_s)
+
+
+# Per-force profile collector: execute_plan / execute_aggregate push a
+# frame, every executed stage notes itself into the topmost frame, and
+# the force records the popped entries into the stats sidecar under its
+# plan fingerprint. A STACK (not a single slot) because forces nest —
+# gathering a join's build side forces an independent pipeline whose
+# stages belong to ITS fingerprint, not the outer one (and whose wall
+# the outer profile sees only through its own join stage entry).
+_PROFILE_TLS = threading.local()
+
+
+def _profile_push() -> list:
+    stack = getattr(_PROFILE_TLS, "stack", None)
+    if stack is None:
+        stack = _PROFILE_TLS.stack = []
+    frame: list = []
+    stack.append(frame)
+    return frame
+
+
+def _profile_pop(frame: list) -> Optional[list]:
+    """Detach ``frame`` from the stack (idempotent — record sites pop
+    first, the owner's finally pops again harmlessly)."""
+    stack = getattr(_PROFILE_TLS, "stack", None)
+    if stack is None:
+        return None
+    try:
+        stack.remove(frame)
+    except ValueError:
+        return None
+    return frame
+
+
+def _profile_note(stage: str, wall_s: float, *, rows: Optional[int] = None,
+                  nbytes: Optional[int] = None,
+                  strategy: Optional[str] = None,
+                  compile_s: Optional[float] = None) -> None:
+    """One executed stage's profile entry: always observed on the
+    pre-registered stage-wall histogram, appended to the active force's
+    collector when one is open."""
+    h = _STAGE_WALL.get(stage)
+    if h is not None:
+        h.observe(wall_s)
+    stack = getattr(_PROFILE_TLS, "stack", None)
+    if not stack:
+        return
+    entry: Dict[str, object] = {"stage": stage, "wall_s": float(wall_s)}
+    if rows is not None:
+        entry["rows"] = int(rows)
+    if nbytes is not None:
+        entry["bytes"] = int(nbytes)
+    if strategy is not None:
+        entry["strategy"] = strategy
+    if compile_s is not None:
+        entry["compile_s"] = float(compile_s)
+    stack[-1].append(entry)
+
 # fused-Program cache: steady-state loops rebuild chains from the same
 # stage Programs every iteration; re-composing (and re-jitting) per
 # force would throw the executable away each time. Keyed by stage
@@ -371,7 +499,7 @@ def _run_fused(source, plan: SegmentPlan):
     """One dispatch per block: compose, hand to map_blocks (jit cache /
     donation / prefetch / sharded paths unchanged), re-key to the
     segment's result columns, apply the filter mask if present."""
-    from ..frame import TensorFrame
+    from ..frame import TensorFrame, _block_num_rows
     from ..ops.verbs import map_blocks
 
     t0 = time.perf_counter()
@@ -398,7 +526,6 @@ def _run_fused(source, plan: SegmentPlan):
         ]
         # same observability contract as the legacy filter: one span,
         # INPUT-rows convention (mask compute + gather wall-clock)
-        from ..frame import _block_num_rows
         from ..utils import profiling
 
         profiling.record(
@@ -408,7 +535,13 @@ def _run_fused(source, plan: SegmentPlan):
     else:
         out_blocks = [{n: b[n] for n in keep} for b in blocks]
     _FUSED_STAGES.inc(len(plan.included))
-    _BYTES_AVOIDED.inc(_avoided_bytes(plan, blocks))
+    avoided = _avoided_bytes(plan, blocks)
+    _BYTES_AVOIDED.inc(avoided)
+    _profile_note(
+        "fused", time.perf_counter() - t0,
+        rows=sum(_block_num_rows(b) for b in blocks),
+        nbytes=avoided, strategy="fuse", compile_s=lower_dt,
+    )
     result = TensorFrame(
         out_blocks, plan.nodes[-1].schema.select(keep)
     )
@@ -421,9 +554,10 @@ def _run_fused(source, plan: SegmentPlan):
 def _run_per_stage(source, plan: SegmentPlan):
     """Exact single-verb execution of the segment's nodes (the honest
     fallback: barriers split the plan, they never change semantics)."""
-    from ..frame import TensorFrame
+    from ..frame import TensorFrame, _block_num_rows
     from ..ops.verbs import map_blocks, map_rows
 
+    t_seg0 = time.perf_counter()
     cur = source
     for n in plan.nodes:
         if n.kind == "map":
@@ -431,7 +565,6 @@ def _run_per_stage(source, plan: SegmentPlan):
         elif n.kind == "select":
             cur = cur.select(list(n.names))
         elif n.kind == "filter":
-            from ..frame import _block_num_rows
             from ..utils import profiling
 
             names = list(n.schema.names)
@@ -448,7 +581,12 @@ def _run_per_stage(source, plan: SegmentPlan):
     keep = list(plan.final_names)
     if list(cur.schema.names) != keep:
         cur = _pruned_source(cur, keep)
-    cur.blocks()
+    blocks = cur.blocks()
+    _profile_note(
+        "per_stage", time.perf_counter() - t_seg0,
+        rows=sum(_block_num_rows(b) for b in blocks),
+        strategy="split_single_stage",
+    )
     return cur
 
 
@@ -498,11 +636,13 @@ def _run_join(cur, plan: SegmentPlan, rcols: Optional[Dict] = None):
     keep = list(plan.join_out_names)
     out = {n: out[n] for n in keep}
     # same observability contract as the eager join span: INPUT rows
-    profiling.record(
-        "join", time.perf_counter() - t0,
-        _block_num_rows(lcols) + _block_num_rows(rcols),
-    )
+    rows_in = _block_num_rows(lcols) + _block_num_rows(rcols)
+    profiling.record("join", time.perf_counter() - t0, rows_in)
     _FUSED_EPILOGUES["join"].inc()
+    _profile_note(
+        "join", time.perf_counter() - t0, rows=rows_in,
+        strategy="hash_join",
+    )
     return TensorFrame([out], jn.schema.select(keep))
 
 
@@ -565,6 +705,19 @@ def _note_reoptimized(why: str, details: Dict[str, object]) -> None:
     """Count + trace one stats-informed (feedback) decision — the
     ``reoptimized`` series the acceptance criteria key on."""
     _note_decision(_rules.Decision("reoptimized", why, details))
+
+
+def _note_flip(decision: "_rules.Decision") -> None:
+    """When a decide_* choice flipped on observed strategy walls (the
+    evidence rides ``details["latency_flip"]``), count it as a
+    ``reoptimized`` decision too — same contract as join reordering."""
+    if decision.details.get("latency_flip"):
+        _note_reoptimized(
+            "strategy chosen from observed per-strategy walls "
+            "(stats sidecar latency table) instead of the static rule",
+            {"decision": decision.kind,
+             "observed_wall_s": decision.details.get("observed_wall_s")},
+        )
 
 
 def _sequential_joins(cur, jplans: List[SegmentPlan], rights):
@@ -671,6 +824,10 @@ def _run_join_chain(cur, jplans: List[SegmentPlan], fusion_on: bool,
             rows_in + build_rows[idx],
         )
         _FUSED_EPILOGUES["join"].inc()
+        _profile_note(
+            "join_chain", time.perf_counter() - t_j,
+            rows=rows_in + build_rows[idx], strategy="reordered_join",
+        )
         obs_joins[_join_stat_key(idx, lev["keys"])] = {
             "build_rows": int(build_rows[idx]),
             "row_sel": round(rows_out / rows_in, 6) if rows_in else 1.0,
@@ -681,22 +838,6 @@ def _run_join_chain(cur, jplans: List[SegmentPlan], fusion_on: bool,
     keep = list(last.join_out_names)
     out = {n: lcols[n] for n in keep}
     return TensorFrame([out], last.join_node.schema.select(keep))
-
-
-def _has_join_run(plans: Sequence[SegmentPlan]) -> bool:
-    """True when ``plans`` contains a run ``_execute_plans`` would hand
-    to the reordering path (>= 2 consecutive join segments, the later
-    ones bare) — the only execute_plan shape that consults stats, so
-    single-join pipelines skip the fingerprint work entirely."""
-    for i in range(len(plans) - 1):
-        if (
-            plans[i].has_join
-            and plans[i + 1].has_join
-            and not plans[i + 1].included
-            and not plans[i + 1].has_filter
-        ):
-            return True
-    return False
 
 
 def _execute_plans(cur, plans: Sequence[SegmentPlan], fusion_on: bool,
@@ -768,13 +909,25 @@ def _run_one_segment(cur, plan: SegmentPlan, fusion_on: bool):
         fused_ok, reason = False, "host_callback"
     if fused_ok and _segment_ragged(cur, plan.source_inputs):
         fused_ok, reason = False, "ragged"
+    timed_choice = False
     if reason is None:
         # the cost model speaks only when no hard barrier already
-        # decided; its fuse/split choice is counted + traced
-        decision = _rules.decide_fuse(plan, _lowering_seconds_mean())
+        # decided; its fuse/split choice is counted + traced. A fusable
+        # segment is a REAL choice (both strategies are bit-identical),
+        # so its dispatch wall feeds the latency table and observed
+        # walls may flip it back to the per-stage replay.
+        timed_choice = plan.fusable
+        decision = _rules.decide_fuse(
+            plan, _lowering_seconds_mean(),
+            observed_walls=(
+                _stats.strategy_walls("fuse") if timed_choice else None
+            ),
+        )
         _note_decision(decision)
+        _note_flip(decision)
         fused_ok = decision.kind == "fuse"
     if fused_ok:
+        t_strat = time.perf_counter()
         try:
             cur = _run_fused(cur, plan)
         except Exception as e:
@@ -786,12 +939,23 @@ def _run_one_segment(cur, plan: SegmentPlan, fusion_on: bool):
                          "per-stage: %s", e)
             _FALLBACKS["trace_error"].inc()
             cur = _run_per_stage(cur, plan)
+        else:
+            if timed_choice:
+                observe_strategy_wall(
+                    "fuse", "fuse", time.perf_counter() - t_strat
+                )
     else:
         if reason is not None:
             _FALLBACKS[reason].inc()
         elif len(plan.included) <= 1:
             _FALLBACKS["single_stage"].inc()
+        t_strat = time.perf_counter()
         cur = _run_per_stage(cur, plan)
+        if timed_choice:
+            observe_strategy_wall(
+                "fuse", "split_single_stage",
+                time.perf_counter() - t_strat,
+            )
     return _run_join(cur, plan) if plan.has_join else cur
 
 
@@ -815,17 +979,40 @@ def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
     # out — it must rule it out for already-built frames as well)
     fusion_on = bool(get_config().plan_fusion)
     fp = None
-    if fusion_on and _stats.reopt_enabled() and _has_join_run(plans):
+    if fusion_on and _stats.reopt_enabled():
+        # every adaptive execution fingerprints now (not just join
+        # runs): the per-stage profile EXPLAIN ANALYZE reads back is
+        # keyed here, and the hash is a few node signatures — cheap
+        # next to any dispatch
         fp = _stats.chain_fingerprint(source, nodes)
+        # the frame drops its plan chain at force time (buffer-pinning
+        # discipline), so EXPLAIN ANALYZE needs the fingerprint stashed
+        # on the frame itself to find this execution's profile later
+        f_res = node.frame()
+        if f_res is not None:
+            try:
+                f_res._plan_fp = fp
+            except AttributeError:  # pragma: no cover - exotic frames
+                pass
+    prof = _profile_push() if fp else None
     t_exec = time.perf_counter()
-    with ir.lowering():
-        cur = _execute_plans(source, plans, fusion_on, fp)
+    try:
+        with ir.lowering():
+            cur = _execute_plans(source, plans, fusion_on, fp)
+        out = [{n: b[n] for n in final_names} for b in cur.blocks()]
+    finally:
+        entries = _profile_pop(prof) if prof is not None else None
+    wall = time.perf_counter() - t_exec
+    if fp:
+        _stats.record_execution(fp, wall_s=wall, profile=entries)
     if _events.TRACER.enabled:
+        args = {"segments": len(plans)}
+        if fp:
+            args["fp"] = fp
         _events.TRACER.emit_complete(
-            "plan.execute", t_exec, time.perf_counter() - t_exec,
-            args={"segments": len(plans)}, cat="plan",
+            "plan.execute", t_exec, wall, args=args, cat="plan",
         )
-    return [{n: b[n] for n in final_names} for b in cur.blocks()]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -997,6 +1184,23 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
     model), or fall back honestly — the per-stage chain replay plus the
     eager host aggregate, counted by reason. The mapped value columns
     are never host-materialized on any fused path."""
+    from ..config import get_config
+
+    adaptive = bool(get_config().plan_fusion) and _stats.reopt_enabled()
+    prof = _profile_push() if adaptive else None
+    try:
+        return _execute_aggregate(node, prof)
+    finally:
+        if prof is not None:
+            _profile_pop(prof)
+
+
+def _execute_aggregate(
+    node: ir.PlanNode, prof: Optional[list]
+) -> List[Dict[str, object]]:
+    """``execute_aggregate``'s body. The wrapper owns the profile
+    frame; the record sites here pop it (idempotently) so the per-stage
+    profile lands in the same sidecar write as the aggregate's stats."""
     import jax.numpy as jnp
 
     from ..config import get_config
@@ -1054,6 +1258,13 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
         plans = _plan_segments(source, inner, need)
         adaptive = fusion_on and _stats.reopt_enabled()
         fp = _stats.chain_fingerprint(source, nodes) if adaptive else None
+        if fp:
+            f_fp = node.frame()
+            if f_fp is not None:
+                try:
+                    f_fp._plan_fp = fp
+                except AttributeError:  # pragma: no cover
+                    pass
 
         # ---- aggregate pushdown below a trailing join chain (the
         # ISSUE 14 rewrite): eligible shapes run the partial aggregate
@@ -1091,7 +1302,7 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
                     )
                     blocks = _pushdown_aggregate(
                         mid_p, plans, push, node, seg_info, fusion_on,
-                        fp, decision, t_exec,
+                        fp, decision, t_exec, prof,
                     )
                     if blocks is not None:
                         return blocks
@@ -1174,8 +1385,10 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
         decision = _rules.decide_epilogue(
             ops_and_dtypes, num_groups,
             _epilogue_value_bytes(last, pruned.schema, seg_info, n_total),
+            observed_walls=_stats.strategy_walls("epilogue"),
         )
         _note_decision(decision)
+        _note_flip(decision)
         k_eff, bucket_dec = _rules.decide_segment_bucket(
             ops_key, num_groups
         )
@@ -1184,12 +1397,14 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
 
         from ..ops.executor import gather_feeds
 
+        lower_dt = 0.0
         try:
             if decision.kind == "epilogue_per_block":
                 fused = _fused_agg_program(
                     last, pruned.schema, seg_info, k_eff
                 )
-                _LOWER_SECONDS.observe(time.perf_counter() - t0)
+                lower_dt = time.perf_counter() - t0
+                _LOWER_SECONDS.observe(lower_dt)
                 compiled = fused.compiled()
                 base_ins = [
                     s.name for s in fused.inputs
@@ -1237,7 +1452,8 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
                 parts: Dict[str, list] = {x: [] for x, _, _ in seg_info}
                 if last.included:
                     fused_map = _fused_program(last, pruned.schema)
-                    _LOWER_SECONDS.observe(time.perf_counter() - t0)
+                    lower_dt = time.perf_counter() - t0
+                    _LOWER_SECONDS.observe(lower_dt)
                     compiled = fused_map.compiled()
                     for b, nb in zip(blocks, rows):
                         if nb == 0:
@@ -1298,10 +1514,17 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
     block = dict(zip(keys, group_key_cols))
     block.update({x: out_cols[x] for x in out_names})
     profiling.record("aggregate", time.perf_counter() - t_exec, n_total)
+    ep_wall = time.perf_counter() - t0
+    observe_strategy_wall("epilogue", decision.kind, ep_wall)
+    _profile_note(
+        "aggregate", ep_wall, rows=n_total, strategy=decision.kind,
+        compile_s=lower_dt,
+    )
     if fp:
         _stats.record_execution(
             fp, agg={"num_groups": int(num_groups)},
             wall_s=time.perf_counter() - t_exec,
+            profile=_profile_pop(prof) if prof is not None else None,
         )
     if _events.TRACER.enabled:
         _events.TRACER.emit_complete(
@@ -1315,6 +1538,7 @@ def execute_aggregate(node: ir.PlanNode) -> List[Dict[str, object]]:
 def _pushdown_aggregate(
     mid, plans: Sequence[SegmentPlan], push, node, seg_info,
     fusion_on: bool, fp: Optional[str], decision, t_exec: float,
+    prof: Optional[list] = None,
 ) -> Optional[List[Dict[str, object]]]:
     """Execute an eligible aggregate-below-join rewrite: the partial
     aggregate runs over the pushed side's full row set (maps fused, one
@@ -1521,6 +1745,10 @@ def _pushdown_aggregate(
         "base_rows": n_base,
         "survival": round(survival, 4),
     }))
+    _profile_note(
+        "pushdown", time.perf_counter() - t_exec, rows=n_base,
+        strategy="pushdown_below_join",
+    )
     if fp:
         _stats.record_execution(
             fp,
@@ -1528,6 +1756,7 @@ def _pushdown_aggregate(
                   "levels": len(push.levels)},
             agg={"num_groups": int(num_groups)},
             wall_s=time.perf_counter() - t_exec,
+            profile=_profile_pop(prof) if prof is not None else None,
         )
     if not mask.any():
         profiling.record(
@@ -1738,6 +1967,11 @@ def lower_reduce(
         return None  # all-empty frame: the eager path owns the error
     _FUSED_STAGES.inc(len(plan.included))
     _FUSED_EPILOGUES["reduce_" + mode].inc()
+    _profile_note(
+        "reduce", time.perf_counter() - t0, rows=n_rows,
+        strategy="fused_" + mode,
+        compile_s=None,
+    )
     avoided = [
         (o.name, o)
         for n in plan.included for o in (n.program.outputs or [])

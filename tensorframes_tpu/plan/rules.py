@@ -40,6 +40,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .ir import PlanNode, program_has_callback
+from .stats import STRATEGY_WALL_MIN_SAMPLES
 
 __all__ = [
     "SegmentPlan",
@@ -61,6 +62,8 @@ __all__ = [
     "decide_join_order",
     "warm_segment_bucket",
     "PUSHDOWN_MIN_SURVIVAL",
+    "LATENCY_FLIP_MARGIN",
+    "pick_by_observed_wall",
 ]
 
 
@@ -278,6 +281,58 @@ class Decision:
     details: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
+#: An observed-wall flip engages only when the alternative's EWMA beats
+#: the static choice's by at least this factor — hysteresis against
+#: noisy walls oscillating the strategy (and retracing) every force.
+LATENCY_FLIP_MARGIN = 0.8
+
+
+def pick_by_observed_wall(
+    static_kind: str,
+    alternatives: Sequence[str],
+    observed_walls: Optional[Dict[str, dict]],
+) -> Optional[Tuple[str, Dict[str, object]]]:
+    """The latency-feedback core shared by every ``decide_*``: given the
+    statically-preferred strategy, the alternatives the CALLER verified
+    are eligible AND bit-identical for this workload, and the observed
+    per-strategy wall table (:func:`..stats.strategy_walls`), pick the
+    observed-fastest alternative when it beats the static choice's EWMA
+    by :data:`LATENCY_FLIP_MARGIN` with enough samples on both sides.
+    Returns ``(flipped_kind, evidence_details)`` or None (keep static).
+    """
+    if not observed_walls:
+        return None
+    cur = observed_walls.get(static_kind)
+    if not cur or int(cur.get("n", 0)) < STRATEGY_WALL_MIN_SAMPLES:
+        return None
+    cur_w = float(cur.get("ewma_s", 0.0))
+    best: Optional[Tuple[str, float]] = None
+    for alt in alternatives:
+        if alt == static_kind:
+            continue
+        ent = observed_walls.get(alt)
+        if not ent or int(ent.get("n", 0)) < STRATEGY_WALL_MIN_SAMPLES:
+            continue
+        w = float(ent.get("ewma_s", 0.0))
+        if w < cur_w * LATENCY_FLIP_MARGIN and (
+            best is None or w < best[1]
+        ):
+            best = (alt, w)
+    if best is None:
+        return None
+    alt, w = best
+    return alt, {
+        "latency_flip": True,
+        "observed_wall_s": {
+            static_kind: round(cur_w, 6), alt: round(w, 6),
+        },
+        "wall_samples": {
+            static_kind: int(cur.get("n", 0)),
+            alt: int(observed_walls[alt].get("n", 0)),
+        },
+    }
+
+
 def _stage_costs(plan: SegmentPlan) -> Dict[str, float]:
     """Summed memoized cost_analysis over the segment's included stages
     (zero when a backend reports no costs, as some CPU builds do) —
@@ -295,7 +350,8 @@ def _stage_costs(plan: SegmentPlan) -> Dict[str, float]:
 
 
 def decide_fuse(
-    plan: SegmentPlan, lowering_seconds_mean: Optional[float] = None
+    plan: SegmentPlan, lowering_seconds_mean: Optional[float] = None,
+    observed_walls: Optional[Dict[str, dict]] = None,
 ) -> Decision:
     """Fuse-vs-split for one map segment. Composition is essentially
     always a win once two stages (or a mask/pruning select) are in
@@ -304,12 +360,31 @@ def decide_fuse(
     ELIDED STAGE, while the composed program's cost is the sum of its
     parts (XLA re-fuses the elementwise chain). A bare single map keeps
     the single-verb path — fusing it buys nothing and would bypass the
-    specialized lead-dim bucketing."""
+    specialized lead-dim bucketing.
+
+    ``observed_walls`` (the stats sidecar's per-strategy EWMA table)
+    can flip a fusable segment BACK to the per-stage replay when the
+    measured per-stage wall beats the fused wall — the replay is the
+    TFTPU_FUSION=0 path, bit-identical by the core contract, so the
+    flip is always safe."""
     details = _stage_costs(plan)
     details["stages"] = len(plan.included)
     if lowering_seconds_mean is not None:
         details["lowering_seconds_mean"] = round(lowering_seconds_mean, 6)
     if plan.fusable:
+        flip = pick_by_observed_wall(
+            "fuse", ("split_single_stage",), observed_walls
+        )
+        if flip is not None:
+            kind, evidence = flip
+            details.update(evidence)
+            return Decision(
+                kind,
+                "observed walls: the per-stage replay runs faster than "
+                "the fused dispatch for this workload (bit-identical — "
+                "it IS the TFTPU_FUSION=0 path)",
+                details,
+            )
         why = (
             f"{len(plan.included)} composable stage(s)"
             + (", mask fuses upstream" if plan.has_filter else "")
@@ -349,6 +424,7 @@ def decide_epilogue(
     ops_and_dtypes: Sequence[Tuple[str, object]],
     num_groups: int,
     value_bytes: float,
+    observed_walls: Optional[Dict[str, dict]] = None,
 ) -> Decision:
     """Aggregate-epilogue strategy for a fused map→aggregate segment.
 
@@ -363,6 +439,12 @@ def decide_epilogue(
       same values in the same row order — bit-identical by
       construction, at the cost of holding the mapped columns in
       device memory once).
+
+    When every op is reassociation-safe BOTH strategies are exact, so
+    the choice is pure latency: ``observed_walls`` (the stats
+    sidecar's per-strategy EWMA table) flips per_block → concat when
+    the concat epilogue measured faster. Unsafe ops always take concat
+    (correctness, never overridden).
     """
     unsafe = [
         (op, str(getattr(dt, "name", dt)))
@@ -375,6 +457,20 @@ def decide_epilogue(
         "ops": [op for op, _ in ops_and_dtypes],
     }
     if not unsafe:
+        flip = pick_by_observed_wall(
+            "epilogue_per_block", ("epilogue_concat",), observed_walls
+        )
+        if flip is not None:
+            kind, evidence = flip
+            details.update(evidence)
+            return Decision(
+                kind,
+                "observed walls: the concat epilogue runs faster than "
+                "per-block partial tables for this workload (both are "
+                "exact for reassociation-safe ops — bit-identical "
+                "either way)",
+                details,
+            )
         return Decision(
             "epilogue_per_block",
             "all ops tree-combine exactly (min/max or integer sums): "
@@ -414,7 +510,20 @@ def _kernel_backend_ok() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def decide_segment_reduce(ops_key, val_cols, num_segments: int) -> Decision:
+def _force_pins_kernels() -> bool:
+    """Under ``TFTPU_PALLAS_FORCE`` the kernel lowering is pinned by the
+    test/bench hook: latency flips must not engage (the hook exists to
+    exercise a SPECIFIC lowering) and interpreted-kernel walls are not
+    representative of any real backend anyway."""
+    from .. import kernels
+
+    return kernels.force_active()
+
+
+def decide_segment_reduce(
+    ops_key, val_cols, num_segments: int,
+    observed_walls: Optional[Dict[str, dict]] = None,
+) -> Decision:
     """Keyed-reduction strategy for one segment: ``host_segment_reduce``
     (CPU bincount — the measured XLA:CPU-scatter escape, unchanged),
     ``pallas_segment_reduce`` (the fused multi-op kernel,
@@ -422,7 +531,15 @@ def decide_segment_reduce(ops_key, val_cols, num_segments: int) -> Decision:
     jitted scatter program). Order matters: the host path keeps CPU
     float sums (its f64 accumulation is the tighter bound and bincount
     beats interpreted pallas by orders of magnitude); the kernel takes
-    whatever remains eligible on a kernel-capable backend."""
+    whatever remains eligible on a kernel-capable backend.
+
+    ``observed_walls`` may flip the static choice to an eligible
+    alternative that measured faster — but ONLY when every (op, value
+    dtype) is :func:`reassoc_safe` (min/max, integer sums): those
+    reduce to the same bits under every strategy, so the flip cannot
+    move results. Float sums pin their statically-chosen strategy (the
+    host path's f64 accumulation is not bit-identical to the scatter
+    program's)."""
     from ..kernels import segment_reduce as _ksr
     from ..ops.segment import host_segment_eligible
 
@@ -430,42 +547,83 @@ def decide_segment_reduce(ops_key, val_cols, num_segments: int) -> Decision:
         "num_groups": int(num_segments),
         "ops": [op for _, op in ops_key],
     }
+    candidates = ["jit_segment_reduce"]
     if host_segment_eligible(ops_key, val_cols):
-        return Decision(
+        static = Decision(
             "host_segment_reduce",
             "CPU backend: bincount's weighted histogram beats XLA's "
             "serialized segment scatter for float sums",
             details,
         )
-    if _kernel_backend_ok() and _ksr.eligible(
+        candidates.append("host_segment_reduce")
+    elif _kernel_backend_ok() and _ksr.eligible(
         ops_key, val_cols, num_segments
     ):
-        return Decision(
+        static = Decision(
             "pallas_segment_reduce",
             "fused multi-op pallas kernel: every (column, op) partial "
             "in ONE dispatch (one-hot MXU sums, masked VPU min/max) "
             "instead of one scatter per fetch",
             details,
         )
+        candidates.append("pallas_segment_reduce")
+    else:
+        static = Decision(
+            "jit_segment_reduce",
+            "jitted XLA segment program (kernel ineligible or disabled)",
+            details,
+        )
+    all_exact = all(
+        x in val_cols and reassoc_safe(op, val_cols[x].dtype)
+        for x, op in ops_key
+    )
+    if not all_exact or _force_pins_kernels():
+        return static
+    flip = pick_by_observed_wall(static.kind, candidates, observed_walls)
+    if flip is None:
+        return static
+    kind, evidence = flip
+    details = dict(details)
+    details.update(evidence)
     return Decision(
-        "jit_segment_reduce",
-        "jitted XLA segment program (kernel ineligible or disabled)",
+        kind,
+        f"observed walls: {kind} runs faster than {static.kind} for "
+        "this workload (all ops reassociation-safe — every strategy "
+        "reduces to the same bits)",
         details,
     )
 
 
 def decide_decode_attention(
-    num_heads: int, head_dim: int, page_size: int, max_pages: int
+    num_heads: int, head_dim: int, page_size: int, max_pages: int,
+    observed_walls: Optional[Dict[str, dict]] = None,
 ) -> Decision:
     """Decode-attention lowering for a serving decode engine, chosen
     ONCE at engine build (both the batched and the solo step trace the
     same choice — the batched==solo and preemption-replay bit-identity
-    gates therefore hold whichever side wins)."""
+    gates therefore hold whichever side wins). ``observed_walls`` can
+    flip pallas → XLA when recorded step walls show the kernel slower
+    on this host (the kernel is bit-identical to the XLA chain, so the
+    flip cannot move tokens); the reverse flip never engages — XLA is
+    only static when the kernel backend is unavailable."""
     details = {
         "heads": int(num_heads), "head_dim": int(head_dim),
         "page_size": int(page_size), "max_pages": int(max_pages),
     }
     if _kernel_backend_ok():
+        flip = None if _force_pins_kernels() else pick_by_observed_wall(
+            "pallas_decode_attn", ("xla_decode_attn",), observed_walls
+        )
+        if flip is not None:
+            kind, evidence = flip
+            details.update(evidence)
+            return Decision(
+                kind,
+                "observed walls: the XLA gather→dequant→attend chain "
+                "steps faster than the paged kernel on this host "
+                "(bit-identical — the kernel gate proves it)",
+                details,
+            )
         return Decision(
             "pallas_decode_attn",
             "fused paged int8-KV kernel: pages stream HBM→VMEM through "
@@ -482,19 +640,27 @@ def decide_decode_attention(
 
 
 def decide_ragged_gather(
-    n_rows: int, n_groups: int, cell_dtype
+    n_rows: int, n_groups: int, cell_dtype,
+    observed_walls: Optional[Dict[str, dict]] = None,
 ) -> Optional[Decision]:
     """Ragged map_rows staging: the pallas flat-buffer gather
     (``pallas_ragged_gather``) when the single-1-D-ragged-column fast
     path applies on a kernel-capable backend; None keeps the host
     ``np.stack`` staging (not a counted decision — it is the ordinary
     path, not a choice). The caller additionally verifies the cell
-    shapes and the int32 offset bound before acting on the choice."""
+    shapes and the int32 offset bound before acting on the choice.
+    ``observed_walls`` flips the kernel BACK to host staging (returns
+    None) when recorded walls show ``host_stack`` faster — staging is
+    bit-identical either way, so the flip only moves time."""
     import numpy as _np
 
     if n_rows == 0 or not _kernel_backend_ok():
         return None
     if _np.dtype(cell_dtype).kind not in ("f", "i", "u", "b"):
+        return None
+    if not _force_pins_kernels() and pick_by_observed_wall(
+        "pallas_ragged_gather", ("host_stack",), observed_walls
+    ) is not None:
         return None
     return Decision(
         "pallas_ragged_gather",

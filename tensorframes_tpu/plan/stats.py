@@ -51,6 +51,12 @@ __all__ = [
     "record_execution",
     "clear_memory",
     "sidecar_dir",
+    "observe_strategy_wall",
+    "strategy_walls",
+    "reset_strategy_walls",
+    "STRATEGY_WALL_ALPHA",
+    "STRATEGY_WALL_MIN_SAMPLES",
+    "STRATEGY_STALE_OBS",
 ]
 
 #: Sidecar record format; a version bump quarantines old records.
@@ -235,8 +241,13 @@ def lookup(fp: str) -> Optional[dict]:
     return copy.deepcopy(rec)
 
 
+#: Bound on recorded per-stage profile entries (one execution's stages).
+_PROFILE_MAX = 32
+
+
 def _merge(rec: dict, *, agg: Optional[dict], joins: Optional[dict],
-           push: Optional[dict], wall_s: Optional[float]) -> dict:
+           push: Optional[dict], wall_s: Optional[float],
+           profile: Optional[List[dict]] = None) -> dict:
     rec["execs"] = int(rec.get("execs", 0)) + 1
     if agg:
         a = rec.setdefault("agg", {})
@@ -255,13 +266,23 @@ def _merge(rec: dict, *, agg: Optional[dict], joins: Optional[dict],
         rec.setdefault("push", {}).update(push)
     if wall_s is not None:
         rec["wall_s"] = round(float(wall_s), 6)
+    if profile:
+        # replace, not merge: the profile is the LAST execution's
+        # per-stage breakdown (wall/rows/bytes/strategy/compile split)
+        # — EXPLAIN ANALYZE shows what just happened, not an average
+        rec["profile"] = [
+            {k: (round(float(v), 6) if isinstance(v, float) else v)
+             for k, v in entry.items()}
+            for entry in profile[:_PROFILE_MAX]
+        ]
     return rec
 
 
 def record_execution(fp: str, *, agg: Optional[dict] = None,
                      joins: Optional[dict] = None,
                      push: Optional[dict] = None,
-                     wall_s: Optional[float] = None) -> None:
+                     wall_s: Optional[float] = None,
+                     profile: Optional[List[dict]] = None) -> None:
     """Merge one execution's observations into the record and persist
     the sidecar (best-effort: a write failure logs and moves on)."""
     if not reopt_enabled():
@@ -273,7 +294,7 @@ def record_execution(fp: str, *, agg: Optional[dict] = None,
         # deep copy before merging: _merge mutates nested dicts, and
         # records handed out by lookup() must stay frozen snapshots
         rec = _merge(copy.deepcopy(rec), agg=agg, joins=joins,
-                     push=push, wall_s=wall_s)
+                     push=push, wall_s=wall_s, profile=profile)
         _MEM[fp] = rec
         _MEM.move_to_end(fp)
         while len(_MEM) > _MEM_MAX:
@@ -294,5 +315,177 @@ def record_execution(fp: str, *, agg: Optional[dict] = None,
 
 def clear_memory() -> None:
     """Drop the in-memory table (tests; the sidecar is untouched)."""
+    global _SW_LOADED
     with _LOCK:
         _MEM.clear()
+    with _SW_LOCK:
+        _SW.clear()
+        _SW_LOADED = False
+
+
+# ---------------------------------------------------------------------------
+# the strategy-wall table: observed per-(decision, strategy) latency
+# ---------------------------------------------------------------------------
+# The fingerprinted records above answer "what did THIS pipeline do".
+# Kernel/epilogue strategy choices need the complementary question:
+# "what does each strategy COST on this host, whatever the pipeline" —
+# host vs pallas vs jit segment-reduce, fused vs per-stage, per-block
+# vs concat epilogue. One process-wide table keyed (decision, strategy)
+# holds an EWMA of observed dispatch wall with a sample count, persisted
+# as ONE sidecar (`strategy_walls.json`) under the same write-temp →
+# atomic-replace / quarantine-on-corrupt contract as the per-fingerprint
+# records. Entries not refreshed within STRATEGY_STALE_OBS observations
+# of their decision are stale and dropped (counted as quarantine), the
+# same hygiene the selectivity records get from _valid().
+
+#: EWMA smoothing factor for observed strategy walls.
+STRATEGY_WALL_ALPHA = 0.3
+#: Minimum samples per strategy before a latency-driven flip may engage.
+STRATEGY_WALL_MIN_SAMPLES = 2
+#: An entry unrefreshed for this many observations of its decision is
+#: stale: dropped instead of consulted (a strategy that stopped being
+#: exercised months of observations ago is not evidence).
+STRATEGY_STALE_OBS = 256
+
+_SW_LOCK = threading.Lock()
+_SW: Dict[str, dict] = {}
+_SW_LOADED = False
+
+
+def _sw_path() -> Optional[str]:
+    d = sidecar_dir()
+    if d is None:
+        return None
+    return os.path.join(d, "strategy_walls.json")
+
+
+def _sw_valid(rec: object) -> bool:
+    return (
+        isinstance(rec, dict)
+        and rec.get("v") == FORMAT_VERSION
+        and rec.get("kind") == "strategy_walls"
+        and isinstance(rec.get("tables"), dict)
+    )
+
+
+def _sw_load_locked() -> None:
+    """Merge the on-disk table into memory once per process (under
+    _SW_LOCK). Corrupt/stale files quarantine exactly like records."""
+    global _SW_LOADED
+    if _SW_LOADED:
+        return
+    _SW_LOADED = True
+    path = _sw_path()
+    if path is None or not os.path.exists(path):
+        return
+    try:
+        with open(path, "r") as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        _quarantine(path, f"unreadable ({type(e).__name__})")
+        return
+    if not _sw_valid(rec):
+        _quarantine(path, "stale (format/kind mismatch)")
+        return
+    _SIDECAR_EVENTS["load"].inc()
+    for decision, table in rec["tables"].items():
+        if not isinstance(table, dict):
+            continue
+        mem = _SW.setdefault(decision, {"obs": 0, "strategies": {}})
+        mem["obs"] = max(int(mem.get("obs", 0)), int(table.get("obs", 0)))
+        for strat, ent in (table.get("strategies") or {}).items():
+            if isinstance(ent, dict) and "ewma_s" in ent:
+                mem["strategies"].setdefault(strat, dict(ent))
+
+
+def _sw_prune_locked(decision: str) -> None:
+    table = _SW.get(decision)
+    if not table:
+        return
+    obs = int(table.get("obs", 0))
+    stale = [
+        s for s, e in table["strategies"].items()
+        if obs - int(e.get("last_obs", 0)) > STRATEGY_STALE_OBS
+    ]
+    for s in stale:
+        del table["strategies"][s]
+        _SIDECAR_EVENTS["quarantine"].inc()
+        logger.warning(
+            "strategy-wall entry (%s, %s) is stale (unrefreshed for >%d "
+            "observations); dropping (static decisions continue)",
+            decision, s, STRATEGY_STALE_OBS,
+        )
+
+
+def observe_strategy_wall(decision: str, strategy: str,
+                          wall_s: float) -> None:
+    """Fold one observed dispatch wall into the (decision, strategy)
+    EWMA and persist the table (best-effort). No-op when re-optimization
+    is disabled — TFTPU_REOPT=0 freezes the static cost model."""
+    if not reopt_enabled():
+        return
+    with _SW_LOCK:
+        _sw_load_locked()
+        table = _SW.setdefault(decision, {"obs": 0, "strategies": {}})
+        table["obs"] = int(table.get("obs", 0)) + 1
+        ent = table["strategies"].get(strategy)
+        if ent is None:
+            ent = {"ewma_s": float(wall_s), "n": 0}
+            table["strategies"][strategy] = ent
+        else:
+            a = STRATEGY_WALL_ALPHA
+            ent["ewma_s"] = a * float(wall_s) + (1.0 - a) * float(ent["ewma_s"])
+        ent["ewma_s"] = round(float(ent["ewma_s"]), 9)
+        ent["n"] = int(ent.get("n", 0)) + 1
+        ent["last_obs"] = table["obs"]
+        _sw_prune_locked(decision)
+        snapshot = copy.deepcopy(_SW)
+    path = _sw_path()
+    if path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump({"v": FORMAT_VERSION, "kind": "strategy_walls",
+                       "tables": snapshot}, f, sort_keys=True)
+        os.replace(tmp, path)
+        _SIDECAR_EVENTS["store"].inc()
+    except OSError as e:  # pragma: no cover - disk-full etc.
+        logger.debug("strategy-wall sidecar write failed: %s", e)
+
+
+def reset_strategy_walls(unlink_sidecar: bool = True) -> None:
+    """Drop the strategy-wall table — memory and (by default) the
+    sidecar file. For tests and the bench's decision-flip smoke leg,
+    which inject synthetic walls to force a flip and must not leave
+    them behind for real runs to act on. ``unlink_sidecar=False``
+    forgets only this process's memory (the per-test isolation hook:
+    the table stays empty because the file is not re-merged either)."""
+    global _SW_LOADED
+    with _SW_LOCK:
+        _SW.clear()
+        _SW_LOADED = True  # do not re-merge the file being dropped
+    if not unlink_sidecar:
+        return
+    path = _sw_path()
+    if path is not None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def strategy_walls(decision: str) -> Dict[str, dict]:
+    """Observed-wall entries for one decision: ``{strategy: {"ewma_s",
+    "n", "last_obs"}}``, stale entries already dropped. Empty when
+    re-optimization is disabled or nothing was observed. Never raises."""
+    if not reopt_enabled():
+        return {}
+    with _SW_LOCK:
+        _sw_load_locked()
+        _sw_prune_locked(decision)
+        table = _SW.get(decision)
+        if not table:
+            return {}
+        return copy.deepcopy(table["strategies"])

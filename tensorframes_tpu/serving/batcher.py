@@ -44,8 +44,11 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import context as _context
 from ..observability import events as _events
 from ..observability import flight as _flight
+from ..observability.latency import LATENCY_BUCKETS
+from ..observability.metrics import Histogram
 from ..ops.executor import bucket_rows
 from ..resilience.faults import delay_point, fault_point, register_site
 from ..utils import get_logger
@@ -127,10 +130,12 @@ class ResultFuture:
 
 
 class _Request:
-    __slots__ = ("feeds", "rows", "t_submit", "deadline", "future")
+    __slots__ = ("feeds", "rows", "t_submit", "deadline", "future",
+                 "trace_id")
 
     def __init__(self, feeds, rows, deadline_s: Optional[float],
-                 future: ResultFuture):
+                 future: ResultFuture,
+                 trace_id: Optional[str] = None):
         self.feeds = feeds
         self.rows = rows
         self.t_submit = time.perf_counter()
@@ -138,6 +143,12 @@ class _Request:
             None if deadline_s is None else self.t_submit + deadline_s
         )
         self.future = future
+        #: cross-hop request id (ISSUE 17): set from the router's trace
+        #: header (or the submit thread's bound request context) so the
+        #: flush/request spans this request rides carry the SAME id the
+        #: router's ingress span does — the flush serves many requests,
+        #: so the id lives on the request slot, not a thread-local
+        self.trace_id = trace_id
 
 
 class ContinuousBatcher:
@@ -190,6 +201,14 @@ class ContinuousBatcher:
         self._admitted_rows = 0
         self._rejected = {r: 0 for r in m.REJECT_REASONS}
         self._deadline_expired = 0
+        # per-endpoint latency histogram, IN-OBJECT (TFL003 keeps
+        # endpoint names out of the registry's label space): feeds the
+        # p50/p95/p99 Server.stats()/healthz report per endpoint
+        self._latency = Histogram(
+            "serving_endpoint_latency_seconds",
+            f"request latency for endpoint {name!r} (submit → result)",
+            (), threading.Lock(), buckets=LATENCY_BUCKETS,
+        )
         self._open = False
         self._draining = False
         self._worker: Optional[threading.Thread] = None
@@ -287,18 +306,22 @@ class ContinuousBatcher:
         """One consistent snapshot of this batcher's queue depth and
         admission counters (the registry keeps the process-wide series)."""
         with self._cond:
-            return {
+            out = {
                 "queued_rows": self._queued_rows,
                 "admitted_requests": self._admitted_requests,
                 "admitted_rows": self._admitted_rows,
                 "rejected": dict(self._rejected),
                 "deadline_expired": self._deadline_expired,
             }
+        # quantiles outside _cond: the histogram has its own lock
+        out["latency"] = self._latency.quantiles()
+        return out
 
     # -- admission ----------------------------------------------------------
 
     def offer(self, feeds: Dict[str, np.ndarray], rows: int,
-              deadline_s: Optional[float]) -> ResultFuture:
+              deadline_s: Optional[float],
+              trace_id: Optional[str] = None) -> ResultFuture:
         if rows > self.max_batch_rows:
             m.rejected("too_large").inc()
             with self._cond:
@@ -310,7 +333,8 @@ class ContinuousBatcher:
                 reason="too_large",
             )
         future = ResultFuture(self.name, rows)
-        req = _Request(feeds, rows, deadline_s, future)
+        req = _Request(feeds, rows, deadline_s, future,
+                       trace_id or _context.current_request())
         with self._cond:
             if not self._open:
                 m.rejected("closed").inc()
@@ -547,11 +571,13 @@ class ContinuousBatcher:
             rows=n, requests=len(batch), seconds=round(dt, 6),
         )
         if _events.TRACER.enabled:
+            args = {"endpoint": self.name, "reason": reason,
+                    "rows": n, "requests": len(batch)}
+            rids = [r.trace_id for r in batch if r.trace_id]
+            if rids:
+                args["request_ids"] = rids[:16]
             _events.TRACER.emit_complete(
-                "serving.flush", t0, dt,
-                args={"endpoint": self.name, "reason": reason,
-                      "rows": n, "requests": len(batch)},
-                cat="serving",
+                "serving.flush", t0, dt, args=args, cat="serving",
             )
         off = 0
         done_t = time.perf_counter()
@@ -564,9 +590,12 @@ class ContinuousBatcher:
             off += req.rows
             latency = done_t - req.t_submit
             m.REQUEST_LATENCY.observe(latency)
+            self._latency.observe(latency)
             if _events.TRACER.enabled:
+                args = {"endpoint": self.name, "rows": req.rows}
+                if req.trace_id:
+                    args["request_id"] = req.trace_id
                 _events.TRACER.emit_complete(
-                    "serving.request", req.t_submit, latency,
-                    args={"endpoint": self.name, "rows": req.rows},
+                    "serving.request", req.t_submit, latency, args=args,
                     cat="serving",
                 )

@@ -52,6 +52,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import events as _events
 from ..observability import flight as _flight
 from ..utils import get_logger
 from ..validation import ValidationError
@@ -180,14 +181,17 @@ class DecodeEngine:
         # bit-identity gates hold whichever lowering wins. The choice
         # also reaches the compile-cache fingerprint (kernels token),
         # so a disable_pallas() flip can never serve a stale executable.
-        from ..plan.lower import _note_decision
+        from ..plan import stats as _pstats
+        from ..plan.lower import _note_decision, _note_flip
         from ..plan.rules import decide_decode_attention
 
         decision = decide_decode_attention(
             model_cfg.num_heads, model_cfg.head_dim, cfg.page_size,
             max_pages,
+            observed_walls=_pstats.strategy_walls("decode_attention"),
         )
         _note_decision(decision)
+        _note_flip(decision)
         self._attn_kernel: Optional[str] = (
             "pallas" if decision.kind == "pallas_decode_attn" else None
         )
@@ -224,7 +228,9 @@ class DecodeEngine:
         rebuilds the step on the XLA gather chain, and retries — a
         custom kernel must never take down the engine."""
         from .. import kernels as _kernels
+        from ..plan.lower import observe_strategy_wall
 
+        t_step = time.perf_counter()
         try:
             out = self._step(*args)
         except Exception as e:
@@ -249,7 +255,14 @@ class DecodeEngine:
                 ),
                 label=f"decode.step[{self.name}]",
             )
+            t_step = time.perf_counter()  # rebuilt step: time XLA only
             out = self._step(*args)
+        observe_strategy_wall(
+            "decode_attention",
+            "pallas_decode_attn" if self._attn_kernel is not None
+            else "xla_decode_attn",
+            time.perf_counter() - t_step,
+        )
         if self._attn_kernel is not None:
             _kernels.note_dispatch(
                 "decode_attn", _kernels.interpret_mode()
@@ -609,6 +622,15 @@ class DecodeEngine:
             resumed=bool(replay),
             waited_s=round(now - req.t_submit, 6),
         )
+        if _events.TRACER.enabled:
+            args = {"endpoint": self.name, "seq": seq,
+                    "prompt_len": plen, "resumed": bool(replay)}
+            if req.trace_id:
+                args["request_id"] = req.trace_id
+            _events.TRACER.emit_complete(
+                "decode.join", now, time.perf_counter() - now,
+                args=args, cat="serving",
+            )
         if len(s.generated) >= s.want:
             self._finish(s)
 
@@ -655,12 +677,22 @@ class DecodeEngine:
             tokens[row] = s.generated[-1]
             pos[row] = s.pos
             tables[row] = self._pool.table(s.seq)
+        t_step = time.perf_counter()
         cols, nxt = self._run_step(
             self.params, self._pool.columns, tokens, pos, tables
         )
         self._pool.columns = cols
         nxt = np.asarray(nxt)
         m.DECODE_STEPS["decode"].inc()
+        if _events.TRACER.enabled:
+            args = {"endpoint": self.name, "slots": n}
+            rids = [s.req.trace_id for s in active if s.req.trace_id]
+            if rids:
+                args["request_ids"] = rids[:16]
+            _events.TRACER.emit_complete(
+                "decode.step", t_step, time.perf_counter() - t_step,
+                args=args, cat="serving",
+            )
         for row, s in enumerate(active):
             s.pos += 1
             tok = int(nxt[row])
@@ -714,6 +746,15 @@ class DecodeEngine:
             tokens=int(out.shape[1]),
             seconds=round(done - s.req.t_submit, 6),
         )
+        if _events.TRACER.enabled:
+            args = {"endpoint": self.name, "seq": s.seq,
+                    "tokens": int(out.shape[1])}
+            if s.req.trace_id:
+                args["request_id"] = s.req.trace_id
+            _events.TRACER.emit_complete(
+                "decode.finish", s.req.t_submit, done - s.req.t_submit,
+                args=args, cat="serving",
+            )
 
     def _bit_identity_violation(self, s: _Seq, got: int,
                                 expect: int) -> None:

@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Optional
 
+from ..observability import context as _context
 from ..utils import get_logger
 from ..validation import ValidationError
 from .batcher import DeadlineExceededError, RejectedError
@@ -312,11 +313,22 @@ def serve_http(server: Server, port: int = 0, addr: str = "127.0.0.1",
             inputs = req.get("inputs")
             deadline_s = req.get("deadline_s")
             idem_key = req.get("idempotency_key")
+            # cross-hop trace adoption (ISSUE 17): the router's stamped
+            # request id binds to this handler thread, so the submit →
+            # batcher slot → flush spans carry the SAME id the router's
+            # ingress span does — `observability merge` joins them into
+            # one cross-process request timeline
+            trace_id, _ = _context.parse_trace_header(
+                self.headers.get(_context.TRACE_HEADER)
+            )
+            if trace_id:
+                m.REQUEST_TRACE.inc()
             t0 = time.perf_counter()
             try:
-                fut = server.submit(endpoint, inputs,
-                                    deadline_s=deadline_s,
-                                    idempotency_key=idem_key)
+                with _context.request_scope(trace_id):
+                    fut = server.submit(endpoint, inputs,
+                                        deadline_s=deadline_s,
+                                        idempotency_key=idem_key)
             except UnknownEndpointError as e:
                 self._reply(404, {"error": str(e)})
                 return

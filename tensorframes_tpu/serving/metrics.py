@@ -37,6 +37,7 @@ __all__ = [
     "ROUTER_REJECTED", "ROUTER_REPLICAS_LIVE", "ROUTER_REPLICA_DEAD",
     "ROUTER_REPLICA_RESTARTS", "ROUTER_DISPATCH_SECONDS",
     "ROUTER_REQUEST_LATENCY", "router_rejected",
+    "REQUEST_TRACE",
 ]
 
 #: Why an admission was refused (closed set — every series pre-registered).
@@ -109,6 +110,12 @@ DISPATCH_SECONDS = _histogram(
 DEADLINE_EXPIRED = _counter(
     "tftpu_serving_deadline_expired_total",
     "Requests failed because their deadline passed while queued",
+)
+REQUEST_TRACE = _counter(
+    "tftpu_serving_request_trace_total",
+    "Requests whose trace context crossed a process hop (Router "
+    "stamped or replica adopted the X-Tftpu-Trace header) — the "
+    "cross-hop tracing coverage signal (ISSUE 17)",
 )
 DISPATCH_ERRORS = _counter(
     "tftpu_serving_dispatch_errors_total",

@@ -55,6 +55,7 @@ from typing import Dict, Optional, Tuple
 
 from ..config import get_config
 from ..observability import context as _context
+from ..observability import events as _events
 from ..observability import flight as _flight
 from ..resilience.faults import delay_point
 from ..utils import get_logger
@@ -421,6 +422,12 @@ class Router:
         )
         payload = dict(payload)
         payload["idempotency_key"] = key
+        # cross-hop trace context (ISSUE 17): the request id IS the
+        # idempotency key — stable across a redrive, so the merged
+        # timeline shows one id from ingress through whichever replica
+        # finally served it
+        trace_val = _context.trace_header_value(key)
+        m.REQUEST_TRACE.inc()
         if deadline_s is None:
             deadline_s = payload.get("deadline_s")
         if deadline_s is None:
@@ -513,6 +520,7 @@ class Router:
                         status, body = http_json(
                             rep.addr, "POST", f"/v1/{endpoint}",
                             payload, timeout,
+                            headers={_context.TRACE_HEADER: trace_val},
                         )
                 except Exception as e:
                     # an injected router.dispatch error counts as a
@@ -577,7 +585,18 @@ class Router:
                     body.setdefault("replica", rep.rank)
                 return status, body
         finally:
-            m.ROUTER_REQUEST_LATENCY.observe(time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            m.ROUTER_REQUEST_LATENCY.observe(dur)
+            if _events.TRACER.enabled:
+                # the ingress half of the cross-process request span:
+                # merge joins it to the replica's serving.* spans via
+                # the shared request_id arg
+                _events.TRACER.emit_complete(
+                    "router.request", t0, dur,
+                    args={"request_id": key, "endpoint": endpoint,
+                          "attempts": attempts},
+                    cat="serving",
+                )
 
     def _reject(self, reason: str, endpoint: str,
                 message: str) -> Tuple[int, dict]:
@@ -678,12 +697,14 @@ class Router:
 
 
 def http_json(addr: str, method: str, path: str,
-               payload: Optional[dict], timeout: float
+               payload: Optional[dict], timeout: float,
+               headers: Optional[Dict[str, str]] = None,
                ) -> Tuple[Optional[int], dict]:
     """One bounded HTTP exchange with a replica. Returns
     ``(status, parsed body)``; ``(None, {"error": ...})`` on any
     network-level failure (refused, reset, timeout, torn reply) — the
-    caller's signal to redrive."""
+    caller's signal to redrive. ``headers`` adds/overrides request
+    headers (the router's trace-context stamp)."""
     import http.client
 
     host, _, port = addr.rpartition(":")
@@ -692,7 +713,7 @@ def http_json(addr: str, method: str, path: str,
     )
     try:
         body = None
-        headers = {}
+        headers = dict(headers or {})
         if payload is not None:
             body = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"

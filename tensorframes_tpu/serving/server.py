@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import context as _context
 from ..observability import flight as _flight
 from ..program import Program
 from ..resilience.retry import RetryPolicy, retry_call
@@ -559,7 +560,15 @@ class Server:
                     key=str(idempotency_key),
                 )
                 return entry[0]
-        fut = self._submit_new(endpoint, feeds, deadline_s)
+        # request id for cross-hop tracing (ISSUE 17): the thread-bound
+        # id (the HTTP adapter binds the X-Tftpu-Trace header's id
+        # before calling submit) wins; otherwise the idempotency key —
+        # a router-stamped dispatch stays traceable even through an
+        # in-process submit path that never touched the HTTP adapter
+        trace_id = _context.current_request()
+        if trace_id is None and idempotency_key is not None:
+            trace_id = str(idempotency_key)
+        fut = self._submit_new(endpoint, feeds, deadline_s, trace_id)
         if idempotency_key is not None and self.config.idempotency_cache:
             with self._lock:
                 # first-writer-wins: a racing duplicate that also missed
@@ -585,13 +594,15 @@ class Server:
             self._idem.popitem(last=False)
 
     def _submit_new(self, endpoint: str, feeds,
-                    deadline_s: Optional[float]) -> ResultFuture:
+                    deadline_s: Optional[float],
+                    trace_id: Optional[str] = None) -> ResultFuture:
         eng = self._decode.get(endpoint)
         if eng is not None:
             # iterative decode rides the engine's own admission queue
             # (its expirer covers slot waits); the engine inherited the
             # server default deadline at register time
-            return eng.submit(feeds, deadline_s=deadline_s)
+            with _context.request_scope(trace_id):
+                return eng.submit(feeds, deadline_s=deadline_s)
         try:
             ep = self._endpoints[endpoint]
         except KeyError:
@@ -608,7 +619,8 @@ class Server:
                 f"deadline_s must be > 0 (got {deadline_s}) — the same "
                 "contract as RetryPolicy.deadline_s"
             )
-        return self._batchers[endpoint].offer(arrs, rows, deadline_s)
+        return self._batchers[endpoint].offer(arrs, rows, deadline_s,
+                                              trace_id=trace_id)
 
     def call(self, endpoint: str, feeds,
              deadline_s: Optional[float] = None,
@@ -636,6 +648,7 @@ class Server:
             self._prune_idem_locked(time.monotonic())
         queues: Dict[str, int] = {}
         decode: Dict[str, Dict[str, int]] = {}
+        latency: Dict[str, Dict[str, float]] = {}
         totals = {
             "admitted_requests": 0,
             "admitted_rows": 0,
@@ -650,6 +663,12 @@ class Server:
             for r, c in snap["rejected"].items():
                 totals["rejected"][r] += c
             totals["deadline_expired"] += snap["deadline_expired"]
+            # per-endpoint p50/p95/p99 (ISSUE 17): endpoint cardinality
+            # stays out of the metrics registry (TFL003), so the
+            # quantiles ride healthz/stats() instead — each batcher
+            # keeps its own in-object histogram
+            if snap.get("latency"):
+                latency[name] = snap["latency"]
 
         for name, b in batchers.items():
             _tally(name, b.counters())
@@ -665,6 +684,7 @@ class Server:
             "state": state,
             "endpoints": sorted(queues),
             "queued_rows": queues,
+            "latency": latency,
             **totals,
             # process-wide compile accounting, for the fleet's
             # zero-compile-restart assertion: a restarted replica warmed

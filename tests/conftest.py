@@ -76,3 +76,19 @@ def _fresh_graph():
 
     with with_graph():
         yield
+
+
+@pytest.fixture(autouse=True)
+def _strategy_walls_isolated():
+    """Latency-feedback hygiene: the strategy-wall EWMA table
+    (plan/stats) is process-global BY DESIGN — in production every
+    pipeline's observed walls inform every decision. Across a test
+    suite that design makes decision-kind assertions order-dependent
+    (one test's recorded walls can flip a later test's decide_*), so
+    each test starts from an empty in-memory table. Memory only: the
+    sidecar file is untouched, and tests that exercise persistence
+    re-arm loading themselves via plan_stats.clear_memory()."""
+    from tensorframes_tpu.plan import stats as _plan_stats
+
+    _plan_stats.reset_strategy_walls(unlink_sidecar=False)
+    yield
