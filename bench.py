@@ -292,6 +292,121 @@ def _bench_chain3_join(n_rows: int = 1_000_000, iters: int = 6,
     return fused_s, unfused_s, steady_compiles
 
 
+def _bench_lifted_chain(n_rows: int = 1_000_000, iters: int = 6,
+                        num_blocks: int = 4, n_groups: int = 512):
+    """map→numpy-UDF→aggregate with verified lifting (ISSUE 18): the
+    static pass lifts the host-callback numpy UDF into the plan IR, so
+    the whole chain fuses into one dispatch; ``TFTPU_LIFT=0``
+    (``configure(udf_lifting=False)``) replays the identical pipeline
+    through the real ``pure_callback`` stage as the bit-identity
+    oracle. UDF values are small odd integers and group sums stay well
+    under 2^24, so every aggregate is exactly representable in f32:
+    lifted and callback outputs must be BIT-IDENTICAL (asserted here),
+    the lifted chain must report ZERO fusion barriers, and the steady
+    state must run compile-free — all three are hard gates, not report
+    lines. Returns (lifted_wall_s, callback_wall_s, steady_compiles)."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.config import get_config
+    from tensorframes_tpu.ops.executor import _JIT_MISSES
+    from tensorframes_tpu.plan import ir as plan_ir
+    from tensorframes_tpu.plan import lift as plan_lift
+
+    rng = np.random.default_rng(0)
+    frame = tfs.frame_from_arrays(
+        {
+            "k": rng.integers(0, n_groups, n_rows).astype(np.int32),
+            "x": (np.arange(n_rows) % 16).astype(np.float32),
+        },
+        num_blocks=num_blocks,
+    )
+    p1 = tfs.compile_program(lambda x: {"y": x * 2.0 + 1.0}, frame)
+
+    def score(y):
+        # elementwise allowlist forms only: where/compare/arith — the
+        # shape the lifter proves bit-exact and substitutes
+        return {"s": np.where(y > 8.0, y - 8.0, 8.0 - y)}
+
+    # ONE NumpyUDF capture reused every iteration (the steady-state
+    # serving shape): its per-spec Program cache is what makes the
+    # steady state compile-free
+    udf = tfs.numpy_udf(score)
+    f1 = tfs.map_blocks(p1, frame)
+    plan_lift.clear_lift_log()
+    f2 = tfs.map_blocks(udf, f1)
+    recs = [r for r in plan_lift.lift_log() if r["udf"] == "score"]
+    if not (recs and recs[-1]["lifted"]):
+        raise AssertionError(
+            f"lifted_chain: the score UDF did not lift "
+            f"({recs[-1] if recs else 'no decision recorded'})"
+        )
+    n_maps, barriers = plan_ir.chain_barriers(f2)
+    if barriers:
+        raise AssertionError(
+            f"lifted_chain: lifted chain still reports fusion "
+            f"barriers: {barriers}"
+        )
+    # the aggregate program compiles ONCE against the mapped schema
+    # (the steady-state serving shape, like chain3's stages)
+    with tfs.with_graph():
+        s_in = tfs.block(f2, "s", tf_name="s_input")
+        fs = tfs.reduce_sum(s_in, axis=0, name="s")
+        agg_program = tfs.compile_program(
+            [fs], f2, reduce_mode="blocks"
+        )
+
+    def run_once():
+        f = tfs.map_blocks(udf, tfs.map_blocks(p1, frame))
+        out = tfs.aggregate(agg_program, f.group_by("k"))
+        return out.blocks()
+
+    def wall(iters_):
+        run_once()  # warm the jit caches out of the timed region
+        t0 = time.perf_counter()
+        for _ in range(iters_):
+            run_once()
+        return (time.perf_counter() - t0) / iters_
+
+    was = get_config().udf_lifting
+    try:
+        tfs.configure(udf_lifting=True)
+        run_once()  # warm
+        m0 = _JIT_MISSES.value
+        lifted_s = wall(iters)
+        steady_compiles = int(_JIT_MISSES.value - m0)
+        lifted_rows = run_once()
+        tfs.configure(udf_lifting=False)  # the TFTPU_LIFT=0 oracle
+        callback_s = wall(iters)
+        callback_rows = run_once()
+    finally:
+        tfs.configure(udf_lifting=was)
+    if steady_compiles:
+        raise AssertionError(
+            f"lifted_chain: {steady_compiles} steady-state compile(s) "
+            "— the lifted chain must be compile-free after warmup"
+        )
+    if len(lifted_rows) != len(callback_rows):
+        raise AssertionError(
+            f"lifted_chain: lifted produced {len(lifted_rows)} "
+            f"block(s), callback {len(callback_rows)} — the "
+            "bit-identity contract is broken"
+        )
+    for lb, cb in zip(lifted_rows, callback_rows):
+        if set(lb) != set(cb):
+            raise AssertionError(
+                f"lifted_chain: lifted columns {sorted(lb)} != callback "
+                f"{sorted(cb)} — the bit-identity contract is broken"
+            )
+        for name in lb:
+            la, ca = np.asarray(lb[name]), np.asarray(cb[name])
+            if la.dtype != ca.dtype or la.tobytes() != ca.tobytes():
+                raise AssertionError(
+                    f"lifted_chain: lifted and callback outputs differ "
+                    f"in column {name!r} — the bit-identity contract "
+                    "is broken"
+                )
+    return lifted_s, callback_s, steady_compiles
+
+
 def _bench_multijoin(n_rows: int = 1_000_000, iters: int = 4,
                      num_blocks: int = 4, n_g1: int = 512,
                      n_g2: int = 64):
@@ -1867,6 +1982,28 @@ def main():
             )
         )
     (
+        lifted_chain_s, lifted_chain_cb_s, lifted_chain_compiles,
+    ) = _try(
+        "lifted_chain", _bench_lifted_chain,
+        (float("nan"), float("nan"), -1),
+        metric_keys=(
+            "lifted_chain_1M_wall_s", "lifted_chain_1M_callback_wall_s",
+        ),
+    )
+    if (
+        lifted_chain_s == lifted_chain_s
+        and lifted_chain_cb_s == lifted_chain_cb_s
+    ):
+        print(
+            "# plan | lift lifted={:.4f}s callback={:.4f}s ratio={:.2f}x "
+            "steady_state_compiles={} bit_identical=True barriers=0 "
+            "(acceptance: >= 1.5x, 0 compiles)".format(
+                lifted_chain_s, lifted_chain_cb_s,
+                lifted_chain_cb_s / lifted_chain_s,
+                lifted_chain_compiles,
+            )
+        )
+    (
         multijoin_opt_s, multijoin_static_s, multijoin_unfused_s,
         multijoin_pushdowns, multijoin_flips,
     ) = _try(
@@ -2286,6 +2423,8 @@ def main():
         "chain3_unfused_1M_wall_s": round(chain3_unfused_s, 6),
         "chain3_join_fused_1M_wall_s": round(chain3_join_fused_s, 6),
         "chain3_join_unfused_1M_wall_s": round(chain3_join_unfused_s, 6),
+        "lifted_chain_1M_wall_s": round(lifted_chain_s, 6),
+        "lifted_chain_1M_callback_wall_s": round(lifted_chain_cb_s, 6),
         "multijoin_opt_1M_wall_s": round(multijoin_opt_s, 6),
         "multijoin_static_1M_wall_s": round(multijoin_static_s, 6),
         "multijoin_unfused_1M_wall_s": round(multijoin_unfused_s, 6),

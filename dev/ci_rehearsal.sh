@@ -83,8 +83,8 @@ if command -v ruff >/dev/null 2>&1; then
 else
   echo "=== step: Lint: ruff — SKIPPED (ruff not in zero-egress image; runs on real CI)" | tee -a "$LOG"
 fi
-run_step "Lint: repo self-lint (dev/lint_rules.py)" \
-  python "$CLONE/dev/lint_rules.py"
+run_step "Lint: repo self-lint (analysis selfcheck, TFL conventions)" \
+  python -m tensorframes_tpu.analysis selfcheck
 run_step "Lint: static program diagnostics (examples, strict)" \
   python -m tensorframes_tpu.analysis --demo --strict --explain
 
@@ -120,6 +120,32 @@ run_step "Re-optimization-off smoke (TFTPU_REOPT=0 static cost model stays green
 # green (same contract as the fusion-off escape hatch above)
 run_step "Kernels-off smoke (TFTPU_PALLAS=0 straggler kernels removed)" \
   env TFTPU_PALLAS=0 python -m pytest tests/test_kernels.py tests/test_segment.py tests/test_verbs.py tests/test_decode.py tests/test_generation.py -q
+
+# ci.yml's lift-off smoke (ISSUE 18): TFTPU_LIFT=0 turns verified UDF
+# lifting off — every numpy UDF replays the host-callback path (the
+# bit-identity oracle lifts are verified against) as a counted barrier
+# with reason `lifting-disabled`, and the UDF + relational suites must
+# stay green on that path (test_lifting pins the knob per-test, the
+# same shape as test_plan in the fusion-off leg)
+run_step "Lift-off smoke (TFTPU_LIFT=0 callback path stays green)" bash -c "
+  env TFTPU_LIFT=0 python -c \"
+import numpy as np, jax
+jax.config.update('jax_platforms', 'cpu')
+import tensorframes_tpu as tfs
+from tensorframes_tpu.plan import lift
+assert tfs.configure().udf_lifting is False, 'TFTPU_LIFT=0 must disable lifting'
+def score(x):
+    return {'y': x * 2.0 + 1.0}
+fr = tfs.frame_from_arrays({'x': np.arange(64, dtype=np.float32)}, num_blocks=4)
+blocks = tfs.map_blocks(tfs.numpy_udf(score), fr).blocks()
+got = np.concatenate([np.asarray(b['y']) for b in blocks])
+assert got.tobytes() == (np.arange(64, dtype=np.float32) * 2.0 + 1.0).tobytes()
+rec = lift.lift_log()[-1]
+assert rec['lifted'] is False and rec['reason'] == 'lifting-disabled', rec
+print('lift-off smoke: callback barrier replayed, reason=lifting-disabled')
+\" &&
+  env TFTPU_LIFT=0 python -m pytest tests/test_lifting.py tests/test_relational_pipeline.py -q
+"
 
 # ci.yml's compile-cache smoke: a tier-1 slice twice against one shared
 # persistent store; the second run must report disk hits > 0 in its

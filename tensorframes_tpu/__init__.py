@@ -95,10 +95,12 @@ from . import plan  # noqa: E402,F401  (registers tftpu_plan_* metrics)
 from . import kernels  # noqa: E402,F401  (registers tftpu_kernels_* metrics)
 from .plan import explain_plan  # noqa: E402,F401
 from .ops.verbs import (  # noqa: E402,F401
+    NumpyUDF,
     aggregate,
     compile_program,
     map_blocks,
     map_rows,
+    numpy_udf,
     reduce_blocks,
     reduce_rows,
 )
@@ -158,6 +160,8 @@ __all__ = [
     "reduce_blocks",
     "aggregate",
     "compile_program",
+    "numpy_udf",
+    "NumpyUDF",
     "analyze",
     "append_shape",
     "print_schema",

@@ -215,7 +215,8 @@ def analyze_frame(
 def lint_plan(frame) -> DiagnosticReport:
     """Lint a frame's *logical plan* (TFG107 fusion-barrier, TFG109
     unfused-aggregate, TFG110 missed-aggregate-pushdown, TFG111
-    larger-than-budget materialization): warn when a
+    larger-than-budget materialization, TFG112 liftable-callback /
+    lift-declined): warn when a
     chain's otherwise-fusable map stages are split by a barrier — a
     host-callback stage, a ``to_host``/``to_numpy`` materialization or
     repartition between maps, a trim map, or ragged source cells —
@@ -230,18 +231,33 @@ def lint_plan(frame) -> DiagnosticReport:
     out-of-core alternative, docs/dataplane.md). Each
     finding's ``explain()`` names the cause. Purely static over the
     recorded plan chain — never forces a lazy frame."""
-    from ..plan.ir import chain_barriers, unfused_epilogues
+    from ..plan.ir import chain_barriers, resolve_chain, unfused_epilogues
     from ..plan.lower import oversized_materializations, pushdown_misses
 
     n_maps, barriers = chain_barriers(frame)
+    # verified-lift decisions (TFG112): each numpy UDF stage carries its
+    # capture record — lifted (barrier cleared) or declined (reason +
+    # offending AST node) — on the program plan/lift built
+    lift_events = []
+    node = getattr(frame, "_plan", None)
+    if node is not None:
+        _, nodes = resolve_chain(node)
+        for n in nodes:
+            info = getattr(getattr(n, "program", None),
+                           "_tftpu_lift_info", None)
+            if info:
+                lift_events.append(dict(info))
     ctx = RuleContext(
         program=None,
         plan_barriers=barriers,
         unfused_epilogues=unfused_epilogues(frame),
         pushdown_misses=pushdown_misses(frame),
         oversized_materializations=oversized_materializations(frame),
+        lift_events=lift_events,
     )
-    diags = run_rules(ctx, codes=["TFG107", "TFG109", "TFG110", "TFG111"])
+    diags = run_rules(
+        ctx, codes=["TFG107", "TFG109", "TFG110", "TFG111", "TFG112"]
+    )
     return DiagnosticReport(
         diags, subject=f"plan({n_maps} map stage(s))"
     )

@@ -5,6 +5,11 @@ Positional arguments are paths to serialized StableHLO program bundles
 :func:`~tensorframes_tpu.program.load_program` and linted **without
 compiling or executing it** (deserialization + tracing only).
 
+``selfcheck`` as the first argument dispatches to the repo self-lint
+instead (:mod:`.selfcheck` — the TFL rules that used to live in
+``dev/lint_rules.py``), making this module the ONE lint entry point CI
+calls: ``python -m tensorframes_tpu.analysis selfcheck [paths]``.
+
 ``--demo`` builds the stock example programs (the README add-3 map, the
 logreg scoring program, the geom-mean log-transform) in-process, lints
 them, round-trips one through a temporary StableHLO bundle, and lints
@@ -124,6 +129,13 @@ def _demo_reports(args) -> List:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "selfcheck":
+        # repo self-lint (TFL rules): one lint entry point for CI
+        from .selfcheck import main as selfcheck_main
+
+        return selfcheck_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m tensorframes_tpu.analysis",
         description="Statically lint serialized StableHLO program bundles "
@@ -144,7 +156,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--hbm-budget", type=int, default=None,
                         help="device memory budget in bytes for TFG106 "
                              "(default: the backend's reported limit)")
+    parser.add_argument("--lift-report", action="store_true",
+                        help="print this process's verified-lift decisions "
+                             "(lifted / declined + reason) and exit")
     args = parser.parse_args(argv)
+    if args.lift_report:
+        from ..plan import lift as plan_lift
+
+        print(plan_lift.lift_report())
+        return 0
     if not args.paths and not args.demo:
         parser.error("nothing to lint: pass bundle paths or --demo")
 

@@ -53,6 +53,18 @@ CODES: Dict[str, Tuple[str, str]] = {
     "TFG109": ("unfused-aggregate", "warn"),
     "TFG110": ("missed-aggregate-pushdown", "warn"),
     "TFG111": ("larger-than-budget-materialization", "warn"),
+    # liftable-callback / lift-declined pair: info when a captured numpy
+    # UDF lifted (verified bit-exact, barrier cleared), warn when it
+    # stayed a callback — the message carries the taxonomy reason and
+    # names the offending AST node.
+    "TFG112": ("liftable-callback", "warn"),
+    # TFL: the repo self-lint family (python -m tensorframes_tpu.analysis
+    # selfcheck — policy rules over this repo's own sources, not user
+    # programs). Registered here so one catalog covers every code a CI
+    # log can print.
+    "TFL001": ("bare-jax-jit", "error"),
+    "TFL002": ("unguarded-module-state", "error"),
+    "TFL003": ("unregistered-runtime-metric", "error"),
 }
 
 # Pre-register the full counter family at import: one series per code,

@@ -111,6 +111,11 @@ class RuleContext:
     #: probed cache key — the fingerprint must be stable WITH the
     #: layout axes in it, exactly as the store keys executables.
     shardings: Optional[Dict[str, object]] = None
+    #: Verified-lift decisions for numpy UDF stages on a frame's plan
+    #: chain (lint_plan only): the capture records attached by
+    #: plan.lift.build_udf_program — dicts with ``udf``, ``lifted``,
+    #: ``reason``, ``node``, ``lineno``, ``detail``; read by TFG112.
+    lift_events: Optional[Sequence[dict]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -844,6 +849,55 @@ def _rule_fingerprint_unstable(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG112 — liftable-callback / lift-declined (plan-chain rule)
+# ---------------------------------------------------------------------------
+
+def _rule_liftable_callback(ctx: RuleContext) -> List[Diagnostic]:
+    """Verified UDF lifting decisions on the chain's numpy UDF stages
+    (plan/lift): a *lifted* stage is an info — the callback barrier was
+    cleared after bit-exact verification and the stage fuses like any
+    other; a *declined* stage is a warn carrying the taxonomy reason
+    and, where one exists, the offending AST node — the actionable
+    rewrite that would let the UDF lift."""
+    if not ctx.lift_events:
+        return []
+    out: List[Diagnostic] = []
+    for ev in ctx.lift_events:
+        udf = str(ev.get("udf", "<udf>"))
+        if ev.get("lifted"):
+            out.append(Diagnostic(
+                "TFG112", "info",
+                f"numpy UDF {udf!r} lifted into the plan IR (synthesis "
+                "verified bit-exact on the boundary corpus): the stage "
+                "fuses — no callback barrier, no per-stage dispatch",
+                subject=udf,
+                fix="none needed — TFTPU_LIFT=0 replays the callback "
+                    "path if you need the host-side original",
+            ))
+            continue
+        reason = str(ev.get("reason", "unknown"))
+        node = ev.get("node")
+        lineno = ev.get("lineno")
+        at = f" at AST node {node}" if node else ""
+        at += f" (line {lineno})" if lineno else ""
+        detail = str(ev.get("detail") or "")
+        out.append(Diagnostic(
+            "TFG112", "warn",
+            f"numpy UDF {udf!r} stayed a host-callback barrier — "
+            f"lift declined: {reason}{at}"
+            + (f" — {detail}" if detail else ""),
+            subject=udf,
+            fix="restrict the UDF to the lifting allowlist (elementwise "
+                "numpy ops, min/max and int/bool sum/mean reductions, "
+                "constants, column refs — no loops, branches, mutable "
+                "closures or np.random); see docs/analysis.md#tfg112 "
+                "for the full table, or keep the callback and accept "
+                "the barrier",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -859,6 +913,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG109": _rule_unfused_aggregate,
     "TFG110": _rule_missed_pushdown,
     "TFG111": _rule_oversized_materialization,
+    "TFG112": _rule_liftable_callback,
 }
 
 

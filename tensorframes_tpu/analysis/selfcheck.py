@@ -1,0 +1,269 @@
+"""Repo self-lint: AST rules for hazards ruff has no opinion on.
+
+Run: ``python -m tensorframes_tpu.analysis selfcheck [paths]``
+(default: the ``tensorframes_tpu/`` package). Exit 0 when clean; 1 with
+one ``path:line: TFL### message`` per finding. CI runs this in the
+``lint`` job next to ruff and the program analyzer — one lint entry
+point, the same split as the runtime: ruff = syntax/style, selfcheck =
+repo conventions, ``tensorframes_tpu.analysis`` = user programs. The
+TFL codes are registered in :mod:`.diagnostics`' catalog so
+``explain()``-style tooling can resolve them; findings here print as
+plain lint lines (they describe repo source, not a traced program).
+``dev/lint_rules.py`` remains as a thin shim for muscle memory.
+
+Rules (pragmas silence a single line):
+
+* **TFL001** — bare ``jax.jit`` in library code outside the allowlisted
+  modules. ``jax.jit(fn)`` embeds closure-captured weights as HLO
+  literals and XLA constant-folds through them (measured round 3: int8
+  weights re-materialized as f32, zero byte saving); new code must go
+  through the hoisted path (``program.HoistedProgram`` /
+  ``CompiledProgram``) or be explicitly allowlisted here with a reason.
+  Pragma: ``# lint: allow-jax-jit``.
+* **TFL002** — module-level mutable container mutated from function
+  scope without a module-level ``threading.Lock``/``RLock`` (verbs run
+  from prefetch worker threads; unsynchronized module state is a data
+  race). Pragma: ``# lint: guarded``.
+* **TFL003** — get-or-create metrics calls (``counter``/``gauge``/
+  ``histogram`` on the default registry) inside a function. Instruments
+  must pre-register at import so expositions always carry the full
+  catalog (a counter that never fired reads 0, it does not vanish).
+  Calls on an explicit registry object stay allowed. Pragma:
+  ``# lint: runtime-metric-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# Modules where bare jax.jit is the implementation of (or deliberately
+# adjacent to) the hoisted path itself, with the justification on record:
+ALLOW_JAX_JIT = {
+    "tensorframes_tpu/program.py",         # HoistedProgram IS the hoisted path
+    "tensorframes_tpu/ops/executor.py",    # CompiledProgram entrypoints
+    "tensorframes_tpu/ops/verbs.py",       # seg fast path / sharded folds: no closure weights
+    "tensorframes_tpu/ops/device_agg.py",  # shard_map plans over runtime args
+    "tensorframes_tpu/ops/exchange.py",    # collective shuffles, no weights
+    "tensorframes_tpu/ops/attention.py",   # pallas kernel wrappers
+    "tensorframes_tpu/ops/quantize.py",    # kernel micro-entry, args only
+    "tensorframes_tpu/frame.py",           # relational masks over runtime args
+    "tensorframes_tpu/parallel/pipeline.py",  # per-stage shard_map programs
+    "tensorframes_tpu/models/moe.py",      # params passed as arguments
+    "tensorframes_tpu/models/transformer.py",  # params passed as arguments
+    "tensorframes_tpu/training.py",        # step fns take params as args
+    "tensorframes_tpu/plan/lift.py",       # verify jit: synthesized fn, no closure weights
+}
+
+MUTATORS = {
+    "append", "add", "update", "setdefault", "pop", "clear", "extend",
+    "insert", "remove", "popitem", "discard",
+}
+
+METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _pragma(lines: List[str], lineno: int, tag: str) -> bool:
+    line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+    return f"lint: {tag}" in line
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("dict", "list", "set", "deque", "defaultdict")
+    return False
+
+
+def _creates_lock(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("Lock", "RLock"):
+                return True
+            if isinstance(f, ast.Name) and f.id in ("Lock", "RLock"):
+                return True
+    return False
+
+
+def _jax_jit_findings(tree, rel, lines) -> List[Tuple[int, str, str]]:
+    out = []
+    jit_aliases = {"jit"} if any(
+        isinstance(n, ast.ImportFrom) and n.module == "jax"
+        and any(a.name == "jit" for a in n.names)
+        for n in ast.walk(tree)
+    ) else set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (
+            isinstance(f, ast.Attribute) and f.attr == "jit"
+            and isinstance(f.value, ast.Name) and f.value.id == "jax"
+        ) or (isinstance(f, ast.Name) and f.id in jit_aliases)
+        if not hit:
+            continue
+        if rel in ALLOW_JAX_JIT or _pragma(lines, node.lineno, "allow-jax-jit"):
+            continue
+        out.append((
+            node.lineno, "TFL001",
+            "bare jax.jit in library code: closure constants fold into the "
+            "HLO (un-doing int8, bloating per-shape compiles) — use the "
+            "hoisted path (program.HoistedProgram / CompiledProgram) or "
+            "allowlist the module in analysis/selfcheck.py with a reason",
+        ))
+    return out
+
+
+def _mutable_state_findings(tree, rel, lines) -> List[Tuple[int, str, str]]:
+    module_containers = {}
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target = node.target.id
+            value = node.value
+        if target is None or not _is_mutable_literal(value):
+            continue
+        if _pragma(lines, node.lineno, "guarded"):
+            continue
+        module_containers[target] = node.lineno
+    if not module_containers:
+        return []
+    has_lock = _creates_lock(tree)
+
+    mutated = set()
+
+    class FnVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self.depth = 0
+
+        def visit_FunctionDef(self, node):
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _name_of(self, v):
+            return v.id if isinstance(v, ast.Name) else None
+
+        def visit_Call(self, node):
+            if self.depth and isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                n = self._name_of(node.func.value)
+                if n in module_containers:
+                    mutated.add(n)
+            self.generic_visit(node)
+
+        def visit_Subscript(self, node):
+            if self.depth and isinstance(node.ctx, (ast.Store, ast.Del)):
+                n = self._name_of(node.value)
+                if n in module_containers:
+                    mutated.add(n)
+            self.generic_visit(node)
+
+    FnVisitor().visit(tree)
+    out = []
+    if not has_lock:
+        for name in sorted(mutated):
+            out.append((
+                module_containers[name], "TFL002",
+                f"module-level mutable {name!r} is mutated from function "
+                "scope but the module creates no threading.Lock/RLock — "
+                "guard it (or mark the line '# lint: guarded' with a "
+                "single-threaded justification)",
+            ))
+    return out
+
+
+def _metric_findings(tree, rel, lines) -> List[Tuple[int, str, str]]:
+    # alias map: imported-from observability.metrics names → factory kind
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.endswith("observability.metrics"):
+            for a in node.names:
+                if a.name in METRIC_FACTORIES:
+                    aliases[a.asname or a.name] = a.name
+    if not aliases:
+        return []
+    out = []
+
+    class FnVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self.depth = 0
+
+        def visit_FunctionDef(self, node):
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            f = node.func
+            bad = False
+            if isinstance(f, ast.Name) and f.id in aliases:
+                bad = self.depth > 0
+            elif isinstance(f, ast.Attribute) and f.attr in METRIC_FACTORIES \
+                    and isinstance(f.value, ast.Name) and f.value.id == "REGISTRY":
+                bad = self.depth > 0
+            if bad and not _pragma(lines, node.lineno, "runtime-metric-ok"):
+                out.append((
+                    node.lineno, "TFL003",
+                    "metrics get-or-create inside a function: instruments "
+                    "must pre-register at import so the exposition always "
+                    "carries the full catalog (move to module level, pass "
+                    "an explicit registry, or mark "
+                    "'# lint: runtime-metric-ok')",
+                ))
+            self.generic_visit(node)
+
+    FnVisitor().visit(tree)
+    return out
+
+
+def lint_file(path: Path) -> List[str]:
+    rel = str(path.relative_to(REPO)) if path.is_relative_to(REPO) else str(path)
+    src = path.read_text()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: TFL000 syntax error: {e.msg}"]
+    findings = []
+    findings += _jax_jit_findings(tree, rel, lines)
+    findings += _mutable_state_findings(tree, rel, lines)
+    findings += _metric_findings(tree, rel, lines)
+    return [f"{rel}:{ln}: {code} {msg}" for ln, code, msg in sorted(findings)]
+
+
+def main(argv: List[str]) -> int:
+    roots = [Path(a) for a in argv] or [REPO / "tensorframes_tpu"]
+    files: List[Path] = []
+    for r in roots:
+        files.extend(sorted(r.rglob("*.py")) if r.is_dir() else [r])
+    all_findings: List[str] = []
+    for f in files:
+        all_findings.extend(lint_file(f))
+    for line in all_findings:
+        print(line)
+    print(
+        f"analysis selfcheck: {len(files)} file(s), "
+        f"{len(all_findings)} finding(s)"
+    )
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

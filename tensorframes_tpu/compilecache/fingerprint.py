@@ -57,7 +57,10 @@ import numpy as np
 #: v3: the straggler-kernel selection state joined the env component
 #: (ISSUE 12 — a ``disable_pallas()`` flip or a ``TFTPU_PALLAS``
 #: change must never serve a stale executable).
-FORMAT_VERSION = 3
+#: v4: the verified-lift state joined the env component (ISSUE 18 —
+#: a ``TFTPU_LIFT`` flip or a synthesis-rule bump swaps a lifted
+#: program for a callback one; the two must never share a key).
+FORMAT_VERSION = 4
 
 __all__ = [
     "FORMAT_VERSION",
@@ -110,6 +113,7 @@ def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
     from ..parallel.distributed import process_topology
 
     from .. import kernels as _kernels
+    from ..plan import lift as _lift
 
     cfg = get_config()
     dev = jax.devices()[0]
@@ -120,6 +124,10 @@ def _env_parts(kind: str, donate: bool, hoisted: bool) -> Dict[str, object]:
         # mode — any flip invalidates every key, because the lowering
         # the cost model picks is baked into the traced program
         "kernels": _kernels.fingerprint_token(),
+        # verified-lift state: enabled flag + synthesis-rule version —
+        # a lifted stage and its callback original trace to different
+        # programs, so a TFTPU_LIFT flip must miss cleanly
+        "lift": _lift.fingerprint_token(),
         "jax": jax.__version__,
         "backend": jax.default_backend(),
         "device_kind": getattr(dev, "device_kind", "unknown"),

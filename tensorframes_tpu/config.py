@@ -140,6 +140,17 @@ class Config:
     # way (the optimizer exists purely for speed; every rewrite is
     # gated on reassoc_safe-style exactness).
     plan_reopt: bool = _env_bool("TFTPU_REOPT", True)
+    # Verified UDF lifting (tensorframes_tpu/analysis/lifting +
+    # plan/lift): numpy UDFs captured as host callbacks are statically
+    # inspected, synthesized into a pure plan-IR Program, and verified
+    # bit-exactly on a bounded boundary-value corpus before
+    # substitution — a verified lift clears the TFG107 fusion barrier
+    # so map→UDF→aggregate chains compile to one dispatch. Anything
+    # that does not verify stays a counted callback barrier with the
+    # decline reason in TFG112. TFTPU_LIFT=0 replays the callback path
+    # for every UDF — the bit-identity oracle (results are identical
+    # either way by construction; the lift exists purely for speed).
+    udf_lifting: bool = _env_bool("TFTPU_LIFT", True)
     # Out-of-core data plane (tensorframes_tpu/blockstore): resident-
     # bytes budget of a BlockStore — blocks past it spill to disk
     # least-recently-used, and the streaming partitioner's peak RSS is

@@ -307,6 +307,12 @@ def program_has_callback(program) -> bool:
     the single-verb ones. Cached on the Program; a trace failure is
     treated as a callback (conservative: never fuse what we cannot
     see)."""
+    # a verified-lifted UDF program is pure jnp by construction — skip
+    # the jaxpr walk (plan/lift primes _tftpu_has_callback too; this
+    # guard keeps the invariant even if the cache attribute is lost on
+    # a Program rebuild, e.g. rename_inputs)
+    if getattr(program, "_tftpu_lifted", False):
+        return False
     cached = getattr(program, "_tftpu_has_callback", None)
     if cached is not None:
         return cached

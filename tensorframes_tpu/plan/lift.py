@@ -1,0 +1,801 @@
+"""Verified UDF lifting: synthesis + bounded bit-exact equivalence.
+
+The static front-end (:mod:`tensorframes_tpu.analysis.lifting`) validates
+a numpy UDF's AST against a closed allowlist; this module turns a
+validated candidate into a pure-jnp Program and *proves* the swap safe
+before it happens:
+
+* **Synthesis** walks the candidate AST with a numpy-as-dtype-oracle
+  evaluator: each op's result dtype is computed by applying the *real*
+  numpy op to zero-size probe arrays (python scalars stay raw so weak
+  promotion matches), operands are explicitly cast, and the jnp
+  counterpart applied — reproducing numpy/NEP50 promotion (int÷int→f64,
+  ``np.sum(int32)``→int64, f32+pyfloat→f32) without hand-derived rules.
+* **Verification** runs both the original numpy function and the
+  synthesized program over a bounded exhaustive corpus on the actual
+  block dtypes — dtype-boundary values (±0.0, finfo/iinfo extremes,
+  ±inf, NaN, the sign-lattice hazard values), block sizes
+  {0,1,2,5,8,13} — and demands *bit exactness*: same dtype, same shape,
+  same bytes. Anything less stays a callback. The envelope is the IEEE
+  *normal* range: XLA flushes subnormals (DAZ/FTZ on CPU and TPU alike)
+  while host numpy keeps gradual underflow, so subnormal bits are
+  backend-defined on BOTH paths and excluded from the corpus rather
+  than letting an unwinnable comparison veto every float lift.
+* **Policy declines** draw the same exactness line the adaptive
+  optimizer's reassoc_safe gate draws: float ``sum``/``mean``/``prod``
+  never lift (numpy's pairwise accumulation order is not bit-stable
+  against an XLA reduce — measured divergence starts at 8 elements);
+  64-bit int ``mean`` doesn't either (numpy computes it in f64, where
+  values past 2^53 round order-sensitively), and float ``min``/``max``
+  don't because a signed-zero tie at the extremum resolves
+  position-dependently in numpy itself (measured: ``np.min([+0.,-0.])``
+  is ``-0`` but ``np.min([-0.,+0.])`` is ``+0``) and order-free in XLA;
+  int/bool min/max/sum are exact (modular for sum), so those lift.
+  Elementwise ``np.minimum``/``np.maximum`` are positional, match
+  exactly, and stay liftable — only the *reductions* are policy-bound.
+
+A lifted Program contains no callback primitive, so it enters the
+existing fusion/pushdown/cost machinery unchanged — a map→UDF→aggregate
+chain compiles to one dispatch. Every decision (lift or decline, with
+the taxonomy reason and offending AST node) lands in a bounded log read
+by ``lint --lift-report`` and the TFG112 rule, and in the
+``tftpu_lift_total`` counter family.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..analysis.lifting import (
+    LiftCandidate,
+    LiftDeclined,
+    detect_mutable_closures,
+    inspect_udf,
+)
+from ..config import get_config
+from ..observability.metrics import counter as _counter
+from ..utils import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "build_udf_program",
+    "fingerprint_token",
+    "lift_log",
+    "clear_lift_log",
+    "lift_report",
+    "LIFT_FORMAT_VERSION",
+]
+
+#: Bumped whenever synthesis or verification semantics change — joins the
+#: compile-cache fingerprint env slot so executables synthesized under
+#: different lifting rules never collide.
+LIFT_FORMAT_VERSION = 1
+
+# Registered at import so expositions always carry the family (a process
+# that never lifted reads 0 — the series does not vanish).
+_LIFT_EVENTS = {
+    outcome: _counter(
+        "tftpu_lift_total",
+        "Verified-lift decisions on captured numpy UDFs, by outcome",
+        labels={"outcome": outcome},
+    )
+    for outcome in ("lifted", "declined")
+}
+
+#: Bounded decision log: one dict per capture-time lift decision
+#: ({"udf", "lifted", "reason", "node", "lineno", "outputs", "wall_s"}).
+#: Read by ``lint --lift-report`` and the TFG112 rule.
+_LIFT_LOG: deque = deque(maxlen=512)
+_LIFT_LOCK = threading.Lock()  # lint: guarded
+
+#: Block sizes of the verification corpus; 8 and 13 straddle numpy's
+#: pairwise-summation unroll width so accumulation-order divergence is
+#: actually exercised, 0/1/2 cover the empty/degenerate edges.
+_CORPUS_SIZES = (0, 1, 2, 5, 8, 13)
+
+#: Distinct cyclic fill phases per corpus size (each input additionally
+#: offsets by its own index, so multi-input UDFs see unaligned values).
+_CORPUS_PHASES = (0, 11)
+
+
+def fingerprint_token() -> dict:
+    """The lifting contribution to the compile-cache environment
+    fingerprint: a config flip or synthesis-rule bump must miss."""
+    return {
+        "enabled": bool(get_config().udf_lifting),
+        "version": LIFT_FORMAT_VERSION,
+    }
+
+
+def _record(udf_name: str, lifted: bool, reason: Optional[str],
+            node: Optional[str], lineno: Optional[int],
+            outputs: Sequence[str], wall_s: float,
+            detail: str = "") -> dict:
+    rec = {
+        "udf": udf_name,
+        "lifted": lifted,
+        "reason": reason,
+        "node": node,
+        "lineno": lineno,
+        "outputs": list(outputs),
+        "wall_s": round(wall_s, 6),
+        "detail": detail,
+    }
+    _LIFT_EVENTS["lifted" if lifted else "declined"].inc()
+    with _LIFT_LOCK:
+        _LIFT_LOG.append(rec)
+    return rec
+
+
+def lift_log() -> List[dict]:
+    """Snapshot of the bounded lift-decision log, oldest first."""
+    with _LIFT_LOCK:
+        return [dict(r) for r in _LIFT_LOG]
+
+
+def clear_lift_log() -> None:
+    with _LIFT_LOCK:
+        _LIFT_LOG.clear()
+
+
+def lift_report() -> str:
+    """The ``lint --lift-report`` payload: one line per decision."""
+    rows = lift_log()
+    if not rows:
+        return "lift-report: no UDF capture decisions recorded"
+    lines = [f"lift-report: {len(rows)} decision(s)"]
+    for r in rows:
+        if r["lifted"]:
+            lines.append(
+                f"  LIFTED   {r['udf']} -> {', '.join(r['outputs']) or '?'}"
+                f" (verify {r['wall_s']:.3f}s)"
+            )
+        else:
+            at = f" at {r['node']}" if r["node"] else ""
+            ln = f" line {r['lineno']}" if r["lineno"] else ""
+            lines.append(
+                f"  DECLINED {r['udf']}: {r['reason']}{at}{ln}"
+                + (f" — {r['detail']}" if r["detail"] else "")
+            )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Output naming shared by the callback wrapper and the synthesizer
+# ---------------------------------------------------------------------------
+
+def as_output_dict(res, fn_name: str) -> Dict[str, object]:
+    """The same naming rule ``program_from_function`` applies: dicts pass
+    through, tuples become ``<name>_<i>``, singles take the UDF name."""
+    if isinstance(res, dict):
+        return dict(res)
+    if isinstance(res, (tuple, list)):
+        return {f"{fn_name}_{i}": v for i, v in enumerate(res)}
+    return {fn_name: res}
+
+
+# ---------------------------------------------------------------------------
+# Synthesis: numpy-as-dtype-oracle AST evaluation
+# ---------------------------------------------------------------------------
+
+class _V:
+    """An evaluated value: the traced jnp side plus a zero-size numpy
+    probe that carries exact numpy promotion semantics. Python scalar
+    constants keep their raw value on both sides (weak typing)."""
+
+    __slots__ = ("jx", "probe", "is_scalar")
+
+    def __init__(self, jx, probe, is_scalar=False):
+        self.jx = jx
+        self.probe = probe
+        self.is_scalar = is_scalar
+
+
+_PY_BINOPS = {
+    ast.Add: lambda a, b: a + b, ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b, ast.Div: lambda a, b: a / b,
+    ast.FloorDiv: lambda a, b: a // b, ast.Mod: lambda a, b: a % b,
+    ast.Pow: lambda a, b: a ** b,
+}
+_NP_BINOPS = {
+    ast.Add: "add", ast.Sub: "subtract", ast.Mult: "multiply",
+    ast.Div: "true_divide", ast.FloorDiv: "floor_divide",
+    ast.Mod: "mod", ast.Pow: "power",
+}
+_CMP_NP = {
+    ast.Eq: "equal", ast.NotEq: "not_equal", ast.Lt: "less",
+    ast.LtE: "less_equal", ast.Gt: "greater", ast.GtE: "greater_equal",
+}
+#: ops whose result is bool but whose operands are used as-is
+_PREDICATES = {
+    "isnan", "isinf", "isfinite",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+}
+_REDUCTIONS = {"sum", "mean", "prod", "min", "max", "amin", "amax"}
+_METHOD_TO_NP = {"sum": "sum", "mean": "mean", "prod": "prod",
+                 "min": "min", "max": "max", "clip": "clip"}
+
+
+def _np_op(name: str):
+    fn = getattr(np, name, None)
+    if fn is None:  # pragma: no cover - allowlist and numpy agree
+        raise LiftDeclined(f"unsupported-call:np.{name}", node="Call")
+    return fn
+
+
+def _jnp_op(name: str):
+    import jax.numpy as jnp
+
+    alias = {"min": "min", "amin": "min", "max": "max", "amax": "max",
+             "abs": "abs", "absolute": "abs", "invert": "invert",
+             "true_divide": "true_divide", "mod": "mod"}
+    fn = getattr(jnp, alias.get(name, name), None)
+    if fn is None:
+        raise LiftDeclined(f"unsupported-call:np.{name}", node="Call")
+    return fn
+
+
+class _Synthesizer:
+    """Evaluate a validated candidate body against jnp feeds, with the
+    numpy dtype oracle deciding every cast. Raises LiftDeclined for the
+    dtype-dependent policy declines (float reductions) the static
+    front-end cannot see."""
+
+    def __init__(self, cand: LiftCandidate, probes: Dict[str, np.ndarray]):
+        self.c = cand
+        self.probes = probes  # param -> zero-size np array
+
+    # -- coercion helpers ---------------------------------------------
+    def _cast(self, v: _V, dtype):
+        import jax.numpy as jnp
+
+        if v.is_scalar:
+            return jnp.asarray(v.probe, dtype=dtype)
+        return v.jx.astype(dtype) if v.jx.dtype != dtype else v.jx
+
+    def _apply_oracle(self, np_name: str, vals: List[_V],
+                      node: ast.AST) -> _V:
+        """Elementwise op: probe numpy for the result dtype, cast every
+        operand to it, run the jnp counterpart, pin the result dtype."""
+        with np.errstate(all="ignore"):
+            probe_res = _np_op(np_name)(*[v.probe for v in vals])
+        if all(v.is_scalar for v in vals):
+            # constant folding on the host: real execution would run this
+            # in numpy before the arrays ever see it
+            return _V(probe_res, probe_res, is_scalar=True)
+        dt_res = np.asarray(probe_res).dtype
+        self._check_dtype(dt_res, node)
+        jargs = [self._cast(v, dt_res) for v in vals]
+        out = _jnp_op(np_name)(*jargs)
+        if out.dtype != dt_res:
+            out = out.astype(dt_res)
+        return _V(out, np.zeros(np.asarray(probe_res).shape
+                                if np.asarray(probe_res).ndim else (),
+                                dtype=dt_res))
+
+    def _apply_predicate(self, np_name: str, vals: List[_V],
+                         node: ast.AST) -> _V:
+        with np.errstate(all="ignore"):
+            probe_res = _np_op(np_name)(*[v.probe for v in vals])
+        if all(v.is_scalar for v in vals):
+            return _V(probe_res, probe_res, is_scalar=True)
+        jargs = [v.probe if v.is_scalar else v.jx for v in vals]
+        out = _jnp_op(np_name)(*jargs)
+        return _V(out, np.zeros(np.asarray(probe_res).shape, dtype=bool))
+
+    def _apply_compare(self, np_name: str, a: _V, b: _V,
+                       node: ast.AST) -> _V:
+        if a.is_scalar and b.is_scalar:
+            with np.errstate(all="ignore"):
+                r = _np_op(np_name)(a.probe, b.probe)
+            return _V(r, r, is_scalar=True)
+        # numpy compares in the common operand type
+        common = np.result_type(a.probe, b.probe)
+        self._check_dtype(common, node)
+        with np.errstate(all="ignore"):
+            probe_res = _np_op(np_name)(a.probe, b.probe)
+        out = _jnp_op(np_name)(self._cast(a, common), self._cast(b, common))
+        return _V(out, np.zeros(np.asarray(probe_res).shape,
+                                dtype=np.asarray(probe_res).dtype))
+
+    def _apply_reduction(self, np_name: str, v: _V, node: ast.AST) -> _V:
+        import jax.numpy as jnp
+
+        if v.is_scalar:
+            raise LiftDeclined("unsupported-syntax:scalar-reduction",
+                               node="Call",
+                               lineno=getattr(node, "lineno", None))
+        in_dtype = v.probe.dtype
+        canon = {"amin": "min", "amax": "max"}.get(np_name, np_name)
+        if canon in ("sum", "mean", "prod") and np.issubdtype(
+            in_dtype, np.floating
+        ):
+            raise LiftDeclined(
+                "float-reduction", node="Call",
+                lineno=getattr(node, "lineno", None),
+                detail=f"np.{canon} over {in_dtype} accumulates in an "
+                       "order numpy (pairwise) and XLA do not share — "
+                       "not bit-stable, stays a callback (same exactness "
+                       "line as the optimizer's reassoc_safe gate)")
+        if canon in ("min", "max") and np.issubdtype(
+            in_dtype, np.floating
+        ):
+            # measured: np.min([+0.,-0.]) returns -0 but
+            # np.min([-0.,+0.]) returns +0 (position-dependent), while
+            # XLA's reduce returns -0 either way — a signed-zero tie at
+            # the extremum makes the float result order-sensitive on
+            # numpy's OWN side, so no order-free synthesis can match
+            raise LiftDeclined(
+                "float-reduction", node="Call",
+                lineno=getattr(node, "lineno", None),
+                detail=f"np.{canon} over {in_dtype}: signed-zero ties "
+                       "at the extremum resolve position-dependently in "
+                       "numpy and order-free in XLA — not bit-stable, "
+                       "stays a callback")
+        if canon in ("min", "max"):
+            out = getattr(jnp, canon)(v.jx)
+            dt_res = in_dtype
+        else:
+            # sum/prod accumulate in the numpy result dtype (int64 for
+            # int/bool input — modular, order-free); mean accumulates
+            # exactly in f64 for int inputs small enough to stay < 2^53
+            with np.errstate(all="ignore"):
+                probe_res = _np_op(canon)(np.zeros((0,), in_dtype)) \
+                    if canon != "mean" else np.float64(0)
+            if canon == "mean" and np.dtype(in_dtype).itemsize >= 8:
+                # int64 mean is computed in f64 on both sides, but
+                # values past 2^53 are inexact there and numpy's
+                # pairwise order then rounds differently from an XLA
+                # reduce — same exactness line as float reductions
+                raise LiftDeclined(
+                    "float-reduction", node="Call",
+                    lineno=getattr(node, "lineno", None),
+                    detail=f"np.mean over {in_dtype} accumulates in "
+                           "float64, inexact past 2^53 and therefore "
+                           "order-sensitive — not bit-stable, stays a "
+                           "callback")
+            if canon == "mean":
+                # numpy divides the exact f64 sum by the count;
+                # jnp.mean multiplies by the reciprocal, and XLA's
+                # algebraic simplifier rewrites divide-by-constant the
+                # same way — off by one ulp on e.g.
+                # mean([7,100,-1,-2,-7]). The optimization barrier
+                # keeps the true division in the compiled program.
+                from jax import lax
+
+                dt_res = np.mean(np.zeros((1,), in_dtype)).dtype
+                self._check_dtype(dt_res, node)
+                total = jnp.sum(v.jx.astype(dt_res))
+                total, count = lax.optimization_barrier(
+                    (total, jnp.asarray(float(v.jx.size), dt_res)))
+                out = total / count
+            else:
+                dt_res = np.asarray(probe_res).dtype
+                self._check_dtype(dt_res, node)
+                out = getattr(jnp, canon)(v.jx.astype(dt_res))
+            if out.dtype != dt_res:
+                out = out.astype(dt_res)
+        return _V(out, np.zeros((), dtype=dt_res))
+
+    def _check_dtype(self, dtype, node) -> None:
+        d = np.dtype(dtype)
+        ok = d == np.bool_ or np.issubdtype(d, np.integer) or d in (
+            np.dtype(np.float16), np.dtype(np.float32), np.dtype(np.float64)
+        )
+        if not ok:
+            raise LiftDeclined(
+                "unsupported-dtype", node=type(node).__name__,
+                lineno=getattr(node, "lineno", None),
+                detail=f"{d} has no verified lowering")
+
+    # -- evaluation ---------------------------------------------------
+    def run(self, feeds) -> Dict[str, object]:
+        env: Dict[str, _V] = {}
+        for p in self.c.params:
+            env[p] = _V(feeds[p], self.probes[p])
+        for name, val in self.c.consts.items():
+            env[name] = _V(val, val, is_scalar=True)
+        ret: Optional[ast.expr] = None
+        for st in self.c.body:
+            if isinstance(st, ast.Assign):
+                env[st.targets[0].id] = self._eval(st.value, env)
+            else:  # Return — validator guarantees it is last
+                ret = st.value
+        assert ret is not None
+        return self._outputs(ret, env)
+
+    def _outputs(self, value: ast.expr, env) -> Dict[str, object]:
+        if isinstance(value, ast.Dict):
+            return {k.value: self._eval(v, env).jx
+                    for k, v in zip(value.keys, value.values)}
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return {f"{self.c.name}_{i}": self._eval(v, env).jx
+                    for i, v in enumerate(value.elts)}
+        return {self.c.name: self._eval(value, env).jx}
+
+    def _eval(self, node: ast.expr, env: Dict[str, _V]) -> _V:
+        if isinstance(node, ast.Name):
+            return env[node.id]
+        if isinstance(node, ast.Constant):
+            return _V(node.value, node.value, is_scalar=True)
+        if isinstance(node, ast.BinOp):
+            a = self._eval(node.left, env)
+            b = self._eval(node.right, env)
+            if a.is_scalar and b.is_scalar:
+                # python evaluates scalar-scalar before numpy sees it:
+                # stay weak by using the python operator
+                r = _PY_BINOPS[type(node.op)](a.probe, b.probe)
+                return _V(r, r, is_scalar=True)
+            return self._apply_oracle(_NP_BINOPS[type(node.op)], [a, b],
+                                      node)
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval(node.operand, env)
+            if v.is_scalar:
+                r = (-v.probe if isinstance(node.op, ast.USub)
+                     else +v.probe if isinstance(node.op, ast.UAdd)
+                     else ~v.probe)
+                return _V(r, r, is_scalar=True)
+            name = {"USub": "negative", "UAdd": "positive",
+                    "Invert": "invert"}[type(node.op).__name__]
+            return self._apply_oracle(name, [v], node)
+        if isinstance(node, ast.Compare):
+            a = self._eval(node.left, env)
+            b = self._eval(node.comparators[0], env)
+            return self._apply_compare(_CMP_NP[type(node.ops[0])], a, b,
+                                       node)
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        raise LiftDeclined(f"unsupported-syntax:{type(node).__name__}",
+                           node=type(node).__name__,
+                           lineno=getattr(node, "lineno", None))
+
+    def _call(self, node: ast.Call, env) -> _V:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in self.c.np_aliases:
+            name = f.attr
+            args = [self._eval(a, env) for a in node.args]
+        elif isinstance(f, ast.Attribute):
+            # method spelling x.sum() — receiver first, then args
+            name = _METHOD_TO_NP[f.attr]
+            args = [self._eval(f.value, env)] + [
+                self._eval(a, env) for a in node.args]
+        elif isinstance(f, ast.Name) and f.id == "abs":
+            name = "abs"
+            args = [self._eval(a, env) for a in node.args]
+        else:  # pragma: no cover - validator blocks this
+            raise LiftDeclined("unsupported-syntax:Call", node="Call",
+                               lineno=node.lineno)
+        canon = {"absolute": "abs"}.get(name, name)
+        if canon in _REDUCTIONS:
+            if len(args) != 1:
+                raise LiftDeclined(
+                    "unsupported-syntax:reduction-arguments", node="Call",
+                    lineno=node.lineno,
+                    detail="only full single-array reductions lift")
+            return self._apply_reduction(canon, args[0], node)
+        if canon in _PREDICATES:
+            return self._apply_predicate(canon, args, node)
+        if canon == "where" and len(args) == 3:
+            cond, x, y = args
+            with np.errstate(all="ignore"):
+                probe_res = np.where(cond.probe, x.probe, y.probe)
+            dt_res = probe_res.dtype
+            self._check_dtype(dt_res, node)
+            import jax.numpy as jnp
+
+            c = cond.probe if cond.is_scalar else (
+                cond.jx if cond.jx.dtype == np.bool_
+                else cond.jx.astype(bool))
+            out = jnp.where(c, self._cast(x, dt_res), self._cast(y, dt_res))
+            return _V(out, np.zeros(probe_res.shape, dtype=dt_res))
+        return self._apply_oracle(canon, args, node)
+
+
+# ---------------------------------------------------------------------------
+# Verification corpus
+# ---------------------------------------------------------------------------
+
+def _boundary_pool(dtype: np.dtype) -> np.ndarray:
+    """Deterministic 1-D pool of hazard values for one dtype: ±0.0,
+    ±1, finfo/iinfo extremes, subnormals, ±inf, NaN, and the PR 3
+    sign-lattice hazard band (negatives / signed zeros / tiny
+    positives)."""
+    d = np.dtype(dtype)
+    if d == np.bool_:
+        return np.array([True, False, True, True, False], dtype=d)
+    if np.issubdtype(d, np.integer):
+        info = np.iinfo(d)
+        vals = [0, 1, 2, 3, 5, 7, 100]
+        for v in (-1, -2, -7, -100):
+            if v >= info.min:
+                vals.append(v)
+        vals += [info.min, info.max, info.min + 1, info.max - 1]
+        vals = [v for v in vals if info.min <= v <= info.max]
+        return np.array(vals, dtype=d)
+    info = np.finfo(d)
+    # The verified envelope is the IEEE NORMAL range: subnormal inputs
+    # are deliberately absent. XLA executes with DAZ/FTZ (a plain add
+    # flushes a subnormal operand to zero on CPU; TPU vector units flush
+    # f32 subnormals in hardware) while host numpy keeps gradual
+    # underflow, so subnormal bits are backend-defined and can NEVER
+    # verify against the callback oracle — including them would turn
+    # every float lift into a decline. ±tiny (the smallest normal) stays
+    # in the pool to pin the underflow boundary itself.
+    vals = [
+        0.0, -0.0, 1.0, -1.0, 0.5, -0.5, 2.0, -2.5, 7.0, 3.140625,
+        float(info.max), float(-info.max), float(info.tiny),
+        float(-info.tiny), float(info.eps), float(1.0 + info.eps),
+        # sign-lattice hazard band: values whose sign/zero classification
+        # diverges across naive rewrites
+        -3.5, -1e-30, 1e-30, -1e-7, 1e-7,
+        float("inf"), float("-inf"), float("nan"),
+    ]
+    arr = np.array(vals, dtype=d)
+    # narrow dtypes (f16) turn some hazard values subnormal on
+    # conversion — drop those, keep zeros/inf/NaN and normals
+    keep = ~np.isfinite(arr) | (arr == 0) | (np.abs(arr) >= info.tiny)
+    return arr[keep]
+
+
+def _corpus_block(pool: np.ndarray, n: int, trailing: Tuple[int, ...],
+                  phase: int) -> np.ndarray:
+    """Cyclic fill of a (n, *trailing) block from the pool, rolled by
+    ``phase`` so multiple inputs never align."""
+    total = n
+    for t in trailing:
+        total *= t
+    if total == 0:
+        return np.zeros((n,) + trailing, dtype=pool.dtype)
+    idx = (np.arange(total) + phase) % len(pool)
+    return pool[idx].reshape((n,) + trailing)
+
+
+def _input_shapes(spec) -> Tuple[int, ...]:
+    """Concrete trailing dims of a block spec (lead dim is the corpus
+    size; Unknown trailing dims probe at 3)."""
+    from ..shape import Unknown
+
+    dims = list(spec.shape.dims)[1:]  # drop the lead (block) dim
+    return tuple(3 if d is Unknown or d == Unknown else int(d)
+                 for d in dims)
+
+
+def verify_candidate(cand: LiftCandidate, specs: Dict[str, object],
+                     synth_fn: Callable) -> None:
+    """Bounded exhaustive equivalence: run the original numpy UDF and
+    the synthesized jnp function over the boundary corpus and demand
+    bit-exact agreement (dtype + shape + bytes) on every output.
+    Raises LiftDeclined('verify-mismatch' | 'probe-failure') on any
+    divergence; returns silently when every case agrees."""
+    import jax
+    import jax.numpy as jnp
+
+    jitted = jax.jit(synth_fn)
+    sizes = [s for s in _CORPUS_SIZES if s > 0 or not cand.has_reduction]
+    pools = {}
+    for p in cand.params:
+        spec = specs[p]
+        d = np.dtype(spec.dtype.np_dtype)
+        pools[p] = _boundary_pool(d)
+
+    for n in sizes:
+        for phase in _CORPUS_PHASES:
+            feeds_np = {}
+            for i, p in enumerate(cand.params):
+                trailing = _input_shapes(specs[p])
+                feeds_np[p] = _corpus_block(
+                    pools[p], n, trailing, phase + 5 * i + n)
+            try:
+                with np.errstate(all="ignore"):
+                    ref = as_output_dict(
+                        cand.fn(*[feeds_np[p] for p in cand.params]),
+                        cand.name)
+                ref = {k: np.asarray(v) for k, v in ref.items()}
+            except Exception as e:
+                raise LiftDeclined(
+                    "probe-failure", node=None,
+                    detail=f"reference raised {type(e).__name__} on "
+                           f"corpus block n={n}: {e}")
+            try:
+                got = jitted({p: jnp.asarray(feeds_np[p])
+                              for p in cand.params})
+                got = {k: np.asarray(v) for k, v in got.items()}
+            except LiftDeclined:
+                # dtype-dependent policy declines (float-reduction,
+                # unsupported-dtype) surface during tracing — keep the
+                # taxonomy reason, do not relabel as probe-failure
+                raise
+            except Exception as e:
+                raise LiftDeclined(
+                    "probe-failure", node=None,
+                    detail=f"synthesized program raised "
+                           f"{type(e).__name__} on corpus block n={n}: "
+                           f"{e}")
+            if set(ref) != set(got):
+                raise LiftDeclined(
+                    "verify-mismatch",
+                    detail=f"output names differ: {sorted(ref)} vs "
+                           f"{sorted(got)}")
+            for k in ref:
+                r, g = ref[k], got[k]
+                if r.dtype != g.dtype or r.shape != g.shape \
+                        or r.tobytes() != g.tobytes():
+                    raise LiftDeclined(
+                        "verify-mismatch",
+                        detail=f"output {k!r} diverges on corpus block "
+                               f"n={n} phase={phase}: reference "
+                               f"{r.dtype}{list(r.shape)} vs synthesized "
+                               f"{g.dtype}{list(g.shape)} (bit-exact "
+                               "comparison)")
+
+
+# ---------------------------------------------------------------------------
+# Program construction
+# ---------------------------------------------------------------------------
+
+def _udf_params(fn, specs: Dict[str, object]) -> List[str]:
+    import inspect as _inspect
+
+    sig = _inspect.signature(fn)
+    params = [p.name for p in sig.parameters.values()
+              if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)]
+    missing = [p for p in params if p not in specs]
+    if missing:
+        raise ValueError(
+            f"numpy_udf parameter(s) {missing} do not match any known "
+            f"input; available: {sorted(specs)}")
+    return params
+
+
+def _build_callback_program(fn, params: List[str],
+                            specs: Dict[str, object], fn_name: str):
+    """The reference path: the UDF runs on host per block behind
+    ``jax.pure_callback``. Output shapes/dtypes are discovered by
+    probing the numpy function on small ones-blocks at trace time (two
+    probe sizes disambiguate batch-covariant dims, the analyze_program
+    rule)."""
+    import jax
+
+    from ..program import Program
+
+    def _probe_shapes(lead_shapes):
+        probe_ins = [np.ones(s, dtype=np.dtype(specs[p].dtype.np_dtype))
+                     for p, s in zip(params, lead_shapes)]
+        with np.errstate(all="ignore"):
+            out = as_output_dict(fn(*probe_ins), fn_name)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def callback_fn(feeds):
+        arrs = [feeds[p] for p in params]
+        shapes = [tuple(int(d) for d in a.shape) for a in arrs]
+
+        def with_lead(n):
+            return [((n,) + s[1:]) if len(s) else s for s in shapes]
+
+        try:
+            out_a = _probe_shapes(with_lead(3))
+            out_b = _probe_shapes(with_lead(4))
+        except Exception as e:
+            raise TypeError(
+                f"numpy_udf {fn_name!r} failed shape probing "
+                f"({type(e).__name__}: {e}); the UDF must be total on "
+                "ones-filled blocks") from e
+        lead = shapes[0][0] if shapes and shapes[0] else None
+        result_shapes = {}
+        for k, va in out_a.items():
+            vb = out_b[k]
+            dims = tuple(
+                (lead if (da != db and lead is not None) else da)
+                for da, db in zip(va.shape, vb.shape))
+            result_shapes[k] = jax.ShapeDtypeStruct(dims, va.dtype)
+
+        def host(*xs):
+            with np.errstate(all="ignore"):
+                out = as_output_dict(fn(*[np.asarray(x) for x in xs]),
+                                     fn_name)
+            return {k: np.asarray(v, dtype=result_shapes[k].dtype)
+                    for k, v in out.items()}
+
+        res = jax.pure_callback(host, result_shapes, *arrs)
+        return dict(res)
+
+    inputs = [specs[p] for p in params]
+    return Program(callback_fn, inputs)
+
+
+def _build_lifted_program(cand: LiftCandidate, params: List[str],
+                          specs: Dict[str, object]):
+    from ..program import Program
+
+    probes = {
+        p: np.zeros((0,) + _input_shapes(specs[p]),
+                    dtype=np.dtype(specs[p].dtype.np_dtype))
+        for p in params
+    }
+
+    def lifted_fn(feeds):
+        return _Synthesizer(cand, probes).run(feeds)
+
+    inputs = [specs[p] for p in params]
+    return Program(lifted_fn, inputs), lifted_fn
+
+
+def build_udf_program(fn, specs: Dict[str, object], *,
+                      subject: str = "") -> "object":
+    """Capture a numpy UDF as a Program: lifted when synthesis verifies
+    bit-exactly, a counted host callback otherwise.
+
+    ``specs`` maps input names to TensorSpecs (block shapes). The
+    returned Program is fully analyzed; lifted programs carry
+    ``_tftpu_lifted=True`` (no callback primitive — fuses), callback
+    programs carry ``_tftpu_lift_info`` with the taxonomy decline
+    reason that TFG112 and ``--lift-report`` surface.
+    """
+    from .. import dtypes as dt
+    from ..program import Program, TensorSpec, analyze_program
+
+    fn_name = getattr(fn, "__name__", "udf")
+    if fn_name == "<lambda>":
+        fn_name = "udf"
+    params = _udf_params(fn, specs)
+    cfg = get_config()
+
+    demoted_specs = specs
+    if dt.demotion_active():
+        demoted_specs = {
+            name: TensorSpec(s.name, dt.demote(s.dtype), s.shape)
+            for name, s in specs.items()
+        }
+
+    t0 = time.perf_counter()
+    info: Optional[dict] = None
+    lifted_program = None
+    if not cfg.udf_lifting:
+        info = _record(fn_name, False, "lifting-disabled", None, None,
+                       [], time.perf_counter() - t0,
+                       detail="config.udf_lifting is off (TFTPU_LIFT=0)")
+    elif dt.demotion_active():
+        info = _record(fn_name, False, "demotion-active", None, None,
+                       [], time.perf_counter() - t0,
+                       detail="x64 demotion rewrites input dtypes at the "
+                              "device boundary; the numpy reference "
+                              "semantics are not reproducible bit-exactly")
+    else:
+        try:
+            cand = inspect_udf(fn)
+            program, lifted_fn = _build_lifted_program(
+                cand, params, specs)
+            verify_candidate(cand, specs, lifted_fn)
+            lifted_program = analyze_program(program)
+            info = _record(
+                fn_name, True, None, None, None,
+                [o.name for o in lifted_program.outputs],
+                time.perf_counter() - t0)
+        except LiftDeclined as d:
+            info = _record(fn_name, False, d.reason, d.node, d.lineno,
+                           [], time.perf_counter() - t0, detail=d.detail)
+        except Exception as e:  # pragma: no cover - synthesis bug guard
+            logger.warning("lift synthesis failed unexpectedly: %s", e)
+            info = _record(fn_name, False, "probe-failure", None, None,
+                           [], time.perf_counter() - t0,
+                           detail=f"{type(e).__name__}: {e}")
+
+    if lifted_program is not None:
+        lifted_program._tftpu_lifted = True
+        lifted_program._tftpu_has_callback = False
+        lifted_program._tftpu_lift_info = info
+        return lifted_program
+
+    program = _build_callback_program(fn, params, demoted_specs, fn_name)
+    program = analyze_program(program)
+    program._tftpu_has_callback = True
+    program._tftpu_lift_info = info
+    return program

@@ -997,9 +997,13 @@ def execute_plan(node: ir.PlanNode) -> List[Dict[str, object]]:
     prof = _profile_push() if fp else None
     t_exec = time.perf_counter()
     try:
-        with ir.lowering():
-            cur = _execute_plans(source, plans, fusion_on, fp)
-        out = [{n: b[n] for n in final_names} for b in cur.blocks()]
+        # strategy-wall observations inside this dispatch attribute to
+        # THIS pipeline (fingerprint prefix) as well as the host-global
+        # table: per-workload keying, ISSUE 18 (v2 sidecar format)
+        with _stats.workload_scope(fp[:12] if fp else None):
+            with ir.lowering():
+                cur = _execute_plans(source, plans, fusion_on, fp)
+            out = [{n: b[n] for n in final_names} for b in cur.blocks()]
     finally:
         entries = _profile_pop(prof) if prof is not None else None
     wall = time.perf_counter() - t_exec

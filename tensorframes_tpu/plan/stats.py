@@ -36,6 +36,7 @@ import json
 import os
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from ..observability.metrics import counter as _counter
@@ -54,6 +55,9 @@ __all__ = [
     "observe_strategy_wall",
     "strategy_walls",
     "reset_strategy_walls",
+    "workload_scope",
+    "current_workload",
+    "SW_FORMAT_VERSION",
     "STRATEGY_WALL_ALPHA",
     "STRATEGY_WALL_MIN_SAMPLES",
     "STRATEGY_STALE_OBS",
@@ -320,6 +324,7 @@ def clear_memory() -> None:
         _MEM.clear()
     with _SW_LOCK:
         _SW.clear()
+        _SW_WL.clear()
         _SW_LOADED = False
 
 
@@ -337,7 +342,20 @@ def clear_memory() -> None:
 # records. Entries not refreshed within STRATEGY_STALE_OBS observations
 # of their decision are stale and dropped (counted as quarantine), the
 # same hygiene the selectivity records get from _valid().
+#
+# v2 adds PER-WORKLOAD tables keyed by a chain-fingerprint prefix: a
+# join-heavy pipeline and a pointwise scoring pipeline can legitimately
+# disagree about, say, per-block vs concat epilogue on the same host.
+# ``workload_scope(fp[:12])`` (installed by execute_plan around each
+# dispatch) routes observations into BOTH the workload table and the
+# global one; lookups prefer the workload table only once it is
+# evidence-grade (≥ STRATEGY_WALL_MIN_SAMPLES samples on ≥ 2
+# strategies — one-sided evidence can't rank), else fall back to the
+# global table. Old v1 sidecars quarantine on load (format bump).
 
+#: Strategy-wall sidecar format; a bump quarantines old sidecars.
+#: (v1 → v2: per-workload tables joined the global one, ISSUE 18.)
+SW_FORMAT_VERSION = 2
 #: EWMA smoothing factor for observed strategy walls.
 STRATEGY_WALL_ALPHA = 0.3
 #: Minimum samples per strategy before a latency-driven flip may engage.
@@ -349,7 +367,31 @@ STRATEGY_STALE_OBS = 256
 
 _SW_LOCK = threading.Lock()
 _SW: Dict[str, dict] = {}
+# workload fingerprint-prefix → {decision: {"obs": int, "strategies": {}}}
+_SW_WL: Dict[str, Dict[str, dict]] = {}  # lint: guarded (under _SW_LOCK)
 _SW_LOADED = False
+# the active workload scope is per-thread: prefetch workers dispatching
+# different chains concurrently must not cross-attribute their walls
+_SW_SCOPE = threading.local()
+
+
+@contextmanager
+def workload_scope(workload: Optional[str]):
+    """Attribute strategy-wall observations on this thread to
+    ``workload`` (a chain-fingerprint prefix) for the duration.
+    ``None`` is a no-op scope (observations stay global-only).
+    Scopes nest; the innermost wins."""
+    prev = getattr(_SW_SCOPE, "wl", None)
+    _SW_SCOPE.wl = workload
+    try:
+        yield
+    finally:
+        _SW_SCOPE.wl = prev
+
+
+def current_workload() -> Optional[str]:
+    """The workload key observations on this thread attribute to."""
+    return getattr(_SW_SCOPE, "wl", None)
 
 
 def _sw_path() -> Optional[str]:
@@ -360,12 +402,27 @@ def _sw_path() -> Optional[str]:
 
 
 def _sw_valid(rec: object) -> bool:
+    # v1 sidecars (no "workloads" slot, pre-workload keying) quarantine:
+    # their global EWMAs may encode walls a single dominant workload
+    # produced, which is exactly the attribution bug v2 fixes
     return (
         isinstance(rec, dict)
-        and rec.get("v") == FORMAT_VERSION
+        and rec.get("v") == SW_FORMAT_VERSION
         and rec.get("kind") == "strategy_walls"
         and isinstance(rec.get("tables"), dict)
+        and isinstance(rec.get("workloads"), dict)
     )
+
+
+def _sw_merge_table(dst: Dict[str, dict], tables: dict) -> None:
+    for decision, table in tables.items():
+        if not isinstance(table, dict):
+            continue
+        mem = dst.setdefault(decision, {"obs": 0, "strategies": {}})
+        mem["obs"] = max(int(mem.get("obs", 0)), int(table.get("obs", 0)))
+        for strat, ent in (table.get("strategies") or {}).items():
+            if isinstance(ent, dict) and "ewma_s" in ent:
+                mem["strategies"].setdefault(strat, dict(ent))
 
 
 def _sw_load_locked() -> None:
@@ -388,18 +445,13 @@ def _sw_load_locked() -> None:
         _quarantine(path, "stale (format/kind mismatch)")
         return
     _SIDECAR_EVENTS["load"].inc()
-    for decision, table in rec["tables"].items():
-        if not isinstance(table, dict):
-            continue
-        mem = _SW.setdefault(decision, {"obs": 0, "strategies": {}})
-        mem["obs"] = max(int(mem.get("obs", 0)), int(table.get("obs", 0)))
-        for strat, ent in (table.get("strategies") or {}).items():
-            if isinstance(ent, dict) and "ewma_s" in ent:
-                mem["strategies"].setdefault(strat, dict(ent))
+    _sw_merge_table(_SW, rec["tables"])
+    for wl, tables in rec["workloads"].items():
+        if isinstance(tables, dict):
+            _sw_merge_table(_SW_WL.setdefault(wl, {}), tables)
 
 
-def _sw_prune_locked(decision: str) -> None:
-    table = _SW.get(decision)
+def _sw_prune_one_locked(table: Optional[dict], decision: str) -> None:
     if not table:
         return
     obs = int(table.get("obs", 0))
@@ -417,29 +469,52 @@ def _sw_prune_locked(decision: str) -> None:
         )
 
 
+def _sw_prune_locked(decision: str) -> None:
+    _sw_prune_one_locked(_SW.get(decision), decision)
+    for tables in _SW_WL.values():
+        _sw_prune_one_locked(tables.get(decision), decision)
+
+
+def _sw_fold_locked(table: dict, strategy: str, wall_s: float) -> None:
+    table["obs"] = int(table.get("obs", 0)) + 1
+    ent = table["strategies"].get(strategy)
+    if ent is None:
+        ent = {"ewma_s": float(wall_s), "n": 0}
+        table["strategies"][strategy] = ent
+    else:
+        a = STRATEGY_WALL_ALPHA
+        ent["ewma_s"] = a * float(wall_s) + (1.0 - a) * float(ent["ewma_s"])
+    ent["ewma_s"] = round(float(ent["ewma_s"]), 9)
+    ent["n"] = int(ent.get("n", 0)) + 1
+    ent["last_obs"] = table["obs"]
+
+
 def observe_strategy_wall(decision: str, strategy: str,
                           wall_s: float) -> None:
     """Fold one observed dispatch wall into the (decision, strategy)
-    EWMA and persist the table (best-effort). No-op when re-optimization
-    is disabled — TFTPU_REOPT=0 freezes the static cost model."""
+    EWMA — the global table always, and the active :func:`workload_scope`
+    table too when one is installed — and persist both (best-effort).
+    No-op when re-optimization is disabled — TFTPU_REOPT=0 freezes the
+    static cost model."""
     if not reopt_enabled():
         return
+    wl = current_workload()
     with _SW_LOCK:
         _sw_load_locked()
-        table = _SW.setdefault(decision, {"obs": 0, "strategies": {}})
-        table["obs"] = int(table.get("obs", 0)) + 1
-        ent = table["strategies"].get(strategy)
-        if ent is None:
-            ent = {"ewma_s": float(wall_s), "n": 0}
-            table["strategies"][strategy] = ent
-        else:
-            a = STRATEGY_WALL_ALPHA
-            ent["ewma_s"] = a * float(wall_s) + (1.0 - a) * float(ent["ewma_s"])
-        ent["ewma_s"] = round(float(ent["ewma_s"]), 9)
-        ent["n"] = int(ent.get("n", 0)) + 1
-        ent["last_obs"] = table["obs"]
+        _sw_fold_locked(
+            _SW.setdefault(decision, {"obs": 0, "strategies": {}}),
+            strategy, wall_s,
+        )
+        if wl is not None:
+            _sw_fold_locked(
+                _SW_WL.setdefault(wl, {}).setdefault(
+                    decision, {"obs": 0, "strategies": {}}
+                ),
+                strategy, wall_s,
+            )
         _sw_prune_locked(decision)
         snapshot = copy.deepcopy(_SW)
+        wl_snapshot = copy.deepcopy(_SW_WL)
     path = _sw_path()
     if path is None:
         return
@@ -447,8 +522,9 @@ def observe_strategy_wall(decision: str, strategy: str,
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
-            json.dump({"v": FORMAT_VERSION, "kind": "strategy_walls",
-                       "tables": snapshot}, f, sort_keys=True)
+            json.dump({"v": SW_FORMAT_VERSION, "kind": "strategy_walls",
+                       "tables": snapshot, "workloads": wl_snapshot},
+                      f, sort_keys=True)
         os.replace(tmp, path)
         _SIDECAR_EVENTS["store"].inc()
     except OSError as e:  # pragma: no cover - disk-full etc.
@@ -465,6 +541,7 @@ def reset_strategy_walls(unlink_sidecar: bool = True) -> None:
     global _SW_LOADED
     with _SW_LOCK:
         _SW.clear()
+        _SW_WL.clear()
         _SW_LOADED = True  # do not re-merge the file being dropped
     if not unlink_sidecar:
         return
@@ -478,13 +555,28 @@ def reset_strategy_walls(unlink_sidecar: bool = True) -> None:
 
 def strategy_walls(decision: str) -> Dict[str, dict]:
     """Observed-wall entries for one decision: ``{strategy: {"ewma_s",
-    "n", "last_obs"}}``, stale entries already dropped. Empty when
-    re-optimization is disabled or nothing was observed. Never raises."""
+    "n", "last_obs"}}``, stale entries already dropped. Inside a
+    :func:`workload_scope`, the workload's own table answers — but only
+    once it is evidence-grade (≥ STRATEGY_WALL_MIN_SAMPLES samples on
+    ≥ 2 strategies; a table that has only ever seen one strategy cannot
+    rank alternatives) — else the global table is the fallback. Empty
+    when re-optimization is disabled or nothing was observed. Never
+    raises."""
     if not reopt_enabled():
         return {}
+    wl = current_workload()
     with _SW_LOCK:
         _sw_load_locked()
         _sw_prune_locked(decision)
+        if wl is not None:
+            table = (_SW_WL.get(wl) or {}).get(decision)
+            if table:
+                ranked = [
+                    e for e in table["strategies"].values()
+                    if int(e.get("n", 0)) >= STRATEGY_WALL_MIN_SAMPLES
+                ]
+                if len(ranked) >= 2:
+                    return copy.deepcopy(table["strategies"])
         table = _SW.get(decision)
         if not table:
             return {}

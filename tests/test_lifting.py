@@ -1,0 +1,535 @@
+"""Verified UDF lifting (ISSUE 18): numpy host-callback UDFs compile
+into the plan IR via synthesis + bounded equivalence checking.
+
+The contract under test, in the paper's terms: a ``numpy_udf`` stage is
+a fusion barrier (host callback) UNLESS the static pass can (a) inspect
+its Python into the closed allowlist of elementwise/reduction numpy
+ops, (b) synthesize an equivalent plan-IR program, and (c) verify the
+synthesis BIT-EXACTLY against the real numpy function on a bounded
+corpus of the actual block dtypes (boundary values, NaN/Inf, empty and
+ragged-edge blocks). Lift only on proof; every decline carries a named
+TFG112 reason; ``TFTPU_LIFT=0`` replays the callback path as the
+bit-identity oracle.
+
+* **liftable corpus** — arith/compare/where/clip chains and int
+  reductions across int/float/bool dtypes lift, and the lifted run is
+  bit-identical to the callback run (dtype + shape + payload bytes);
+* **decline corpus** — loops, data-dependent branches, np.random,
+  mutable closures, augmented assignment, float reductions each decline
+  with the right reason (never a wrong answer, never a silent fall-through);
+* **plan integration** — a fully-lifted chain reports ZERO fusion
+  barriers (TFG107 counter clean) and TFG112 surfaces the decisions in
+  ``lint_plan``; the lift token keys the compile-cache fingerprint;
+* **per-workload strategy walls** (same PR) — observed-wall lookups
+  prefer the workload's own evidence-grade table and fall back to the
+  host-global one; v1 sidecars quarantine on the format bump.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+import tensorframes_tpu.ops.verbs as V
+from tensorframes_tpu.analysis.diagnostics import DIAGNOSTIC_LOG
+from tensorframes_tpu.ops.verbs import numpy_udf
+from tensorframes_tpu.plan import ir as plan_ir
+from tensorframes_tpu.plan import lift as plan_lift
+from tensorframes_tpu.plan import stats as plan_stats
+
+
+@pytest.fixture(autouse=True)
+def _lift_state():
+    """Every test starts lifting-enabled with an empty decision log and
+    leaves the config the way it found it."""
+    was = tfs.configure().udf_lifting
+    tfs.configure(udf_lifting=True)
+    plan_lift.clear_lift_log()
+    yield
+    tfs.configure(udf_lifting=was)
+
+
+def _assert_bit_identical(blocks_a, blocks_b):
+    assert len(blocks_a) == len(blocks_b)
+    for ba, bb in zip(blocks_a, blocks_b):
+        assert sorted(ba) == sorted(bb)
+        for k in ba:
+            va, vb = np.asarray(ba[k]), np.asarray(bb[k])
+            assert va.dtype == vb.dtype, (k, va.dtype, vb.dtype)
+            assert va.shape == vb.shape, (k, va.shape, vb.shape)
+            assert va.tobytes() == vb.tobytes(), (k, va, vb)
+
+
+def _lift_vs_oracle(fr, fn):
+    """Run ``fn`` lifted and with lifting disabled (the callback path =
+    the bit-identity oracle, ≙ TFTPU_LIFT=0); returns both block lists
+    plus the lift decision record."""
+    plan_lift.clear_lift_log()
+    lifted = tfs.map_blocks(numpy_udf(fn), fr).blocks()
+    recs = [r for r in plan_lift.lift_log() if r["udf"] == fn.__name__]
+    assert recs, "no lift decision recorded"
+    tfs.configure(udf_lifting=False)
+    try:
+        oracle = tfs.map_blocks(numpy_udf(fn), fr).blocks()
+    finally:
+        tfs.configure(udf_lifting=True)
+    return lifted, oracle, recs[-1]
+
+
+# ---------------------------------------------------------------------------
+# liftable corpus: forms × dtypes, bit-identical to the callback oracle
+# ---------------------------------------------------------------------------
+
+def _arith(x):
+    return {"y": (x * 2 + 1) - 3}
+
+
+def _where_compare(x):
+    return {"y": np.where(x > 3, x - 3, 3 - x)}
+
+
+def _clip(x):
+    return {"y": np.clip(x, 1, 25)}
+
+
+def _demean(x):
+    return {"y": x - x.mean()}
+
+
+def _span(x):
+    return {"lo": x - x.min(), "hi": x.max() - x}
+
+
+def _chain(x):
+    z = np.abs(x) + 1
+    return {"y": np.maximum(z, x) * np.minimum(z, 7)}
+
+
+_FLOAT_VALUES = [0.0, -0.0, 1.5, -2.25, 1e30, -1e30, np.inf, -np.inf,
+                 np.nan, 5.0, 8.0, 13.0]
+_INT_VALUES = [0, 1, -1, 7, -8, 2**30, -(2**30), 2**31 - 1, -(2**31),
+               5, 3, 2]
+
+
+@pytest.mark.parametrize("dtype,values", [
+    (np.float32, _FLOAT_VALUES),
+    (np.float64, _FLOAT_VALUES),
+    (np.int32, _INT_VALUES),
+    (np.int64, _INT_VALUES),
+])
+@pytest.mark.parametrize("fn", [
+    _arith, _where_compare, _clip, _chain,
+], ids=lambda f: f.__name__)
+def test_liftable_elementwise_bit_identical(dtype, values, fn):
+    fr = tfs.frame_from_arrays(
+        {"x": np.asarray(values, dtype=dtype)}, num_blocks=3
+    )
+    lifted, oracle, rec = _lift_vs_oracle(fr, fn)
+    assert rec["lifted"], rec
+    _assert_bit_identical(lifted, oracle)
+
+
+@pytest.mark.parametrize("dtype,fn", [
+    (np.int32, _demean),
+    (np.int32, _span),
+    (np.int64, _span),
+], ids=["demean-int32", "span-int32", "span-int64"])
+def test_liftable_int_reductions_bit_identical(dtype, fn):
+    # includes values whose int32 sum wraps: modular arithmetic must
+    # match numpy's exactly, not "approximately in f64"
+    fr = tfs.frame_from_arrays(
+        {"x": np.asarray(_INT_VALUES, dtype=dtype)}, num_blocks=3
+    )
+    lifted, oracle, rec = _lift_vs_oracle(fr, fn)
+    assert rec["lifted"], rec
+    _assert_bit_identical(lifted, oracle)
+
+
+def test_int64_mean_policy_declines():
+    # int64 mean runs in f64 — inexact past 2^53, order-sensitive —
+    # so it draws the same policy decline as float reductions
+    fr = tfs.frame_from_arrays(
+        {"x": np.asarray(_INT_VALUES, dtype=np.int64)}, num_blocks=2
+    )
+    plan_lift.clear_lift_log()
+    V.compile_program(numpy_udf(_demean), fr)
+    rec = plan_lift.lift_log()[-1]
+    assert not rec["lifted"]
+    assert rec["reason"] == "float-reduction"
+
+
+def test_float_minmax_reduction_policy_declines():
+    # measured: np.min([+0.,-0.]) = -0 but np.min([-0.,+0.]) = +0 —
+    # numpy resolves signed-zero ties position-dependently, XLA
+    # order-free, so float min/max REDUCTIONS stay callbacks (the
+    # elementwise np.minimum/np.maximum are positional and lift fine)
+    fr = tfs.frame_from_arrays(
+        {"x": np.asarray([1.5, -2.0, 0.25, 8.0, -0.0, 0.0, 7.5, 3.0],
+                         np.float32)},
+        num_blocks=2,
+    )
+    lifted, oracle, rec = _lift_vs_oracle(fr, _span)
+    assert not rec["lifted"]
+    assert rec["reason"] == "float-reduction"
+    # the decline is not a correctness event: both paths ran the
+    # callback and agree bit-exactly
+    _assert_bit_identical(lifted, oracle)
+
+
+def test_liftable_bool_logic_bit_identical():
+    def masks(x):
+        return {"m": np.logical_and(x > 2, x < 9),
+                "n": np.logical_or(x == 0, x == 5)}
+
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(12, dtype=np.int32)}, num_blocks=3
+    )
+    lifted, oracle, rec = _lift_vs_oracle(fr, masks)
+    assert rec["lifted"], rec
+    _assert_bit_identical(lifted, oracle)
+    for b in lifted:
+        assert np.asarray(b["m"]).dtype == np.bool_
+
+
+def test_liftable_multi_input():
+    def hyp(x, y):
+        return {"h": np.sqrt(x * x + y * y), "d": np.where(x > y, x, y)}
+
+    fr = tfs.frame_from_arrays(
+        {"x": np.asarray([3.0, 0.0, -3.0, 1e20, np.nan, 5.0], np.float64),
+         "y": np.asarray([4.0, -0.0, 4.0, 1e20, 1.0, 12.0], np.float64)},
+        num_blocks=2,
+    )
+    lifted, oracle, rec = _lift_vs_oracle(fr, hyp)
+    assert rec["lifted"], rec
+    _assert_bit_identical(lifted, oracle)
+
+
+# ---------------------------------------------------------------------------
+# decline corpus: each wrong shape gets the RIGHT named reason
+# ---------------------------------------------------------------------------
+
+def _decline_reason(fr, fn):
+    plan_lift.clear_lift_log()
+    V.compile_program(numpy_udf(fn), fr)
+    rec = plan_lift.lift_log()[-1]
+    assert not rec["lifted"], rec
+    return rec
+
+
+@pytest.fixture()
+def _ffr():
+    return tfs.frame_from_arrays(
+        {"x": np.arange(8, dtype=np.float32)}, num_blocks=2
+    )
+
+
+def test_decline_loop(_ffr):
+    def loopy(x):
+        acc = x
+        for _ in range(3):
+            acc = acc + x
+        return {"a": acc}
+
+    rec = _decline_reason(_ffr, loopy)
+    assert rec["reason"] == "unsupported-syntax:For"
+    assert rec["node"] == "For"
+
+
+def test_decline_data_dependent_branch(_ffr):
+    def branchy(x):
+        if x.sum() > 0:
+            return {"y": x}
+        return {"y": -x}
+
+    rec = _decline_reason(_ffr, branchy)
+    assert rec["reason"] == "data-dependent-branch"
+
+
+def test_decline_np_random(_ffr):
+    def rng(x):
+        return {"r": x + np.random.rand(*x.shape)}
+
+    rec = _decline_reason(_ffr, rng)
+    assert rec["reason"] == "unsupported-call:np.random.rand"
+
+
+def test_decline_mutable_closure(_ffr):
+    state = [1.0]
+
+    def closed(x):
+        return {"c": x * state[0]}
+
+    rec = _decline_reason(_ffr, closed)
+    assert rec["reason"] == "mutable-closure:state"
+
+
+def test_decline_augmented_assignment(_ffr):
+    def aug(x):
+        y = x * 2
+        y += 1
+        return {"y": y}
+
+    rec = _decline_reason(_ffr, aug)
+    assert rec["reason"] == "augmented-assignment"
+
+
+def test_decline_float_reduction(_ffr):
+    # float sums are pairwise in numpy and tree-reduced in XLA: the
+    # policy declines rather than verify-fail block-size-dependently
+    def fsum(x):
+        return {"s": x - np.sum(x)}
+
+    rec = _decline_reason(_ffr, fsum)
+    assert rec["reason"] == "float-reduction"
+
+
+def test_decline_unsupported_call(_ffr):
+    def sorter(x):
+        return {"y": np.sort(x)}
+
+    rec = _decline_reason(_ffr, sorter)
+    assert rec["reason"] == "unsupported-call:np.sort"
+
+
+def test_decline_attribute_access(_ffr):
+    def fft(x):
+        return {"y": np.fft.fft(x).real}
+
+    rec = _decline_reason(_ffr, fft)
+    assert rec["reason"] == "unsupported-syntax:Attribute"
+
+
+def test_decline_is_not_an_error(_ffr):
+    # a declined lift still EXECUTES (callback path) — lifting is an
+    # optimization, never a correctness gate
+    state = {"k": 2.0}
+
+    def closed(x):
+        return {"c": x * state["k"]}
+
+    out = tfs.map_blocks(numpy_udf(closed), _ffr).blocks()
+    got = np.concatenate([np.asarray(b["c"]) for b in out])
+    np.testing.assert_array_equal(
+        got, np.arange(8, dtype=np.float32) * 2.0
+    )
+
+
+def test_lifting_disabled_records_reason(_ffr):
+    tfs.configure(udf_lifting=False)
+    try:
+        plan_lift.clear_lift_log()
+        V.compile_program(numpy_udf(_arith), _ffr)
+        rec = plan_lift.lift_log()[-1]
+        assert not rec["lifted"]
+        assert rec["reason"] == "lifting-disabled"
+    finally:
+        tfs.configure(udf_lifting=True)
+
+
+# ---------------------------------------------------------------------------
+# capture-time hygiene: mutable closures warn loudly at numpy_udf()
+# ---------------------------------------------------------------------------
+
+def test_mutable_closure_capture_warns():
+    state = [1.0]
+
+    def closed(x):
+        return {"c": x * state[0]}
+
+    n0 = len(DIAGNOSTIC_LOG)
+    numpy_udf(closed)
+    warns = [d for d in list(DIAGNOSTIC_LOG)[n0:] if d.code == "TFG112"]
+    assert warns, "capture of a mutable closure must warn (TFG112)"
+    assert warns[0].severity == "warn"
+    assert "state" in warns[0].message
+
+
+def test_clean_capture_does_not_warn():
+    n0 = len(DIAGNOSTIC_LOG)
+    numpy_udf(_arith)
+    assert not [d for d in list(DIAGNOSTIC_LOG)[n0:] if d.code == "TFG112"]
+
+
+# ---------------------------------------------------------------------------
+# plan integration: barriers, lint_plan, fingerprint keying
+# ---------------------------------------------------------------------------
+
+def test_fully_lifted_chain_has_zero_barriers():
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(16, dtype=np.float32),
+         "y": np.arange(16, dtype=np.float32) - 7.5},
+        num_blocks=2,
+    )
+
+    def blend(u, v):
+        return {"z": np.where(u > v, u - v, v - u)}
+
+    f1 = tfs.map_blocks(lambda x, y: {"u": x * 2.0, "v": y + 1.0}, fr)
+    f2 = tfs.map_blocks(numpy_udf(blend), f1)
+    n_maps, barriers = plan_ir.chain_barriers(f2)
+    assert n_maps == 2
+    assert barriers == [], barriers
+
+
+def test_declined_chain_keeps_barrier():
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(16, dtype=np.float32)}, num_blocks=2
+    )
+
+    def rng(w):
+        return {"y": w + np.random.rand(*w.shape)}
+
+    f1 = tfs.map_blocks(lambda x: {"w": x * 2.0}, fr)
+    f2 = tfs.map_blocks(numpy_udf(rng), f1)
+    _, barriers = plan_ir.chain_barriers(f2)
+    assert barriers, "a declined lift must stay a counted barrier"
+
+
+def test_lint_plan_reports_tfg112():
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(16, dtype=np.float32)}, num_blocks=2
+    )
+    lifted_frame = tfs.map_blocks(numpy_udf(_arith), fr)
+    report = tfs.lint_plan(lifted_frame)
+    hits = [d for d in report.diagnostics if d.code == "TFG112"]
+    assert hits and hits[0].severity == "info"
+    assert "lifted" in hits[0].message
+
+    def rng(x):
+        return {"y": x + np.random.rand(*x.shape)}
+
+    declined_frame = tfs.map_blocks(numpy_udf(rng), fr)
+    report = tfs.lint_plan(declined_frame)
+    hits = [d for d in report.diagnostics if d.code == "TFG112"]
+    assert hits and hits[0].severity == "warn"
+    assert "unsupported-call:np.random.rand" in hits[0].message
+
+
+def test_lift_token_keys_fingerprint_env():
+    from tensorframes_tpu.compilecache.fingerprint import _env_parts
+
+    on = _env_parts("block", False, True)
+    tfs.configure(udf_lifting=False)
+    try:
+        off = _env_parts("block", False, True)
+    finally:
+        tfs.configure(udf_lifting=True)
+    assert on["lift"]["enabled"] is True
+    assert off["lift"]["enabled"] is False
+    assert on != off, "a TFTPU_LIFT flip must re-key the compile cache"
+
+
+def test_lifted_program_not_flagged_as_callback():
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(8, dtype=np.float32)}, num_blocks=2
+    )
+    prog = V.compile_program(numpy_udf(_arith), fr)
+    assert not plan_ir.program_has_callback(prog)
+    tfs.configure(udf_lifting=False)
+    try:
+        prog_cb = V.compile_program(numpy_udf(_arith), fr)
+    finally:
+        tfs.configure(udf_lifting=True)
+    assert plan_ir.program_has_callback(prog_cb)
+
+
+def test_lift_report_renders():
+    fr = tfs.frame_from_arrays(
+        {"x": np.arange(8, dtype=np.float32)}, num_blocks=2
+    )
+    plan_lift.clear_lift_log()
+    V.compile_program(numpy_udf(_arith), fr)
+
+    def loopy(x):
+        acc = x
+        for _ in range(2):
+            acc = acc + x
+        return {"a": acc}
+
+    V.compile_program(numpy_udf(loopy), fr)
+    text = plan_lift.lift_report()
+    assert "LIFTED" in text and "DECLINED" in text
+    assert "unsupported-syntax:For" in text
+
+
+# ---------------------------------------------------------------------------
+# per-workload strategy walls (satellite): keyed lookups + v1 quarantine
+# ---------------------------------------------------------------------------
+
+_reopt_only = pytest.mark.skipif(
+    not plan_stats.reopt_enabled(), reason="TFTPU_REOPT=0"
+)
+
+
+@_reopt_only
+def test_workload_walls_prefer_local_evidence():
+    plan_stats.reset_strategy_walls(unlink_sidecar=False)
+    with plan_stats.workload_scope("wlA"):
+        for _ in range(3):
+            plan_stats.observe_strategy_wall("epi", "per_block", 0.010)
+            plan_stats.observe_strategy_wall("epi", "concat", 0.020)
+    with plan_stats.workload_scope("wlB"):
+        for _ in range(3):
+            plan_stats.observe_strategy_wall("epi", "per_block", 0.050)
+            plan_stats.observe_strategy_wall("epi", "concat", 0.001)
+    with plan_stats.workload_scope("wlA"):
+        wa = plan_stats.strategy_walls("epi")
+    with plan_stats.workload_scope("wlB"):
+        wb = plan_stats.strategy_walls("epi")
+    # the same decision ranks OPPOSITE ways for the two workloads
+    assert wa["per_block"]["ewma_s"] < wa["concat"]["ewma_s"]
+    assert wb["concat"]["ewma_s"] < wb["per_block"]["ewma_s"]
+
+
+@_reopt_only
+def test_workload_walls_fall_back_to_global():
+    plan_stats.reset_strategy_walls(unlink_sidecar=False)
+    for _ in range(2):
+        plan_stats.observe_strategy_wall("fuse", "fused", 0.010)
+        plan_stats.observe_strategy_wall("fuse", "split", 0.030)
+    with plan_stats.workload_scope("wl-thin"):
+        # one strategy, one sample: not evidence-grade → global answers
+        plan_stats.observe_strategy_wall("fuse", "fused", 0.005)
+        walls = plan_stats.strategy_walls("fuse")
+    assert set(walls) == {"fused", "split"}
+    assert walls["split"]["n"] >= 2
+
+
+@_reopt_only
+def test_workload_scope_is_thread_local_and_nests():
+    assert plan_stats.current_workload() is None
+    with plan_stats.workload_scope("outer"):
+        assert plan_stats.current_workload() == "outer"
+        with plan_stats.workload_scope("inner"):
+            assert plan_stats.current_workload() == "inner"
+        assert plan_stats.current_workload() == "outer"
+    assert plan_stats.current_workload() is None
+
+
+@_reopt_only
+def test_v1_strategy_wall_sidecar_quarantines(tmp_path):
+    was = tfs.configure().compilation_cache_dir
+    tfs.configure(compilation_cache_dir=str(tmp_path))
+    try:
+        plan_stats.clear_memory()
+        path = tmp_path / "planstats" / "strategy_walls.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps({
+            "v": 1, "kind": "strategy_walls",
+            "tables": {"fuse": {"obs": 3, "strategies": {
+                "fused": {"ewma_s": 0.1, "n": 3, "last_obs": 3}}}},
+        }))
+        assert plan_stats.strategy_walls("fuse") == {}
+        assert not path.exists(), "v1 sidecars quarantine on format bump"
+
+        # a fresh observation rewrites the sidecar at v2 with both slots
+        plan_stats.observe_strategy_wall("fuse", "fused", 0.5)
+        rec = json.loads(path.read_text())
+        assert rec["v"] == plan_stats.SW_FORMAT_VERSION
+        assert "workloads" in rec and "tables" in rec
+    finally:
+        plan_stats.reset_strategy_walls()
+        tfs.configure(compilation_cache_dir=was)
+        plan_stats.clear_memory()

@@ -346,7 +346,7 @@ def test_strategy_wall_stale_entries_are_pruned(tmp_path):
         side.mkdir()
         obs = plan_stats.STRATEGY_STALE_OBS + 10
         (side / "strategy_walls.json").write_text(json.dumps({
-            "v": plan_stats.FORMAT_VERSION, "kind": "strategy_walls",
+            "v": plan_stats.SW_FORMAT_VERSION, "kind": "strategy_walls",
             "tables": {"fuse": {"obs": obs, "strategies": {
                 # unrefreshed for > STRATEGY_STALE_OBS observations
                 "fuse": {"ewma_s": 1.0, "n": 5, "last_obs": 1},
@@ -354,6 +354,7 @@ def test_strategy_wall_stale_entries_are_pruned(tmp_path):
                     "ewma_s": 0.5, "n": 5, "last_obs": obs - 1
                 },
             }}},
+            "workloads": {},
         }))
         q0 = _sidecar_count("quarantine")
         walls = plan_stats.strategy_walls("fuse")
