@@ -1109,6 +1109,165 @@ def _bench_decode_engine(n_requests: int = 12, new_tokens: int = 8,
         srv.stop(drain=True, timeout=300)
 
 
+def _bench_kv_hierarchy(n_samples: int = 12, new_tokens: int = 8):
+    """KV memory hierarchy (ISSUE 19): content-addressed prefix-cache
+    TTFT against cold prefill, and per-sequence host-swap resume on an
+    undersized pool. TTFT samples are direct wall-clock of 1-token
+    requests on an idle warmed engine (submit -> first token), not
+    histogram-bucket quantiles, so the p50 comparison is exact. Hard
+    gates (raise, so the smoke exits nonzero):
+
+    * prefix-hit TTFT p50 strictly below cold-prefill TTFT p50, with
+      hit outputs BIT-IDENTICAL to the dense-cache ``gen.generate``
+      oracle (whole-prompt copy-on-extend AND shared-prefix+fresh-
+      suffix both checked);
+    * the undersized-pool leg sustains every request through
+      swap-resume (``swap_resumes > 0``, zero corruption fallbacks)
+      with outputs bit-identical to the oracle;
+    * zero steady-state XLA compiles on both warmed engines."""
+    import statistics
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.models import transformer as tr
+    from tensorframes_tpu.ops.executor import _JIT_MISSES
+    from tensorframes_tpu.serving import metrics as smet
+
+    cfg = gen.gpt_tiny()
+    params = tr.quantize_params(tr.init_params(cfg, seed=0))
+
+    def oracle(p):
+        return np.asarray(
+            gen.generate(cfg, params, p[None], new_tokens, kv_quant=True)
+        )
+
+    rng = np.random.default_rng(11)
+    plen, ps = 40, 8
+
+    def fresh_prompt(n=plen):
+        return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+    # -- leg 1: prefix cache, cold vs hit TTFT --------------------------
+    srv = tfs.Server(tfs.ServingConfig(max_batch_rows=8))
+    eng = srv.register_decode(
+        "prefix", cfg, params,
+        tfs.DecodeConfig(
+            max_slots=4, page_size=ps, max_prompt_len=plen,
+            max_new_tokens=new_tokens, prefix_cache=True,
+            # roomy pool: every cold request publishes its pages too,
+            # and LRU reclaim under pressure would evict the shared
+            # chain mid-leg — the TTFT comparison wants deterministic
+            # hits, not cache-sizing noise
+            num_pages=128,
+        ),
+    )
+    srv.start()
+    try:
+        shared = fresh_prompt()
+        srv.call("prefix", {"prompt": shared}, timeout=600)  # publishes
+        miss0 = _JIT_MISSES.value
+
+        def timed(p):
+            t0 = time.perf_counter()
+            srv.call(
+                "prefix", {"prompt": p, "max_new_tokens": 1}, timeout=600
+            )
+            return time.perf_counter() - t0
+
+        def suffix_prompt():
+            # shared first 4 pages, fresh final page: a suffix-only hit
+            return np.concatenate(
+                [shared[:plen - ps], fresh_prompt(ps)]
+            ).astype(np.int32)
+
+        cold_ts = [timed(fresh_prompt()) for _ in range(n_samples)]
+        h0 = smet.PREFIX_HITS.value
+        hit_ts = [timed(suffix_prompt()) for _ in range(n_samples)]
+        hits = int(smet.PREFIX_HITS.value - h0)
+        # bit-identity: both hit shapes against the dense oracle
+        out = srv.call("prefix", {"prompt": shared}, timeout=600)
+        assert np.array_equal(out["tokens"], oracle(shared)), (
+            "prefix-cache exact-repeat output != dense oracle "
+            "(bit-identity gate)"
+        )
+        sfx = suffix_prompt()
+        out = srv.call("prefix", {"prompt": sfx}, timeout=600)
+        assert np.array_equal(out["tokens"], oracle(sfx)), (
+            "prefix-cache suffix-hit output != dense oracle "
+            "(bit-identity gate)"
+        )
+        steady = int(_JIT_MISSES.value - miss0)
+        shared_pages = int(eng.counters()["shared_pages"])
+    finally:
+        srv.stop(drain=True, timeout=300)
+    assert hits >= n_samples, (
+        f"prefix cache hit only {hits}x over {n_samples} shared-prefix "
+        "requests"
+    )
+    assert steady == 0, (
+        f"warmed prefix-cache engine compiled {steady}x in steady state"
+    )
+    cold_p50 = statistics.median(cold_ts)
+    hit_p50 = statistics.median(hit_ts)
+    assert hit_p50 < cold_p50, (
+        f"prefix-hit TTFT p50 {hit_p50:.6f}s not below cold-prefill "
+        f"p50 {cold_p50:.6f}s"
+    )
+
+    # -- leg 2: host-swap resume on an undersized pool ------------------
+    srv2 = tfs.Server(tfs.ServingConfig(max_batch_rows=8))
+    srv2.register_decode(
+        "swap", cfg, params,
+        tfs.DecodeConfig(
+            max_slots=4, page_size=ps, num_pages=1 + 2 * 3,
+            max_prompt_len=16, max_new_tokens=new_tokens, kv_swap=True,
+        ),
+    )
+    srv2.start()
+    try:
+        srv2.call("swap", {"prompt": fresh_prompt(9)}, timeout=600)
+        miss0 = _JIT_MISSES.value
+        o0 = smet.KVSWAP_OUTS.value
+        r0 = smet.KVSWAP_RESUMES.value
+        f0 = smet.KVSWAP_FALLBACKS.value
+        prompts = [
+            fresh_prompt(int(rng.integers(9, 17))) for _ in range(8)
+        ]
+        futs = [srv2.submit("swap", {"prompt": p}) for p in prompts]
+        outs = [f.result(600)["tokens"] for f in futs]
+        swap_outs = int(smet.KVSWAP_OUTS.value - o0)
+        swap_resumes = int(smet.KVSWAP_RESUMES.value - r0)
+        swap_fallbacks = int(smet.KVSWAP_FALLBACKS.value - f0)
+        steady2 = int(_JIT_MISSES.value - miss0)
+        for i, (p, o) in enumerate(zip(prompts, outs)):
+            assert np.array_equal(o, oracle(p)), (
+                f"swap-resume leg request {i}: output != dense oracle "
+                "(bit-identity gate)"
+            )
+    finally:
+        srv2.stop(drain=True, timeout=600)
+    assert swap_resumes > 0, (
+        "undersized pool never swap-resumed: the leg did not exercise "
+        "the host-swap tier"
+    )
+    assert swap_fallbacks == 0, (
+        f"{swap_fallbacks} swap segments failed CRC on a healthy store"
+    )
+    assert steady2 == 0, (
+        f"warmed kv_swap engine compiled {steady2}x in steady state"
+    )
+    return {
+        "prefix_hit_ttft_p50_s": hit_p50,
+        "cold_ttft_p50_s": cold_p50,
+        "prefix_hits": hits,
+        "shared_pages": shared_pages,
+        "swap_outs": swap_outs,
+        "swap_resumes": swap_resumes,
+        "swap_fallbacks": swap_fallbacks,
+        "steady_state_compiles": steady + steady2,
+    }
+
+
 def _bench_read_csv(n_rows: int = 1_000_000):
     """CSV → frame ingestion (native C++ single-pass parser), s/call."""
     import os
@@ -2355,6 +2514,16 @@ def main():
             "serving_decode_ttft_p99_s",
         ),
     ) or {}
+    # KV memory hierarchy (ISSUE 19): prefix-hit vs cold TTFT and the
+    # undersized-pool swap-resume leg — hard-gated inside the bench
+    kvh_res = _try(
+        "serving_kv_hierarchy", _bench_kv_hierarchy, {},
+        metric_keys=(
+            "serving_decode_prefix_hit_ttft_p50_s",
+            "serving_decode_cold_ttft_p50_s",
+            "serving_decode_swap_resumes_total",
+        ),
+    ) or {}
     if serving_res:
         print(
             "# serving | open_loop rows_per_sec={:.0f} p50={:.6f}s "
@@ -2381,6 +2550,20 @@ def main():
                 decode_res["ttft_p99_s"],
                 decode_res["steady_state_compiles"],
                 decode_res["requests"], decode_res["preemptions"],
+            )
+        )
+    if kvh_res:
+        print(
+            "# serving | kv_hierarchy prefix_hit_ttft_p50={:.6f}s "
+            "cold_ttft_p50={:.6f}s prefix_hits={} shared_pages={} "
+            "swap_resumes={} swap_fallbacks={} steady_state_compiles={} "
+            "(gates: hit p50 < cold p50, swap_resumes > 0, outputs "
+            "bit-identical to the dense oracle)".format(
+                kvh_res["prefix_hit_ttft_p50_s"],
+                kvh_res["cold_ttft_p50_s"], kvh_res["prefix_hits"],
+                kvh_res["shared_pages"], kvh_res["swap_resumes"],
+                kvh_res["swap_fallbacks"],
+                kvh_res["steady_state_compiles"],
             )
         )
 
@@ -2478,6 +2661,15 @@ def main():
         ),
         "serving_decode_ttft_p99_s": round(
             decode_res.get("ttft_p99_s", 0.0), 6
+        ),
+        "serving_decode_prefix_hit_ttft_p50_s": round(
+            kvh_res.get("prefix_hit_ttft_p50_s", 0.0), 6
+        ),
+        "serving_decode_cold_ttft_p50_s": round(
+            kvh_res.get("cold_ttft_p50_s", 0.0), 6
+        ),
+        "serving_decode_swap_resumes_total": int(
+            kvh_res.get("swap_resumes", 0)
         ),
     }
     print(f"# chips={n_chips} devices={jax.devices()}")
@@ -2750,6 +2942,21 @@ def serving_decode_main():
                 res["requests"], res["completed"], res["preemptions"],
             )
         )
+    # KV memory hierarchy (ISSUE 19): its own hard gates raise inside
+    # (hit p50 < cold p50, swap_resumes > 0, bit-identity, 0 compiles)
+    # so a regression fails this smoke; the tftpu_kvswap_* and
+    # tftpu_prefix_* counters it drives ride the metrics artifact below
+    kvh = _try("serving_kv_hierarchy", _bench_kv_hierarchy, {}) or {}
+    if kvh:
+        print(
+            "# serving-decode | kv_hierarchy prefix_hit_ttft_p50={:.6f}s"
+            " cold_ttft_p50={:.6f}s prefix_hits={} swap_resumes={} "
+            "swap_fallbacks={}".format(
+                kvh["prefix_hit_ttft_p50_s"], kvh["cold_ttft_p50_s"],
+                kvh["prefix_hits"], kvh["swap_resumes"],
+                kvh["swap_fallbacks"],
+            )
+        )
     out_dir = os.environ.get("TFTPU_OBS_EXPORT")
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
@@ -2769,12 +2976,24 @@ def serving_decode_main():
         "steady_state_compiles": res.get("steady_state_compiles"),
         "requests": res.get("requests"),
         "completed": res.get("completed"),
+        "prefix_hit_ttft_p50_s": kvh.get("prefix_hit_ttft_p50_s"),
+        "cold_ttft_p50_s": kvh.get("cold_ttft_p50_s"),
+        "prefix_hits": kvh.get("prefix_hits"),
+        "swap_resumes": kvh.get("swap_resumes"),
+        "swap_fallbacks": kvh.get("swap_fallbacks"),
     }))
     if not res or res.get("steady_state_compiles", 1) != 0 \
             or res.get("completed") != res.get("requests"):
         print(
             "# serving-decode | FAILED: steady-state compiles != 0, "
             "lost requests, or a hard gate raised"
+        )
+        sys.exit(1)
+    if not kvh or kvh.get("swap_resumes", 0) <= 0 \
+            or kvh.get("prefix_hits", 0) <= 0:
+        print(
+            "# serving-decode | FAILED: kv hierarchy leg — no swap "
+            "resumes, no prefix hits, or a hard gate raised"
         )
         sys.exit(1)
 

@@ -199,11 +199,17 @@ run_step "Serving smoke (open-loop CPU load, zero steady-state compiles)" bash -
 # prompts through the token-level decode engine + paged KV pool —
 # exits nonzero on steady-state compiles, lost requests, or a
 # batched-vs-solo bit-identity divergence; the tftpu_decode_* metrics
-# JSONL rides the observability artifacts
+# JSONL rides the observability artifacts. The KV memory hierarchy leg
+# (ISSUE 19) is gated inside the same smoke — prefix-hit TTFT p50
+# below cold prefill, swap_resumes > 0 with zero corruption fallbacks,
+# bit-identity vs the dense oracle — and the greps prove the
+# tftpu_kvswap_* / tftpu_prefix_* families landed in the artifact
 run_step "Serving decode smoke (iterative decode engine, paged KV pool)" bash -c "
   env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.serving_decode_main()\" &&
   test -s '$WORK/obs/serving_decode_metrics.jsonl' &&
-  test -s '$WORK/obs/serving_decode_trace.json'
+  test -s '$WORK/obs/serving_decode_trace.json' &&
+  grep -q 'tftpu_kvswap_resume_total' '$WORK/obs/serving_decode_metrics.jsonl' &&
+  grep -q 'tftpu_prefix_cache_hits_total' '$WORK/obs/serving_decode_metrics.jsonl'
 "
 
 # ci.yml's serving-fleet smoke (ISSUE 13): a supervised 2-replica

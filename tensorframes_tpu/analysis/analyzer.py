@@ -216,7 +216,7 @@ def lint_plan(frame) -> DiagnosticReport:
     """Lint a frame's *logical plan* (TFG107 fusion-barrier, TFG109
     unfused-aggregate, TFG110 missed-aggregate-pushdown, TFG111
     larger-than-budget materialization, TFG112 liftable-callback /
-    lift-declined): warn when a
+    lift-declined, TFG113 prefix-cache-ineligible): warn when a
     chain's otherwise-fusable map stages are split by a barrier — a
     host-callback stage, a ``to_host``/``to_numpy`` materialization or
     repartition between maps, a trim map, or ragged source cells —
@@ -247,6 +247,15 @@ def lint_plan(frame) -> DiagnosticReport:
                            "_tftpu_lift_info", None)
             if info:
                 lift_events.append(dict(info))
+    # serving evidence (TFG113): decode engines record when prompt
+    # prefill work could not ride the prefix cache; import-guarded —
+    # linting must work in a build without the serving extra
+    try:
+        from ..serving.decode import prefix_cache_events
+
+        prefix_events = prefix_cache_events()
+    except Exception:  # pragma: no cover - serving unavailable
+        prefix_events = []
     ctx = RuleContext(
         program=None,
         plan_barriers=barriers,
@@ -254,9 +263,12 @@ def lint_plan(frame) -> DiagnosticReport:
         pushdown_misses=pushdown_misses(frame),
         oversized_materializations=oversized_materializations(frame),
         lift_events=lift_events,
+        prefix_cache_events=prefix_events,
     )
     diags = run_rules(
-        ctx, codes=["TFG107", "TFG109", "TFG110", "TFG111", "TFG112"]
+        ctx,
+        codes=["TFG107", "TFG109", "TFG110", "TFG111", "TFG112",
+               "TFG113"],
     )
     return DiagnosticReport(
         diags, subject=f"plan({n_maps} map stage(s))"

@@ -58,6 +58,11 @@ CODES: Dict[str, Tuple[str, str]] = {
     # stayed a callback — the message carries the taxonomy reason and
     # names the offending AST node.
     "TFG112": ("liftable-callback", "warn"),
+    # prefix-cache ineligible: serving evidence that decode prefill
+    # work could not be shared (repeated prefixes on an engine with the
+    # cache off, prompts below one page, replay-resumed joins) — the
+    # fix names the DecodeConfig/page-size change that would enable it.
+    "TFG113": ("prefix-cache-ineligible", "warn"),
     # TFL: the repo self-lint family (python -m tensorframes_tpu.analysis
     # selfcheck — policy rules over this repo's own sources, not user
     # programs). Registered here so one catalog covers every code a CI

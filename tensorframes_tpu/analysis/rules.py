@@ -116,6 +116,11 @@ class RuleContext:
     #: plan.lift.build_udf_program — dicts with ``udf``, ``lifted``,
     #: ``reason``, ``node``, ``lineno``, ``detail``; read by TFG112.
     lift_events: Optional[Sequence[dict]] = None
+    #: Prefix-cache ineligibility evidence from the decode engines
+    #: (lint_plan only): dicts with ``endpoint``, ``reason``,
+    #: ``prompt_len``, ``page_size`` — recorded per (endpoint, reason)
+    #: by serving.decode, read by TFG113.
+    prefix_cache_events: Optional[Sequence[dict]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -898,6 +903,58 @@ def _rule_liftable_callback(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG113 — prefix-cache ineligible (serving evidence rule)
+# ---------------------------------------------------------------------------
+
+_TFG113_FIXES = {
+    "store_unarmed":
+        "arm the cache: register_decode(..., DecodeConfig("
+        "prefix_cache=True)) — repeated prompt prefixes were observed, "
+        "so those prefill chunks would be shared (docs/serving.md#kv-"
+        "memory-hierarchy)",
+    "page_misalignment":
+        "prompts this short never fill one KV page, so nothing can be "
+        "published or matched at page granularity — lower "
+        "DecodeConfig.page_size below the common prefix length (the "
+        "cache matches whole pages only)",
+    "sampling_state_mismatch":
+        "replay-resumed joins must reproduce their recorded tokens "
+        "against the page state of first admission, so they bypass the "
+        "cache by design — size the pool (num_pages) or arm kv_swap so "
+        "fewer sequences resume through the recompute path",
+}
+
+
+def _rule_prefix_cache_ineligible(ctx: RuleContext) -> List[Diagnostic]:
+    """Decode-engine evidence that prompt prefill work could NOT ride
+    the content-addressed prefix cache: the cache was off while
+    repeated prefixes arrived (store_unarmed), prompts were too short
+    to fill one page (page_misalignment), or joins were replay-resumed
+    and therefore pinned to their recorded state
+    (sampling_state_mismatch). Each finding's fix names the config
+    change — or explains why the exclusion is structural."""
+    if not ctx.prefix_cache_events:
+        return []
+    out: List[Diagnostic] = []
+    for ev in ctx.prefix_cache_events:
+        reason = str(ev.get("reason", "unknown"))
+        endpoint = str(ev.get("endpoint", "<endpoint>"))
+        out.append(Diagnostic(
+            "TFG113", "warn",
+            f"decode endpoint {endpoint!r}: prompt prefill was not "
+            f"shareable through the prefix cache — {reason} "
+            f"(prompt_len={ev.get('prompt_len')}, "
+            f"page_size={ev.get('page_size')})",
+            subject=endpoint,
+            fix=_TFG113_FIXES.get(
+                reason,
+                "see docs/analysis.md#tfg113 for the reason taxonomy",
+            ),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -914,6 +971,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG110": _rule_missed_pushdown,
     "TFG111": _rule_oversized_materialization,
     "TFG112": _rule_liftable_callback,
+    "TFG113": _rule_prefix_cache_ineligible,
 }
 
 

@@ -226,6 +226,23 @@ class BlockStore:
             self._enforce_budget()
         return ref
 
+    def put_spilled(self, block: Dict[str, object]) -> BlockRef:
+        """Register one block and push it straight to its disk segment
+        (temp → fsync → rename, CRC-stamped), dropping the in-RAM copy
+        immediately. The per-sequence KV swap path (ISSUE 19): a
+        swapped-out sequence's pages are cold by definition and must
+        not displace the resident working set through the LRU budget —
+        this never spills OTHER blocks the way :meth:`put` can."""
+        nbytes = _block_nbytes(block)
+        with self._lock:
+            ref = BlockRef(self._next_id, nbytes, _block_rows(block))
+            self._next_id += 1
+            e = _Entry(ref, dict(block))
+            self._entries[ref.block_id] = e
+            self._account(+nbytes, 0)
+            self._spill_entry(e)
+        return ref
+
     def _enforce_budget(self) -> None:
         # called under the lock; oldest-touched first (OrderedDict
         # order). budget <= 0 is the degenerate disk-only store: every

@@ -401,6 +401,119 @@ def paged_prefill_fn(cfg: TransformerConfig, page_size: int,
     return prefill
 
 
+def paged_suffix_prefill_fn(cfg: TransformerConfig, page_size: int,
+                            max_pages: int):
+    """Build the prefix-cache suffix prefill: ``fn(params, pool,
+    tokens[T], start, length, table[max_pages]) -> (pool, first)``.
+
+    The prefix-cache join path (serving/decode.py): positions
+    ``[0, start)`` are already resident in the sequence's pages (shared
+    pages matched by content hash), so only the suffix ``tokens[:length]``
+    is processed — written at positions ``[start, start + length)``
+    through ``table`` and attended against the WHOLE sequence via the
+    paged gather (`paged_attention_reference`, the same dequantize-
+    commutes chain decode steps read through, so a cache-hit join emits
+    exactly the tokens full prefill + decode would). ``start`` and
+    ``length`` are traced int32 scalars — one executable per suffix
+    bucket T serves every (start, length) in it; ``start=0`` degrades
+    to a full prefill through the gather chain.
+    """
+
+    def suffix_prefill(params, pool, tokens, start, length, table):
+        from ..kernels.decode_attention import paged_attention_reference
+        from ..ops.quantize import matmul as _mm
+
+        (T,) = tokens.shape
+        h, nh, hd = cfg.hidden, cfg.num_heads, cfg.head_dim
+        tpos = jnp.arange(T)
+        valid = tpos < length
+        seqpos = start + tpos                   # absolute KV positions
+        emb_pos = jnp.minimum(
+            seqpos, params["embed"]["pos"].shape[0] - 1
+        )
+        x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+        x = x + params["embed"]["pos"][emb_pos].astype(cfg.dtype)
+        pg = jnp.where(
+            valid,
+            table[jnp.minimum(seqpos // page_size, max_pages - 1)], 0,
+        )
+        off = seqpos % page_size
+        # per-row gather coordinates: each suffix position attends the
+        # sequence's own pages masked to j <= its absolute position;
+        # padding rows carry null tables and position 0
+        tables_r = jnp.where(valid[:, None], table[None, :], 0)
+        pos_r = jnp.where(valid, seqpos, 0)
+        pool = dict(pool)
+        for li, p in enumerate(params["layers"]):
+            y = _layer_norm(x, **p["ln1"])
+            qkv = _mm(y, p["attn"]["qkv"]).reshape(T, 3, nh, hd)
+            q = qkv[:, 0]                       # [T, nh, hd]
+            k = qkv[:, 1]
+            v = qkv[:, 2]
+            kq, ks = _quantize_slots(k[:, :, None, :])
+            vq, vs = _quantize_slots(v[:, :, None, :])
+            kq, ks = kq[:, :, 0], ks[:, :, 0]
+            vq, vs = vq[:, :, 0], vs[:, :, 0]
+            # write first, then gather-attend — row i sees positions
+            # 0..start+i including its own token, the decode-step order
+            pool["k"] = pool["k"].at[pg, li, :, off].set(kq)
+            pool["v"] = pool["v"].at[pg, li, :, off].set(vq)
+            pool["k_scale"] = pool["k_scale"].at[pg, li, :, off].set(ks)
+            pool["v_scale"] = pool["v_scale"].at[pg, li, :, off].set(vs)
+            ctx = paged_attention_reference(
+                q, pool["k"], pool["v"],
+                pool["k_scale"], pool["v_scale"],
+                li, tables_r, pos_r,
+            ).reshape(T, h)
+            x = x + _mm(ctx, p["attn"]["out"])
+            x = x + _mlp(p["mlp"], _layer_norm(x, **p["ln2"]))
+        hs = _layer_norm(x, **params["final_ln"])
+        last = jnp.take(hs, length - 1, axis=0)
+        first = jnp.argmax(
+            _logits(cfg, params, last), axis=-1
+        ).astype(jnp.int32)
+        return pool, first
+
+    return suffix_prefill
+
+
+def paged_page_ops_fns(max_pages: int):
+    """Build the page-granular pool maintenance steps the KV memory
+    hierarchy dispatches (serving/decode.py, ISSUE 19) — all shapes
+    fixed, so each is ONE warmable executable:
+
+    * ``extract(pool, idx[max_pages]) -> {col: [max_pages, ...]}`` —
+      gather a sequence's pages out of the pool (host-swap-out reads
+      this, then trims to the real page count; padding entries gather
+      the null page and are discarded).
+    * ``restore(pool, idx[max_pages], k, v, k_scale, v_scale) -> pool``
+      — scatter swapped-in page payloads back (padding entries target
+      the null page, whose contents are garbage by contract).
+    * ``copy_page(pool, src, dst) -> pool`` — duplicate one page
+      (copy-on-extend: a ragged-tail prefix-cache hit copies the shared
+      page before writing into it).
+    """
+
+    def extract(pool, idx):
+        return {name: col[idx] for name, col in pool.items()}
+
+    def restore(pool, idx, k, v, k_scale, v_scale):
+        pool = dict(pool)
+        pool["k"] = pool["k"].at[idx].set(k)
+        pool["v"] = pool["v"].at[idx].set(v)
+        pool["k_scale"] = pool["k_scale"].at[idx].set(k_scale)
+        pool["v_scale"] = pool["v_scale"].at[idx].set(v_scale)
+        return pool
+
+    def copy_page(pool, src, dst):
+        pool = dict(pool)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            pool[name] = pool[name].at[dst].set(pool[name][src])
+        return pool
+
+    return extract, restore, copy_page
+
+
 def paged_decode_step_fn(cfg: TransformerConfig, page_size: int,
                          max_pages: int,
                          attn_kernel: Optional[str] = None):

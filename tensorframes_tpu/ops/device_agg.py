@@ -124,6 +124,17 @@ _minmax_memo: Dict[int, tuple] = {}  # lint: guarded (benign race: concurrent wr
 _dict_encode_memo: Dict[tuple, tuple] = {}  # lint: guarded (benign race: same-key writers store identical staged values)
 
 
+def _placement_token() -> tuple:
+    """The topology a staged upload targeted: a cached relay placement
+    is only valid while the backend and visible device set are
+    unchanged — keying the staged ids by this token re-stages after a
+    backend/device flip instead of serving a mis-placed array."""
+    return (
+        jax.default_backend(),
+        tuple(d.id for d in jax.local_devices()),
+    )
+
+
 def _cached_minmax(cols):
     import weakref
 
@@ -479,10 +490,19 @@ def try_aggregate_device(
 
     frame_ck = ("__device_dict__",) + tuple(keys)
     hit = None
+    staged_ck = None
+    ids_dev = None
     if memo_key is None and tail is None:
         hit = frame_cache_get(frame, frame_ck)
+        # relay-placement cache (the r4 follow-up): the encode cache
+        # above still paid a host->device ids upload — a full relay
+        # round trip on tunnel-attached chips — on EVERY call; the
+        # staged array is as immutable as the frame, scoped to the
+        # placement it was uploaded for
+        staged_ck = frame_ck + ("__staged__", _placement_token())
     if hit is not None:
         ids_all, group_key_cols, K = hit
+        ids_dev = frame_cache_get(frame, staged_ck)
     else:
         key_host: List[np.ndarray] = []
         for k in keys:
@@ -511,9 +531,11 @@ def try_aggregate_device(
             "host path", K, feat,
         )
         return None
-    ids_main = ids_all[:main_rows].astype(np.int32)
     ids_tail = ids_all[main_rows:] if tail is not None else None
-    ids_dev = jnp.asarray(ids_main)
+    if ids_dev is None:
+        ids_dev = jnp.asarray(ids_all[:main_rows].astype(np.int32))
+        if staged_ck is not None:
+            frame_cache_put(frame, staged_ck, ids_dev)
     if memo_key is not None:
         import weakref
 

@@ -40,6 +40,25 @@ grid, so a warmed engine sustains any join/leave mix without touching
 XLA. Decode is greedy (argmax inside the step executable): determinism
 is what makes preemption-replay and the batched-vs-solo bit-identity
 gate (bench.py hard-gates it) meaningful.
+
+KV memory hierarchy (ISSUE 19), both tiers off by default:
+
+* ``prefix_cache=True`` arms the content-addressed prefix cache: a
+  fresh prompt's page-granular prefix is hash-matched against pages
+  other sequences already prefilled and published read-only
+  (refcounted; ``PagedKVPool.check()``'s partition extends to them); a
+  hit skips those prefill chunks — the suffix runs through a dedicated
+  gather-attending prefill executable, or copy-on-extend duplicates a
+  shared ragged-tail page and teacher-forces only the final prompt
+  token — so TTFT drops to the unshared remainder while outputs stay
+  bit-identical to the cold path (same quantized-KV math end to end).
+* ``kv_swap=True`` arms per-sequence host-swap: a preempted sequence's
+  own pages (slot scales and generated prefix included) travel to a
+  CRC-stamped BlockStore segment, and rejoin RESTORES them instead of
+  recompute-replaying. Replay data is kept alongside every swap
+  snapshot: segment corruption falls back to the replay path, counted
+  (``tftpu_kvswap_fallback_total``), so no store problem can lose a
+  request.
 """
 
 from __future__ import annotations
@@ -48,7 +67,7 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -69,7 +88,22 @@ from .kvpool import PagedKVPool, PoolExhaustedError
 
 logger = get_logger(__name__)
 
-__all__ = ["DecodeConfig", "DecodeEngine"]
+__all__ = ["DecodeConfig", "DecodeEngine", "prefix_cache_events"]
+
+# Prefix-cache ineligibility evidence for lint_plan's TFG113 rule: one
+# entry per (endpoint, reason) the first time it arises, bounded. The
+# analyzer reads this through prefix_cache_events() — serving state
+# never imports analysis, only the other way around.
+_PREFIX_INELIGIBLE: Deque[Dict[str, object]] = collections.deque(
+    maxlen=128
+)
+_PREFIX_INELIGIBLE_SEEN: set = set()
+
+
+def prefix_cache_events() -> List[Dict[str, object]]:
+    """Recent prefix-cache ineligibility evidence (deduplicated per
+    endpoint and reason) — the TFG113 rule's input."""
+    return list(_PREFIX_INELIGIBLE)
 
 
 @dataclasses.dataclass
@@ -91,6 +125,13 @@ class DecodeConfig:
     request carries none (``RetryPolicy.deadline_s`` semantics; expiry
     covers queue AND slot wait — once running, a sequence completes).
     ``warmup`` — precompile the slot × phase bucket grid at start.
+    ``kv_swap`` — host-swap a preempted sequence's pages to a
+    BlockStore segment and restore them on rejoin (counted fallback to
+    recompute-replay on corruption). ``swap_dir`` roots the swap store
+    (default: a private temp dir, deleted at stop).
+    ``prefix_cache`` — share read-only prompt-prefix pages across
+    requests by content hash (refcounted, copy-on-extend at the ragged
+    tail, evicted only at refcount 0).
     """
 
     max_slots: int = 8
@@ -101,6 +142,9 @@ class DecodeConfig:
     max_queue_requests: int = 1024
     default_deadline_s: Optional[float] = None
     warmup: bool = True
+    kv_swap: bool = False
+    prefix_cache: bool = False
+    swap_dir: Optional[str] = None
 
 
 class _Seq:
@@ -202,6 +246,45 @@ class DecodeEngine:
             ),
             label=f"decode.step[{name}]",
         )
+        # KV memory hierarchy executables (ISSUE 19) — all fixed-shape,
+        # warmed alongside the grid, so neither tier costs a
+        # steady-state compile
+        self._prefix_cache = bool(cfg.prefix_cache)
+        self._kv_swap = bool(cfg.kv_swap)
+        self._suffix_prefill = None
+        self._extract = self._restore = self._copy_page = None
+        if self._prefix_cache:
+            self._suffix_prefill = aot_jit(
+                gen.paged_suffix_prefill_fn(
+                    model_cfg, cfg.page_size, max_pages
+                ),
+                label=f"decode.suffix_prefill[{name}]",
+            )
+        if self._prefix_cache or self._kv_swap:
+            ex_fn, rs_fn, cp_fn = gen.paged_page_ops_fns(max_pages)
+            if self._kv_swap:
+                self._extract = aot_jit(
+                    ex_fn, label=f"decode.kvswap.extract[{name}]"
+                )
+                self._restore = aot_jit(
+                    rs_fn, label=f"decode.kvswap.restore[{name}]"
+                )
+            if self._prefix_cache:
+                self._copy_page = aot_jit(
+                    cp_fn, label=f"decode.prefix.copy[{name}]"
+                )
+        self._swap_store = None
+        self._swap: Dict[_Request, Dict[str, object]] = {}
+        # first-page fingerprints of fresh prompts on an UNARMED
+        # engine: a repeat is hard evidence prefill work was shareable
+        # (the TFG113 store_unarmed signal) — bounded, never grows past
+        # the cap
+        self._seen_first_pages: set = set()
+        self._swap_outs = 0
+        self._swap_resumes = 0
+        self._swap_fallbacks = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         # admission: pull mode — no worker thread; the engine loop
         # drains it, its expirer covers the slot-wait queue
         self._admission = ContinuousBatcher(
@@ -287,6 +370,13 @@ class DecodeEngine:
                 1 for s in self._slots if s is not None
             )
         snap["free_pages"] = self._pool.num_free
+        snap["allocatable_pages"] = self._pool.num_allocatable
+        snap["shared_pages"] = self._pool.num_shared
+        snap["swap_outs"] = self._swap_outs
+        snap["swap_resumes"] = self._swap_resumes
+        snap["swap_fallbacks"] = self._swap_fallbacks
+        snap["prefix_hits"] = self._prefix_hits
+        snap["prefix_misses"] = self._prefix_misses
         return snap
 
     # -- lifecycle ----------------------------------------------------------
@@ -311,6 +401,15 @@ class DecodeEngine:
             # cleanly restartable, not a zombie that reports running
             # while every submit sheds as 'closed'
             self._pool.reopen()  # no-op unless restarting after stop()
+            if self._kv_swap and self._swap_store is None:
+                from ..blockstore import BlockStore
+
+                # budget 0: swap segments go straight to disk anyway
+                # (put_spilled), and the swap store must never hold
+                # pages resident on behalf of the pool it is relieving
+                self._swap_store = BlockStore(
+                    root=self.config.swap_dir, budget_bytes=0,
+                )
             if self.config.warmup:
                 self._warm()
             self._admission.start()
@@ -355,6 +454,24 @@ class DecodeEngine:
                 np.zeros(sb, np.int32),
                 np.zeros((sb, self._pool.max_pages_per_seq), np.int32),
             )
+        maxp = self._pool.max_pages_per_seq
+        if self._suffix_prefill is not None:
+            for tb in self._prefill_buckets:
+                self._suffix_prefill(
+                    self.params, cols, np.zeros(tb, np.int32),
+                    np.int32(0), np.int32(1), null,
+                )
+        if self._copy_page is not None:
+            # null page onto itself — garbage by contract either way
+            self._copy_page(cols, np.int32(0), np.int32(0))
+        if self._extract is not None:
+            idx = np.zeros(maxp, np.int32)
+            ex = self._extract(cols, idx)
+            self._restore(
+                cols, idx,
+                np.asarray(ex["k"]), np.asarray(ex["v"]),
+                np.asarray(ex["k_scale"]), np.asarray(ex["v_scale"]),
+            )
         logger.info(
             "decode warmup[%s]: prefill buckets %s + decode buckets %s "
             "in %.2fs", self.name, self._prefill_buckets,
@@ -395,6 +512,22 @@ class DecodeEngine:
             ):
                 self._thread = None
         self._pool.close()  # withdraw from the free-pages gauge
+        store = self._swap_store
+        if store is not None:
+            for r in list(self._swap):
+                self._drop_swap(r)
+            self._swap_store = None
+            store.close()  # deletes the root if the engine created it
+        # TFG113 evidence is scoped to RUNNING endpoints: a stopped
+        # engine's config can no longer be fixed, so its findings are
+        # withdrawn (lint_plan reads the live evidence each call)
+        kept = [e for e in _PREFIX_INELIGIBLE
+                if e.get("endpoint") != self.name]
+        _PREFIX_INELIGIBLE.clear()
+        _PREFIX_INELIGIBLE.extend(kept)
+        for key in [k for k in _PREFIX_INELIGIBLE_SEEN
+                    if k[0] == self.name]:
+            _PREFIX_INELIGIBLE_SEEN.discard(key)
         _flight.record(
             "serving.decode.stop", endpoint=self.name, drain=drain,
         )
@@ -537,13 +670,20 @@ class DecodeEngine:
         """A fresh admission predicate for ONE poll: each accepted
         request claims its prompt pages from the snapshot budget, so a
         multi-request poll can never overcommit the pool (the joins run
-        after the poll returns)."""
-        budget = [self._pool.num_free]
+        after the poll returns). The budget is ``num_allocatable`` —
+        free pages plus reclaimable refcount-0 shared pages — and a
+        host-swapped request claims its SNAPSHOT's page count (it may
+        hold pages past its prompt), not its prompt estimate."""
+        budget = [self._pool.num_allocatable]
 
         def can_take(req: _Request) -> bool:
-            need = self._pool.pages_needed(
-                int(req.feeds["prompt"].shape[0])
-            )
+            snap = self._swap.get(req)
+            if snap is not None:
+                need = int(snap["pages"])
+            else:
+                need = self._pool.pages_needed(
+                    int(req.feeds["prompt"].shape[0])
+                )
             if need > budget[0]:
                 return False
             budget[0] -= need
@@ -554,11 +694,23 @@ class DecodeEngine:
     def _purge_resume(self) -> None:
         # a preempted request can expire (or be abandoned) while
         # requeued — its future resolves in the expirer; drop its
-        # replay state so the dict cannot grow unboundedly
+        # replay state (and swap segment) so neither can grow
+        # unboundedly
         if self._resume:
             dead = [r for r in self._resume if r.future.done()]
             for r in dead:
                 del self._resume[r]
+        if self._swap:
+            for r in [r for r in self._swap if r.future.done()]:
+                self._drop_swap(r)
+
+    def _drop_swap(self, req: _Request) -> None:
+        snap = self._swap.pop(req, None)
+        if snap is not None and self._swap_store is not None:
+            try:
+                self._swap_store.drop(snap["ref"])
+            except Exception:  # pragma: no cover - already dropped
+                pass
 
     def _prefill_bucket(self, plen: int) -> int:
         for b in self._prefill_buckets:
@@ -568,6 +720,124 @@ class DecodeEngine:
             f"prompt of {plen} tokens above the warmed prefill ladder "
             f"{self._prefill_buckets}"
         )
+
+    def _note_prefix_ineligible(self, reason: str, plen: int) -> None:
+        key = (self.name, reason)
+        if key in _PREFIX_INELIGIBLE_SEEN:
+            return
+        _PREFIX_INELIGIBLE_SEEN.add(key)
+        _PREFIX_INELIGIBLE.append({
+            "endpoint": self.name, "reason": reason,
+            "prompt_len": int(plen),
+            "page_size": int(self.config.page_size),
+        })
+
+    def _prefill_seq(self, seq: int, prompt: np.ndarray, plen: int,
+                     resumed: bool) -> Tuple[int, int]:
+        """Write the prompt's KV for a fresh sequence and produce its
+        first token through the cheapest eligible path: shared-prefix
+        suffix prefill, copy-on-extend, or cold full prefill. Returns
+        ``(first_token, shared_pages_referenced)``."""
+        hit_pages: List[int] = []
+        covered = 0
+        cow = None
+        if not self._prefix_cache:
+            # evidence only on an OBSERVED repeat: a prompt whose first
+            # page was already prefilled by an earlier fresh request is
+            # work the cache would have shared — an engine that never
+            # sees overlap has nothing to gain and records nothing
+            if not resumed and plen > self.config.page_size:
+                fp = prompt[:self.config.page_size].tobytes()
+                if fp in self._seen_first_pages:
+                    self._note_prefix_ineligible("store_unarmed", plen)
+                elif len(self._seen_first_pages) < 512:
+                    self._seen_first_pages.add(fp)
+        elif resumed:
+            # a replay-resumed join must reproduce its recorded tokens
+            # against the page state that existed at first admission;
+            # routing it through cache pages published since would
+            # change accounting mid-replay — ineligible by design
+            self._note_prefix_ineligible("sampling_state_mismatch", plen)
+        else:
+            hit_pages, covered, cow, _r = self._pool.prefix_match(prompt)
+            if not hit_pages and cow is None:
+                if plen <= self.config.page_size:
+                    # below one full page nothing can ever be published
+                    # or matched at page granularity
+                    self._note_prefix_ineligible("page_misalignment", plen)
+                m.PREFIX_MISSES.inc()
+                self._prefix_misses += 1
+        if hit_pages:
+            self._pool.prefix_acquire(seq, hit_pages)
+        if hit_pages or cow is not None:
+            m.PREFIX_HITS.inc()
+            self._prefix_hits += 1
+        if cow is not None:
+            # the whole remaining tail is resident in a published page:
+            # copy it (never write a shared page), then teacher-force
+            # only the final prompt token through the solo decode step
+            # — it rewrites KV the copy already holds (deterministic,
+            # identical) and yields the first-token logits
+            dst = self._pool.copy_on_extend(seq, cow)
+            self._pool.columns = self._copy_page(
+                self._pool.columns, np.int32(cow), np.int32(dst)
+            )
+            sb = self._slot_buckets[0]
+            maxp = self._pool.max_pages_per_seq
+            tokens = np.zeros(sb, np.int32)
+            pos = np.zeros(sb, np.int32)
+            tables = np.zeros((sb, maxp), np.int32)
+            tokens[0] = int(prompt[plen - 1])
+            pos[0] = plen - 1
+            tables[0] = self._pool.table(seq)
+            cols, nxt = self._run_step(
+                self.params, self._pool.columns, tokens, pos, tables
+            )
+            self._pool.columns = cols
+            first = int(np.asarray(nxt)[0])
+        elif hit_pages:
+            # matched pages cover [0, covered); prefill only the suffix
+            # through the gather-attending executable (its rows see the
+            # shared pages through the sequence's table)
+            self._pool.alloc(
+                seq, self._pool.pages_needed(plen) - len(hit_pages)
+            )
+            tlen = plen - covered
+            tb = self._prefill_bucket(tlen)
+            padded = np.zeros(tb, np.int32)
+            padded[:tlen] = prompt[covered:]
+            cols, fd = self._suffix_prefill(
+                self.params, self._pool.columns, padded,
+                np.int32(covered), np.int32(tlen),
+                self._pool.table(seq),
+            )
+            self._pool.columns = cols
+            first = int(fd)
+        else:
+            self._pool.alloc(seq, self._pool.pages_needed(plen))
+            tb = self._prefill_bucket(plen)
+            padded = np.zeros(tb, np.int32)
+            padded[:plen] = prompt
+            cols, fd = self._prefill(
+                self.params, self._pool.columns, padded,
+                np.int32(plen), self._pool.table(seq),
+            )
+            self._pool.columns = cols
+            first = int(fd)
+        m.DECODE_STEPS["prefill"].inc()
+        if self._prefix_cache and not resumed:
+            # publish this prompt's freshly written FULL pages so later
+            # requests can share them (no-op on total overlap; stops at
+            # chain-key collisions with another lineage)
+            self._pool.publish_prefix(seq, prompt)
+        if hit_pages or cow is not None:
+            _flight.record(
+                "serving.decode.prefix_hit", endpoint=self.name,
+                seq=seq, prompt_len=plen,
+                shared_pages=len(hit_pages), covered_tokens=covered,
+                copy_on_extend=cow is not None,
+            )
+        return first, len(hit_pages)
 
     def _join(self, req: _Request) -> None:
         now = time.perf_counter()
@@ -579,26 +849,25 @@ class DecodeEngine:
                 f"{now - req.t_submit:.4f}s waiting for a decode slot"
             ))
             self._resume.pop(req, None)
+            self._drop_swap(req)
             return
+        if self._swap_store is not None and req in self._swap:
+            if self._swap_in(req, self._swap.pop(req), now):
+                return
+            # counted fallback: the replay data kept alongside the
+            # snapshot resumes it through the recompute path below
         prompt = req.feeds["prompt"]
         plen = int(prompt.shape[0])
         seq = self._next_seq
         self._next_seq += 1
-        self._pool.alloc(seq, self._pool.pages_needed(plen))
-        tb = self._prefill_bucket(plen)
-        padded = np.zeros(tb, np.int32)
-        padded[:plen] = prompt
-        cols, first = self._prefill(
-            self.params, self._pool.columns, padded, np.int32(plen),
-            self._pool.table(seq),
+        replay = self._resume.pop(req, None)
+        first, prefix_pages = self._prefill_seq(
+            seq, prompt, plen, resumed=bool(replay)
         )
-        self._pool.columns = cols
-        m.DECODE_STEPS["prefill"].inc()
         self._join_counter += 1
         s = _Seq(req, seq, prompt, int(req.feeds["new"]),
                  self._join_counter)
-        replay = self._resume.pop(req, None)
-        tok = int(first)
+        tok = first
         if replay:
             s.replay = collections.deque(replay)
             expect = s.replay.popleft()
@@ -619,7 +888,7 @@ class DecodeEngine:
         _flight.record(
             "serving.decode.join", endpoint=self.name, seq=seq,
             prompt_len=plen, new_tokens=s.want,
-            resumed=bool(replay),
+            resumed=bool(replay), prefix_pages=prefix_pages,
             waited_s=round(now - req.t_submit, 6),
         )
         if _events.TRACER.enabled:
@@ -633,6 +902,84 @@ class DecodeEngine:
             )
         if len(s.generated) >= s.want:
             self._finish(s)
+
+    def _swap_in(self, req: _Request, snap: Dict[str, object],
+                 now: float) -> bool:
+        """Restore a host-swapped sequence's pages bit-identically and
+        put it straight back into a slot — no prefill, no replay, no
+        recompute. Returns False on ANY store or pool problem (counted
+        as ``tftpu_kvswap_fallback_total``; the caller's replay path
+        still resumes the request — a swap problem never loses one)."""
+        seq = self._next_seq
+        self._next_seq += 1
+        try:
+            pages, block = self._pool.swap_in_seq(
+                self._swap_store, snap, seq
+            )
+        except Exception as e:
+            # a corrupt segment was already quarantined + counted by
+            # the store; drop the ref if it survived, count the
+            # fallback, and let the replay join take over
+            try:
+                self._swap_store.drop(snap["ref"])
+            except Exception:
+                pass
+            m.KVSWAP_FALLBACKS.inc()
+            self._swap_fallbacks += 1
+            logger.warning(
+                "decode engine %r: swap-in failed (%s: %s); falling "
+                "back to recompute-replay", self.name,
+                type(e).__name__, e,
+            )
+            _flight.record(
+                "serving.decode.swap_fallback", endpoint=self.name,
+                error=type(e).__name__, message=str(e)[:200],
+            )
+            return False
+        maxp = self._pool.max_pages_per_seq
+        npg = len(pages)
+        idx = np.zeros(maxp, np.int32)
+        idx[:npg] = pages
+        payload = []
+        for name in ("k", "v", "k_scale", "v_scale"):
+            arr = np.asarray(block[name])
+            fullp = np.zeros((maxp,) + arr.shape[1:], arr.dtype)
+            fullp[:npg] = arr
+            payload.append(fullp)
+        # padding rows scatter zeros into the null page — garbage by
+        # contract; one fixed-shape dispatch, warmed at start
+        self._pool.columns = self._restore(
+            self._pool.columns, idx, *payload
+        )
+        self._join_counter += 1
+        s = _Seq(req, seq, req.feeds["prompt"],
+                 int(req.feeds["new"]), self._join_counter)
+        s.pos = int(snap["pos"])
+        s.generated = list(snap["generated"])
+        s.replay = (collections.deque(snap["replay"])
+                    if snap["replay"] else None)
+        self._resume.pop(req, None)
+        self._slots[self._slots.index(None)] = s
+        m.DECODE_SLOTS.inc()
+        m.KVSWAP_RESUMES.inc()
+        self._swap_resumes += 1
+        _flight.record(
+            "serving.decode.swap_in", endpoint=self.name, seq=seq,
+            pages=npg, tokens_done=len(s.generated),
+            waited_s=round(now - req.t_submit, 6),
+        )
+        if _events.TRACER.enabled:
+            args = {"endpoint": self.name, "seq": seq,
+                    "swap_resumed": True}
+            if req.trace_id:
+                args["request_id"] = req.trace_id
+            _events.TRACER.emit_complete(
+                "decode.join", now, time.perf_counter() - now,
+                args=args, cat="serving",
+            )
+        if len(s.generated) >= s.want:
+            self._finish(s)
+        return True
 
     def _active(self) -> List[_Seq]:
         return [s for s in self._slots if s is not None]
@@ -649,10 +996,10 @@ class DecodeEngine:
             if s not in self._slots:
                 continue  # preempted by an earlier fault in this pass
             need = s.pos // self._pool.page_size
-            if need < len(self._pool.owned(s.seq)):
+            if need < len(self._pool.seq_pages(s.seq)):
                 continue
             preempted_self = False
-            while self._pool.num_free < 1:
+            while self._pool.num_allocatable < 1:
                 victim = max(self._active(), key=lambda x: x.joined)
                 self._preempt(victim)
                 if victim is s:
@@ -713,25 +1060,73 @@ class DecodeEngine:
     def _slot_of(self, s: _Seq) -> int:
         return self._slots.index(s)
 
+    def _swap_out(self, s: _Seq) -> Optional[Dict[str, object]]:
+        """Extract the sequence's pages (one fixed-shape gather, warmed)
+        and publish them to the swap store's CRC-stamped disk segment.
+        Returns the snapshot, or None if the store write failed — the
+        caller falls back to plain eviction + recompute-replay, so a
+        swap problem can never lose a request."""
+        pages = self._pool.seq_pages(s.seq)
+        maxp = self._pool.max_pages_per_seq
+        idx = np.zeros(maxp, np.int32)
+        idx[:len(pages)] = pages
+        ex = self._extract(self._pool.columns, idx)
+        block = {
+            name: np.ascontiguousarray(np.asarray(col)[:len(pages)])
+            for name, col in ex.items()
+        }
+        try:
+            snap = self._pool.swap_out_seq(
+                self._swap_store, s.seq, block
+            )
+        except Exception as e:
+            logger.warning(
+                "decode engine %r: swap-out failed (%s: %s); evicting "
+                "with recompute-replay resume", self.name,
+                type(e).__name__, e,
+            )
+            return None
+        snap["pos"] = s.pos
+        snap["generated"] = list(s.generated)
+        snap["replay"] = list(s.replay or ())
+        m.KVSWAP_OUTS.inc()
+        m.KVSWAP_BYTES.inc(int(snap["ref"].nbytes))
+        self._swap_outs += 1
+        _flight.record(
+            "serving.decode.swap_out", endpoint=self.name, seq=s.seq,
+            pages=int(snap["pages"]), bytes=int(snap["ref"].nbytes),
+            tokens_done=len(s.generated),
+        )
+        return snap
+
     def _preempt(self, s: _Seq) -> None:
         self._slots[self._slot_of(s)] = None
         m.DECODE_SLOTS.dec()
-        freed = self._pool.free_seq(s.seq)
+        snap = (self._swap_out(s)
+                if self._swap_store is not None else None)
+        freed = (int(snap["freed"]) if snap is not None
+                 else self._pool.free_seq(s.seq))
         m.DECODE_PREEMPTIONS.inc()
         m.DECODE_EVICTIONS.inc(freed)
         _flight.record(
             "serving.decode.preempt", endpoint=self.name, seq=s.seq,
             tokens_done=len(s.generated), pages_evicted=freed,
+            swapped=snap is not None,
         )
         # requeue at the HEAD with the generated prefix intact: on
-        # rejoin, prefill + teacher-forced replay through the same
-        # executables reproduce the pool state bit-identically. A
+        # rejoin, a swap snapshot restores the pages outright, and the
+        # recompute-replay data is ALWAYS kept beside it — prefill +
+        # teacher-forced replay through the same executables is the
+        # counted fallback if the segment comes back corrupt. A
         # sequence preempted MID-replay keeps its unreplayed suffix
         # too — dropping it would re-count those tokens as fresh and
         # silently skip their bit-identity check
         self._resume[s.req] = list(s.generated) + list(s.replay or ())
+        if snap is not None:
+            self._swap[s.req] = snap
         if not self._admission.requeue_front(s.req):
             self._resume.pop(s.req, None)
+            self._drop_swap(s.req)
 
     def _finish(self, s: _Seq) -> None:
         self._slots[self._slot_of(s)] = None

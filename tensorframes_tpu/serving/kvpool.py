@@ -7,23 +7,37 @@ page-major ``[num_pages, layers, heads, page_size, head_dim]`` — so the
 pool IS a set of frame columns with pages as rows (:meth:`as_frame`
 materializes the TensorFrame view; ROADMAP #3's data plane can later
 back these columns with its block store). This class owns the HOST side:
-the free list, per-sequence page ownership, and the page tables the
-step functions gather through.
+the free list, per-sequence page ownership, the page tables the step
+functions gather through, and (ISSUE 19) the two extra page lifecycles
+of the serving KV memory hierarchy:
+
+* **shared prefix pages** — read-only pages published into a
+  content-addressed index (hash chain over page-granular token
+  prefixes) with per-page refcounts. A sequence whose prompt prefix
+  matches a published chain references those pages instead of
+  re-prefilling them; a page whose refcount drops to 0 stays cached
+  (LRU) until :meth:`alloc` reclaims it under demand.
+* **host-swapped sequences** — :meth:`swap_out_seq` moves one evicted
+  sequence's page payloads into a
+  :class:`~tensorframes_tpu.blockstore.BlockStore` segment (CRC +
+  quarantine machinery included) and :meth:`swap_in_seq` brings them
+  back, so preemption resume restores pages instead of recomputing.
 
 Accounting contract (property-swept in tests/test_decode.py): every
-page except the reserved null page 0 is at all times EITHER free OR
-owned by exactly one sequence — ``alloc`` can never hand out an owned
-page, ``free_seq`` can never double-free, and :meth:`check` asserts the
-partition after any interleaving of join/extend/evict. Page 0 belongs
-to nobody: padding slots and masked prefill positions write their
-garbage there, and the attention masks guarantee it is never read
+page except the reserved null page 0 is at all times in EXACTLY ONE of
+three states — free, exclusively owned by one sequence, or shared with
+a refcount — and :meth:`check` asserts the three-way partition after
+any interleaving of join/extend/evict/share/copy-on-extend/swap. Page 0
+belongs to nobody: padding slots and masked prefill positions write
+their garbage there, and the attention masks guarantee it is never read
 unmasked.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import Dict, List, Optional
+import hashlib
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +54,15 @@ class PoolExhaustedError(RuntimeError):
     """``alloc`` asked for more pages than are free. The decode engine
     turns this into preemption (evict a victim, retry), never an
     unbounded wait."""
+
+
+def _chain_key(prev: bytes, tokens: np.ndarray) -> bytes:
+    """One link of the page-granular content address: the hash of a
+    page's tokens chained onto the hash of everything before it, so a
+    key identifies the page's tokens AND its whole prefix lineage."""
+    h = hashlib.sha1(prev)
+    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+    return h.digest()
 
 
 class PagedKVPool:
@@ -79,6 +102,18 @@ class PagedKVPool:
             range(1, self.num_pages)
         )
         self._owned: Dict[int, List[int]] = {}
+        # -- prefix-cache state (shared read-only pages, ISSUE 19) ----------
+        # a sequence's table is refs (shared prefix chain) + owned
+        # (exclusive pages), in position order
+        self._refs: Dict[int, List[int]] = {}
+        self._shared_ref: Dict[int, int] = {}        # page -> refcount
+        self._shared_lru: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict()                # refcount-0 pages
+        )
+        self._prefix_index: Dict[bytes, int] = {}    # chain key -> page
+        # page -> (parent chain key, own chain key, page tokens)
+        self._prefix_meta: Dict[int, Tuple[bytes, bytes, bytes]] = {}
+        self._prefix_children: Dict[bytes, List[int]] = {}
         self._closed = False
         # the free-pages gauge aggregates by DELTA across live pools
         # (several decode endpoints share one process-wide series; a
@@ -98,6 +133,19 @@ class PagedKVPool:
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_allocatable(self) -> int:
+        """Pages :meth:`alloc` can satisfy right now: the free list plus
+        cached shared pages nobody references (reclaimable on demand).
+        The engine's admission budget and preemption trigger read this —
+        a cache full of refcount-0 pages must not starve admissions."""
+        return len(self._free) + len(self._shared_lru)
+
+    @property
+    def num_shared(self) -> int:
+        """Pages currently in the shared prefix cache (any refcount)."""
+        return len(self._shared_ref)
+
     def pages_needed(self, n_positions: int) -> int:
         """Pages covering ``n_positions`` KV slots."""
         return -(-int(n_positions) // self.page_size)
@@ -105,21 +153,27 @@ class PagedKVPool:
     # -- alloc / free -------------------------------------------------------
 
     def alloc(self, seq: int, n: int) -> List[int]:
-        """Give ``n`` pages to sequence ``seq`` (appended to its table).
-        Raises :class:`PoolExhaustedError` when fewer than ``n`` are
-        free (nothing is partially allocated)."""
+        """Give ``n`` exclusive pages to sequence ``seq`` (appended to
+        its table after any shared prefix). Reclaims refcount-0 shared
+        pages LRU-first when the free list alone cannot cover ``n``;
+        raises :class:`PoolExhaustedError` when even that cannot
+        (nothing is partially allocated)."""
         n = int(n)
         if n < 0:
             raise ValueError(f"alloc of {n} pages")
         held = self._owned.setdefault(int(seq), [])
-        if len(held) + n > self.max_pages_per_seq:
+        total = len(held) + len(self._refs.get(int(seq), ())) + n
+        if total > self.max_pages_per_seq:
             raise PoolAccountingError(
-                f"sequence {seq} would hold {len(held) + n} pages, "
+                f"sequence {seq} would hold {total} pages, "
                 f"over max_pages_per_seq={self.max_pages_per_seq}"
             )
         if n > len(self._free):
+            self._reclaim_shared(n - len(self._free))
+        if n > len(self._free):
             raise PoolExhaustedError(
-                f"need {n} pages, {len(self._free)} free "
+                f"need {n} pages, {len(self._free)} free + "
+                f"{len(self._shared_lru)} reclaimable "
                 f"(of {self.usable_pages} usable)"
             )
         got = [self._free.popleft() for _ in range(n)]
@@ -131,19 +185,22 @@ class PagedKVPool:
         return got
 
     def free_seq(self, seq: int) -> int:
-        """Return every page owned by ``seq`` to the free list; returns
-        the count (0 for a sequence holding nothing). Double frees and
+        """Return every exclusive page owned by ``seq`` to the free list
+        and drop its references on shared prefix pages (a shared page at
+        refcount 0 stays cached until reclaimed). Returns the exclusive
+        count freed (0 for a sequence holding nothing). Double frees and
         corrupted ownership raise :class:`PoolAccountingError`."""
+        self._release_refs(int(seq))
         pages = self._owned.pop(int(seq), None)
         if pages is None:
             return 0
         free_set = set(self._free)
         for p in pages:
-            if p in free_set or p == 0:
+            if p in free_set or p == 0 or p in self._shared_ref:
                 self._owned[int(seq)] = pages  # restore for postmortem
                 raise PoolAccountingError(
                     f"double free: page {p} of sequence {seq} is "
-                    "already free (or the null page)"
+                    "already free, shared, or the null page"
                 )
         self._free.extend(pages)
         if not self._closed:
@@ -155,11 +212,17 @@ class PagedKVPool:
     def owned(self, seq: int) -> List[int]:
         return list(self._owned.get(int(seq), ()))
 
+    def seq_pages(self, seq: int) -> List[int]:
+        """The sequence's full table in position order: shared prefix
+        pages first, then its exclusive pages."""
+        return (list(self._refs.get(int(seq), ()))
+                + list(self._owned.get(int(seq), ())))
+
     def table(self, seq: int) -> np.ndarray:
         """The sequence's page table as the step functions expect it:
         int32 ``[max_pages_per_seq]``, unused tail entries = null page 0."""
         t = np.zeros(self.max_pages_per_seq, np.int32)
-        pages = self._owned.get(int(seq), ())
+        pages = self.seq_pages(seq)
         t[:len(pages)] = pages
         return t
 
@@ -169,37 +232,206 @@ class PagedKVPool:
 
     def close(self) -> None:
         """Withdraw this pool's contribution from the process-wide
-        free-pages gauge (the engine calls it at stop). Accounting and
-        ``check()`` keep working; only the gauge stops tracking."""
+        gauges (the engine calls it at stop). Accounting and ``check()``
+        keep working; only the gauges stop tracking."""
         if not self._closed:
             self._closed = True
             from . import metrics as m
 
             m.DECODE_FREE_PAGES.dec(len(self._free))
+            m.PREFIX_SHARED_PAGES.dec(len(self._shared_ref))
 
     def reopen(self) -> None:
-        """Re-enroll in the free-pages gauge (engine restart)."""
+        """Re-enroll in the process-wide gauges (engine restart)."""
         if self._closed:
             self._closed = False
             from . import metrics as m
 
             m.DECODE_FREE_PAGES.inc(len(self._free))
+            m.PREFIX_SHARED_PAGES.inc(len(self._shared_ref))
+
+    # -- content-addressed prefix cache (ISSUE 19) --------------------------
+
+    def prefix_match(
+        self, tokens: np.ndarray
+    ) -> Tuple[List[int], int, Optional[int], int]:
+        """Longest published chain matching ``tokens``' page-granular
+        prefix. Returns ``(pages, covered, cow_page, cow_tokens)``:
+        ``pages`` are the matched shared pages (covering ``covered``
+        tokens), capped so at least one token is always left to compute
+        (the engine needs the logits at the last prompt position, and
+        computing them writes KV — never into a shared page).
+
+        ``cow_page``, when not None, is a published page whose first
+        ``cow_tokens`` tokens equal the ENTIRE remaining prompt tail —
+        the copy-on-extend candidate: the caller copies it into a fresh
+        exclusive page (:meth:`copy_on_extend`) and teacher-forces only
+        the final token, instead of prefilling the tail."""
+        tokens = np.asarray(tokens, np.int32)
+        plen = int(tokens.shape[0])
+        ps = self.page_size
+        limit = max(0, (plen - 1) // ps)
+        pages: List[int] = []
+        key = b""
+        for i in range(limit):
+            nxt = _chain_key(key, tokens[i * ps:(i + 1) * ps])
+            page = self._prefix_index.get(nxt)
+            if page is None:
+                break
+            pages.append(page)
+            key = nxt
+        covered = len(pages) * ps
+        tail = tokens[covered:]
+        r = plen - covered
+        cow = None
+        if 0 < r <= ps:
+            want = np.ascontiguousarray(tail, np.int32).tobytes()
+            for cand in self._prefix_children.get(key, ()):
+                if self._prefix_meta[cand][2][:len(want)] == want:
+                    cow = cand
+                    break
+        return pages, covered, cow, r
+
+    def prefix_acquire(self, seq: int, pages: List[int]) -> None:
+        """Reference ``pages`` (a matched chain, in position order) as
+        sequence ``seq``'s shared prefix. Must run before the sequence
+        allocates any exclusive page (the table is refs-then-owned)."""
+        seq = int(seq)
+        if self._refs.get(seq) or self._owned.get(seq):
+            raise PoolAccountingError(
+                f"sequence {seq} already holds pages; a shared prefix "
+                "must be acquired before any alloc"
+            )
+        if len(pages) > self.max_pages_per_seq:
+            raise PoolAccountingError(
+                f"prefix of {len(pages)} pages exceeds "
+                f"max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        for p in pages:
+            if p not in self._shared_ref:
+                raise PoolAccountingError(
+                    f"page {p} is not in the shared prefix cache"
+                )
+            if self._shared_ref[p] == 0:
+                self._shared_lru.pop(p, None)
+            self._shared_ref[p] += 1
+        self._refs[seq] = list(pages)
+
+    def _release_refs(self, seq: int) -> None:
+        for p in self._refs.pop(seq, ()):
+            c = self._shared_ref.get(p)
+            if c is None or c < 1:
+                raise PoolAccountingError(
+                    f"sequence {seq} released shared page {p} with "
+                    f"refcount {c}"
+                )
+            self._shared_ref[p] = c - 1
+            if c == 1:
+                # unreferenced but still cached: future prompts can hit
+                # it until alloc pressure reclaims LRU-first
+                self._shared_lru[p] = None
+
+    def publish_prefix(self, seq: int, tokens: np.ndarray) -> int:
+        """Convert sequence ``seq``'s freshly prefilled FULL prompt
+        pages into shared prefix-cache pages (the sequence keeps
+        referencing them; its ragged tail page — decode writes land
+        there — stays exclusive). Publishing stops at the first chain
+        key already indexed by another lineage: the shared prefix must
+        stay contiguous at the head of the table. Returns the number of
+        pages published."""
+        seq = int(seq)
+        tokens = np.asarray(tokens, np.int32)
+        ps = self.page_size
+        refs = self._refs.setdefault(seq, [])
+        owned = self._owned.get(seq, [])
+        full = int(tokens.shape[0]) // ps
+        key = b""
+        for i in range(len(refs)):
+            key = _chain_key(key, tokens[i * ps:(i + 1) * ps])
+        published = 0
+        for i in range(len(refs), full):
+            if not owned:
+                break
+            page_toks = tokens[i * ps:(i + 1) * ps]
+            nxt = _chain_key(key, page_toks)
+            if nxt in self._prefix_index:
+                break
+            page = owned.pop(0)
+            refs.append(page)
+            self._shared_ref[page] = 1
+            self._prefix_index[nxt] = page
+            self._prefix_meta[page] = (
+                key, nxt,
+                np.ascontiguousarray(page_toks, np.int32).tobytes(),
+            )
+            self._prefix_children.setdefault(key, []).append(page)
+            key = nxt
+            published += 1
+        if published and not self._closed:
+            from . import metrics as m
+
+            m.PREFIX_SHARED_PAGES.inc(published)
+        return published
+
+    def copy_on_extend(self, seq: int, src: int) -> int:
+        """Allocate a fresh exclusive page for ``seq`` as the copy
+        target of shared page ``src`` (the ragged-tail copy-on-extend:
+        the caller copies the device payload, then writes freely into
+        the copy). Pure accounting here — returns the destination page."""
+        if src not in self._shared_ref:
+            raise PoolAccountingError(
+                f"copy-on-extend source page {src} is not shared"
+            )
+        return self.alloc(seq, 1)[0]
+
+    def _reclaim_shared(self, n: int) -> int:
+        """Evict up to ``n`` refcount-0 shared pages (LRU-first) back to
+        the free list, unpublishing them from the content index."""
+        evicted = 0
+        while evicted < n and self._shared_lru:
+            page, _ = self._shared_lru.popitem(last=False)
+            if self._shared_ref.pop(page, 0) != 0:
+                raise PoolAccountingError(
+                    f"shared page {page} on the LRU with a live refcount"
+                )
+            parent, key, _toks = self._prefix_meta.pop(page)
+            self._prefix_index.pop(key, None)
+            kids = self._prefix_children.get(parent)
+            if kids:
+                try:
+                    kids.remove(page)
+                except ValueError:
+                    pass
+                if not kids:
+                    del self._prefix_children[parent]
+            self._free.append(page)
+            evicted += 1
+        if evicted and not self._closed:
+            from . import metrics as m
+
+            m.PREFIX_EVICTIONS.inc(evicted)
+            m.PREFIX_SHARED_PAGES.dec(evicted)
+            m.DECODE_FREE_PAGES.inc(evicted)
+        return evicted
 
     # -- invariants ---------------------------------------------------------
 
     def check(self) -> None:
-        """Assert the accounting partition: free ∪ owned = pages 1..P-1,
-        with no page in two places. Cheap; the property sweep calls it
-        after every mutation."""
+        """Assert the accounting partition: free ∪ exclusively-owned ∪
+        shared-with-refcount = pages 1..P-1, with no page in two states,
+        refcounts exactly matching the per-sequence references, and the
+        content index bijective with the shared set. Cheap; the property
+        sweep calls it after every mutation."""
         free = list(self._free)
         free_set = set(free)
         if len(free) != len(free_set):
             raise PoolAccountingError("free list holds a duplicate page")
         owned_all: List[int] = []
         for seq, pages in self._owned.items():
-            if len(pages) > self.max_pages_per_seq:
+            held = len(pages) + len(self._refs.get(seq, ()))
+            if held > self.max_pages_per_seq:
                 raise PoolAccountingError(
-                    f"sequence {seq} holds {len(pages)} pages > "
+                    f"sequence {seq} holds {held} pages > "
                     f"max_pages_per_seq={self.max_pages_per_seq}"
                 )
             owned_all.extend(pages)
@@ -208,19 +440,56 @@ class PagedKVPool:
             raise PoolAccountingError(
                 "a page is owned by two sequences (or twice by one)"
             )
-        if free_set & owned_set:
+        shared_set = set(self._shared_ref)
+        counts: Dict[int, int] = {p: 0 for p in shared_set}
+        for seq, pages in self._refs.items():
+            for p in pages:
+                if p not in shared_set:
+                    raise PoolAccountingError(
+                        f"sequence {seq} references page {p} which is "
+                        "not in the shared set"
+                    )
+                counts[p] += 1
+        for p, want in counts.items():
+            if self._shared_ref[p] != want:
+                raise PoolAccountingError(
+                    f"shared page {p} refcount {self._shared_ref[p]} != "
+                    f"{want} references held"
+                )
+        lru_set = set(self._shared_lru)
+        zero_set = {p for p, c in self._shared_ref.items() if c == 0}
+        if lru_set != zero_set:
             raise PoolAccountingError(
-                f"pages both free and owned: {sorted(free_set & owned_set)}"
+                f"LRU set {sorted(lru_set)} != refcount-0 shared pages "
+                f"{sorted(zero_set)}"
+            )
+        index_pages = sorted(self._prefix_index.values())
+        if index_pages != sorted(set(index_pages)):
+            raise PoolAccountingError(
+                "the prefix index maps two keys to one page"
+            )
+        if set(index_pages) != shared_set or set(
+            self._prefix_meta
+        ) != shared_set:
+            raise PoolAccountingError(
+                "prefix index/meta out of step with the shared set"
+            )
+        overlaps = (free_set & owned_set) | (free_set & shared_set) | (
+            owned_set & shared_set
+        )
+        if overlaps:
+            raise PoolAccountingError(
+                f"pages in two partition states: {sorted(overlaps)}"
             )
         want = set(range(1, self.num_pages))
-        have = free_set | owned_set
+        have = free_set | owned_set | shared_set
         if have != want:
             raise PoolAccountingError(
                 f"leaked pages: {sorted(want - have)}; "
                 f"phantom pages: {sorted(have - want)}"
             )
 
-    # -- host-swap tier (ROADMAP #3 data plane) ------------------------------
+    # -- host-swap tier (blockstore-backed, ISSUE 15 + 19) -------------------
 
     def spill(self, store) -> Dict[str, object]:
         """Snapshot the whole pool into a
@@ -229,11 +498,10 @@ class PagedKVPool:
         a pool snapshot is cold by definition, it must not consume the
         store's resident budget) plus the host bookkeeping (free list,
         ownership) in the returned snapshot dict. This is the KV pool's
-        host-swap tier: a served model's KV state survives an engine
-        restart through the same CRC-checked segments frame blocks
-        spill to, and :meth:`restore` brings it back bit-identically.
-        Per-sequence swap (evict one sequence's pages to host instead
-        of recompute-replay) remains the named follow-up."""
+        whole-pool host-swap tier: a served model's KV state survives an
+        engine restart through the same CRC-checked segments frame
+        blocks spill to, and :meth:`restore` brings it back
+        bit-identically. Per-sequence swap is :meth:`swap_out_seq`."""
         block = {k: np.asarray(v) for k, v in self.columns.items()}
         ref = store.put(block)
         store.spill(ref)
@@ -241,6 +509,16 @@ class PagedKVPool:
             "ref": ref,
             "free": list(self._free),
             "owned": {int(s): list(p) for s, p in self._owned.items()},
+            # prefix-cache state rides the snapshot too — a restored
+            # pool must keep every published page addressable
+            "refs": {int(s): list(p) for s, p in self._refs.items()},
+            "shared_ref": dict(self._shared_ref),
+            "shared_lru": list(self._shared_lru),
+            "prefix_index": dict(self._prefix_index),
+            "prefix_meta": dict(self._prefix_meta),
+            "prefix_children": {
+                k: list(v) for k, v in self._prefix_children.items()
+            },
             "num_pages": self.num_pages,
             "page_size": self.page_size,
             "max_pages_per_seq": self.max_pages_per_seq,
@@ -271,17 +549,91 @@ class PagedKVPool:
             k: jax.device_put(np.asarray(v)) for k, v in block.items()
         }
         old_free = len(self._free)
+        old_shared = len(self._shared_ref)
         self.columns = new_cols
         self._free = collections.deque(int(p) for p in snapshot["free"])
         self._owned = {
             int(s): [int(p) for p in pages]
             for s, pages in dict(snapshot["owned"]).items()
         }
+        self._refs = {
+            int(s): [int(p) for p in pages]
+            for s, pages in dict(snapshot.get("refs", {})).items()
+        }
+        self._shared_ref = {
+            int(p): int(c)
+            for p, c in dict(snapshot.get("shared_ref", {})).items()
+        }
+        self._shared_lru = collections.OrderedDict(
+            (int(p), None) for p in snapshot.get("shared_lru", ())
+        )
+        self._prefix_index = dict(snapshot.get("prefix_index", {}))
+        self._prefix_meta = {
+            int(p): tuple(v)
+            for p, v in dict(snapshot.get("prefix_meta", {})).items()
+        }
+        self._prefix_children = {
+            k: list(v)
+            for k, v in dict(snapshot.get("prefix_children", {})).items()
+        }
         self.check()
         if not self._closed:
             from . import metrics as m
 
             m.DECODE_FREE_PAGES.inc(len(self._free) - old_free)
+            m.PREFIX_SHARED_PAGES.inc(len(self._shared_ref) - old_shared)
+
+    def swap_out_seq(self, store, seq: int,
+                     block: Dict[str, np.ndarray]) -> Dict[str, object]:
+        """Per-sequence host-swap out (ISSUE 19): publish ``block`` —
+        the sequence's page payloads in table order, sliced by the
+        engine's warmed extract executable — straight to a CRC-checked
+        disk segment (``put_spilled``: a swap segment is cold by
+        definition), then release every page the sequence holds (shared
+        refs drop, exclusive pages free). Returns the snapshot the
+        matching :meth:`swap_in_seq` needs; ``freed`` carries the
+        exclusive-page count for the caller's eviction accounting."""
+        seq = int(seq)
+        pages = self.seq_pages(seq)
+        if not pages:
+            raise PoolAccountingError(
+                f"swap_out_seq: sequence {seq} holds no pages"
+            )
+        ref = store.put_spilled(block)
+        freed = self.free_seq(seq)
+        return {
+            "ref": ref,
+            "pages": len(pages),
+            "freed": freed,
+            "page_size": self.page_size,
+        }
+
+    def swap_in_seq(self, store, snapshot: Dict[str, object],
+                    seq: int) -> Tuple[List[int], Dict[str, object]]:
+        """Per-sequence host-swap in: CRC-checked reload of the swap
+        segment (corruption quarantines + raises ``BlockCorruptionError``
+        AFTER the snapshot's ref is dropped, so the caller's counted
+        fallback to recompute-replay starts clean), fresh exclusive
+        pages allocated to ``seq``, segment dropped. Returns
+        ``(pages, block)`` — the caller scatters the payloads into the
+        pages with its warmed restore executable. The restored sequence
+        owns everything exclusively (shared-prefix references are not
+        re-acquired; re-sharing would need a content re-proof)."""
+        from ..blockstore.store import BlockCorruptionError
+
+        if int(snapshot["page_size"]) != self.page_size:
+            raise PoolAccountingError(
+                f"swap_in_seq: snapshot page_size {snapshot['page_size']}"
+                f" != pool page_size {self.page_size}"
+            )
+        try:
+            block = store.get(snapshot["ref"])
+        except BlockCorruptionError:
+            store.drop(snapshot["ref"])
+            raise
+        pages = self.alloc(int(seq), int(snapshot["pages"]))
+        store.drop(snapshot["ref"])
+        return pages, block
 
     # -- frame view ---------------------------------------------------------
 
@@ -300,5 +652,5 @@ class PagedKVPool:
         return (
             f"PagedKVPool(pages={self.num_pages}, "
             f"page_size={self.page_size}, free={self.num_free}, "
-            f"seqs={len(self._owned)})"
+            f"shared={self.num_shared}, seqs={len(self._owned)})"
         )

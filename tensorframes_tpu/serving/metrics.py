@@ -31,6 +31,9 @@ __all__ = [
     "DECODE_PHASES", "DECODE_TOKENS", "DECODE_STEPS", "DECODE_TTFT",
     "DECODE_SLOTS", "DECODE_FREE_PAGES", "DECODE_PREEMPTIONS",
     "DECODE_EVICTIONS",
+    "KVSWAP_OUTS", "KVSWAP_RESUMES", "KVSWAP_FALLBACKS", "KVSWAP_BYTES",
+    "PREFIX_HITS", "PREFIX_MISSES", "PREFIX_SHARED_PAGES",
+    "PREFIX_EVICTIONS",
     "HTTP_REJECT_REASONS", "HTTP_REJECTIONS", "http_rejected",
     "IDEMPOTENT_DEDUP",
     "ROUTER_REJECT_REASONS", "ROUTER_REQUESTS", "ROUTER_REDRIVES",
@@ -175,6 +178,61 @@ DECODE_EVICTIONS = _counter(
     "tftpu_decode_evictions_total",
     "KV pages evicted by preemption (freed from a preempted "
     "sequence's table)",
+)
+
+
+# -- KV memory hierarchy (tftpu_kvswap_* / tftpu_prefix_cache_*, ISSUE 19) --
+# Two page lifecycles beyond the free/owned pair: an evicted sequence's
+# pages host-swapping through the block store (resume = restore, not
+# recompute), and read-only prefix pages shared across requests by
+# content address. The swap counters split the preemption story —
+# preemptions_total keeps counting every eviction, kvswap_out_total the
+# subset whose pages went to disk, and resume vs fallback says whether
+# the swap actually paid off or corruption pushed the request back onto
+# the replay path. The prefix counters are the cache's hit-rate and
+# residency: hits/misses differentiated = how often a prompt's prefill
+# was skipped, shared_pages = pages pinned read-only right now.
+
+KVSWAP_OUTS = _counter(
+    "tftpu_kvswap_out_total",
+    "Preempted sequences whose KV pages were host-swapped to the "
+    "block store (CRC-checked segment) instead of discarded",
+)
+KVSWAP_RESUMES = _counter(
+    "tftpu_kvswap_resume_total",
+    "Sequences resumed by restoring host-swapped pages bit-identically "
+    "(no prefill or teacher-forced replay ran)",
+)
+KVSWAP_FALLBACKS = _counter(
+    "tftpu_kvswap_fallback_total",
+    "Swap-in attempts abandoned for the recompute-replay path (segment "
+    "corruption or store failure — the request still completes; the "
+    "store's quarantine counters name the root cause)",
+)
+KVSWAP_BYTES = _counter(
+    "tftpu_kvswap_bytes_total",
+    "Bytes of KV page payload written to the block store by "
+    "per-sequence swap-out",
+)
+PREFIX_HITS = _counter(
+    "tftpu_prefix_cache_hits_total",
+    "Prompt admissions that reused at least one shared prefix page "
+    "(those prefill chunks were skipped entirely)",
+)
+PREFIX_MISSES = _counter(
+    "tftpu_prefix_cache_misses_total",
+    "Prompt admissions that found no shared prefix page (cold prefill "
+    "ran for the whole prompt; only counted when the cache is armed)",
+)
+PREFIX_SHARED_PAGES = _gauge(
+    "tftpu_prefix_cache_shared_pages",
+    "Pages currently published read-only in the content-addressed "
+    "prefix cache (any refcount, including cached-but-unreferenced)",
+)
+PREFIX_EVICTIONS = _counter(
+    "tftpu_prefix_cache_evictions_total",
+    "Shared prefix pages reclaimed to the free list under allocation "
+    "pressure (only refcount-0 pages are eligible, LRU-first)",
 )
 
 

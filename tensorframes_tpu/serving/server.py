@@ -678,6 +678,15 @@ class Server:
             decode[name] = {
                 "running_slots": snap["running_slots"],
                 "free_pages": snap["free_pages"],
+                # KV memory hierarchy (ISSUE 19): swap engagement and
+                # prefix-cache hit rate per engine, 0 when unarmed
+                "allocatable_pages": snap["allocatable_pages"],
+                "shared_pages": snap["shared_pages"],
+                "swap_outs": snap["swap_outs"],
+                "swap_resumes": snap["swap_resumes"],
+                "swap_fallbacks": snap["swap_fallbacks"],
+                "prefix_hits": snap["prefix_hits"],
+                "prefix_misses": snap["prefix_misses"],
             }
         out = {
             "running": running,

@@ -531,3 +531,344 @@ def test_decode_flight_records(model):
     for want in ("serving.decode.start", "serving.decode.join",
                  "serving.decode.finish", "serving.decode.stop"):
         assert want in kinds, f"missing flight record {want}"
+
+
+# ---------------------------------------------------------------------------
+# KV memory hierarchy (ISSUE 19): prefix cache + per-sequence host swap
+# ---------------------------------------------------------------------------
+
+def test_kvpool_property_sweep_swap_and_prefix_interleaved(model, tmp_path):
+    """Random interleaving of swap_out/swap_in/prefix-share/copy-on-
+    extend with join/extend/leave — the extended three-way partition
+    (free + exclusive + shared-with-refcount) must hold after EVERY op,
+    and draining everything returns the pool to fully allocatable."""
+    from tensorframes_tpu.blockstore import BlockStore
+
+    cfg, _ = model
+    ps = 4
+    pool = PagedKVPool(cfg, num_pages=33, page_size=ps,
+                       max_pages_per_seq=6)
+    store = BlockStore(root=str(tmp_path / "swap"), budget_bytes=0)
+    rng = np.random.default_rng(19)
+    vocab = 40
+    # joins draw from shared templates so page-granular prefixes really
+    # repeat (pure random prompts would never collide at 4 tokens)
+    templates = [
+        rng.integers(0, vocab, (ps * 4,)).astype(np.int32)
+        for _ in range(3)
+    ]
+    live = {}      # seq -> prompt tokens
+    swapped = []   # swap snapshots (with their prompt riding along)
+    next_seq = [0]
+
+    def fresh_seq():
+        next_seq[0] += 1
+        return next_seq[0] - 1
+
+    ops = 0
+    hits = cows = outs = resumes = published = 0
+    for _ in range(650):
+        op = int(rng.integers(0, 7))
+        if op == 0:  # join, riding the prefix cache when it matches
+            t = templates[int(rng.integers(0, len(templates)))]
+            plen = int(rng.integers(1, ps * 4 + 1))
+            cut = int(rng.integers(0, plen + 1))
+            tokens = np.concatenate([
+                t[:cut],
+                rng.integers(0, vocab, (plen - cut,)).astype(np.int32),
+            ]).astype(np.int32)
+            need = pool.pages_needed(plen)
+            if pool.num_allocatable < need:
+                continue
+            seq = fresh_seq()
+            matched, covered, cow, _r = pool.prefix_match(tokens)
+            if matched:
+                pool.prefix_acquire(seq, matched)
+                hits += 1
+            if cow is not None:
+                pool.copy_on_extend(seq, cow)
+                cows += 1
+            else:
+                pool.alloc(seq, need - len(matched))
+            if rng.integers(0, 2):
+                published += pool.publish_prefix(seq, tokens)
+            live[seq] = tokens
+        elif op == 1 and live:  # extend (a decode step crossed a page)
+            seq = int(rng.choice(sorted(live)))
+            if (len(pool.seq_pages(seq)) < pool.max_pages_per_seq
+                    and pool.num_allocatable >= 1):
+                pool.alloc(seq, 1)
+        elif op == 2 and live:  # leave (finish / evict-without-swap)
+            seq = int(rng.choice(sorted(live)))
+            pool.free_seq(seq)
+            del live[seq]
+        elif op == 3 and live:  # preempt with host-swap
+            seq = int(rng.choice(sorted(live)))
+            npg = len(pool.seq_pages(seq))
+            block = {"payload": np.full((npg, 3), seq, np.int32)}
+            snap = pool.swap_out_seq(store, seq, block)
+            assert int(snap["pages"]) == npg
+            snap["tokens"] = live.pop(seq)
+            swapped.append(snap)
+            outs += 1
+        elif op == 4 and swapped:  # swap-resume under a fresh seq id
+            snap = swapped.pop(int(rng.integers(0, len(swapped))))
+            if pool.num_allocatable < int(snap["pages"]):
+                swapped.append(snap)
+                continue
+            seq = fresh_seq()
+            pages, block = pool.swap_in_seq(store, snap, seq)
+            assert len(pages) == int(snap["pages"])
+            assert block["payload"].shape == (len(pages), 3)
+            live[seq] = snap["tokens"]
+            resumes += 1
+        elif op == 5 and live:  # publish again (idempotent at collisions)
+            seq = int(rng.choice(sorted(live)))
+            published += pool.publish_prefix(seq, live[seq])
+        elif op == 6 and pool.num_allocatable >= 2:  # pressure burst
+            seq = fresh_seq()
+            pool.alloc(seq, 2)
+            live[seq] = np.zeros(0, np.int32)
+        pool.check()
+        ops += 1
+    assert ops >= 500
+    # the sweep actually exercised every new op at least once
+    assert hits > 0 and cows > 0 and outs > 0 and resumes > 0
+    assert published > 0
+    # drain: every page comes back, swap segments drop cleanly
+    for seq in sorted(live):
+        pool.free_seq(seq)
+    for snap in swapped:
+        store.drop(snap["ref"])
+    pool.check()
+    assert pool.num_allocatable == pool.usable_pages
+    # cached refcount-0 shared pages reclaim under real demand
+    big = fresh_seq()
+    pool.alloc(big, pool.max_pages_per_seq)
+    pool.check()
+    pool.free_seq(big)
+    store.close()
+
+
+def test_kvpool_swap_misuse_raises(model, tmp_path):
+    from tensorframes_tpu.blockstore import BlockStore
+
+    cfg, _ = model
+    pool = PagedKVPool(cfg, num_pages=9, page_size=4, max_pages_per_seq=4)
+    store = BlockStore(root=str(tmp_path / "swap"), budget_bytes=0)
+    with pytest.raises(PoolAccountingError):
+        pool.swap_out_seq(store, 7, {"x": np.zeros((1, 2), np.int8)})
+    pool.alloc(1, 2)
+    snap = pool.swap_out_seq(
+        store, 1, {"x": np.zeros((2, 2), np.int8)}
+    )
+    other = PagedKVPool(cfg, num_pages=9, page_size=8,
+                        max_pages_per_seq=4)
+    with pytest.raises(PoolAccountingError):
+        other.swap_in_seq(store, snap, 1)  # page-size mismatch
+    pages, _ = pool.swap_in_seq(store, snap, 2)
+    assert len(pages) == 2
+    pool.free_seq(2)
+    pool.check()
+    store.close()
+
+
+def test_prefix_cache_hits_bit_identical_and_counted(model):
+    """Cold -> exact repeat (copy-on-extend) -> shared-page + fresh
+    suffix (suffix prefill): every reply bit-identical to the dense
+    oracle, hits counted, zero steady-state compiles."""
+    from tensorframes_tpu.ops.executor import _JIT_MISSES
+
+    cfg, params = model
+    eng = DecodeEngine("t_prefix", cfg, params, DecodeConfig(
+        max_slots=4, page_size=8, max_prompt_len=16, max_new_tokens=8,
+        prefix_cache=True,
+    ))
+    eng.start()
+    try:
+        h0 = sm.PREFIX_HITS.value
+        miss0 = _JIT_MISSES.value
+        rng = np.random.default_rng(53)
+        shared = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        cold = eng.call({"prompt": shared}, timeout=300)["tokens"]
+        assert np.array_equal(cold, _reference(model, shared, 8))
+        # exact repeat: whole-prompt reuse through copy-on-extend
+        hot = eng.call({"prompt": shared}, timeout=300)["tokens"]
+        assert np.array_equal(hot, cold)
+        # shared first page, fresh suffix: suffix-only prefill
+        p2 = np.concatenate([
+            shared[:8],
+            rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32),
+        ])
+        out2 = eng.call({"prompt": p2}, timeout=300)["tokens"]
+        assert np.array_equal(out2, _reference(model, p2, 8))
+        assert sm.PREFIX_HITS.value - h0 >= 2
+        snap = eng.counters()
+        assert snap["prefix_hits"] >= 2
+        assert snap["shared_pages"] > 0
+        assert int(_JIT_MISSES.value - miss0) == 0, \
+            "prefix-cache path compiled in steady state"
+        assert eng.pool.num_shared > 0
+    finally:
+        eng.stop(drain=True, timeout=300)
+    eng.pool.check()
+
+
+def test_swap_resume_undersized_pool_bit_identical(model, tmp_path):
+    """kv_swap on an undersized pool: preemptions swap out instead of
+    discarding, resumes restore pages instead of replaying, and every
+    request still completes bit-identically to the dense oracle."""
+    cfg, params = model
+    new = 8
+    eng = DecodeEngine("t_swap", cfg, params, DecodeConfig(
+        max_slots=4, page_size=8, num_pages=1 + 2 * 3,
+        max_prompt_len=16, max_new_tokens=new,
+        kv_swap=True, swap_dir=str(tmp_path / "swap"),
+    ))
+    eng.start()
+    try:
+        o0, r0 = sm.KVSWAP_OUTS.value, sm.KVSWAP_RESUMES.value
+        f0 = sm.KVSWAP_FALLBACKS.value
+        t0 = sm.DECODE_TOKENS.value
+        prompts = _prompts(8, 9, 16, seed=61, vocab=cfg.vocab_size)
+        futs = [eng.submit({"prompt": p}) for p in prompts]
+        outs = [f.result(600)["tokens"] for f in futs]
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _reference(model, p, new))
+        assert sm.KVSWAP_OUTS.value - o0 > 0
+        assert sm.KVSWAP_RESUMES.value - r0 > 0
+        assert sm.KVSWAP_FALLBACKS.value - f0 == 0
+        # swap resume regenerates nothing: fresh tokens only, once each
+        assert sm.DECODE_TOKENS.value - t0 == len(prompts) * new
+        snap = eng.counters()
+        assert snap["swap_outs"] > 0 and snap["swap_resumes"] > 0
+    finally:
+        eng.stop(drain=True, timeout=600)
+    eng.pool.check()
+    assert eng.pool.num_free == eng.pool.usable_pages
+
+
+def test_corrupted_swap_segment_counted_fallback_bit_identical(
+    model, tmp_path
+):
+    """Flip a byte in every swap segment as it lands: swap-in hits a
+    real CRC failure, the engine falls back to recompute-replay (the
+    counted path), and NO request is lost — outputs stay bit-identical
+    to the oracle."""
+    import os
+
+    cfg, params = model
+    new = 8
+    eng = DecodeEngine("t_swapcorrupt", cfg, params, DecodeConfig(
+        max_slots=4, page_size=8, num_pages=1 + 2 * 3,
+        max_prompt_len=16, max_new_tokens=new,
+        kv_swap=True, swap_dir=str(tmp_path / "swap"),
+    ))
+    eng.start()
+    store = eng._swap_store
+    orig_put = store.put_spilled
+
+    def corrupting_put(block):
+        ref = orig_put(block)
+        seg = store._seg_dir(ref.block_id)
+        for fn in sorted(os.listdir(seg)):
+            if fn.endswith(".bin"):
+                path = os.path.join(seg, fn)
+                with open(path, "r+b") as f:
+                    b = f.read(1)
+                    f.seek(0)
+                    f.write(bytes([b[0] ^ 0xFF]))
+                break
+        return ref
+
+    store.put_spilled = corrupting_put
+    try:
+        o0 = sm.KVSWAP_OUTS.value
+        f0 = sm.KVSWAP_FALLBACKS.value
+        r0 = sm.KVSWAP_RESUMES.value
+        prompts = _prompts(8, 9, 16, seed=67, vocab=cfg.vocab_size)
+        futs = [eng.submit({"prompt": p}) for p in prompts]
+        outs = [f.result(600)["tokens"] for f in futs]
+        assert sm.KVSWAP_OUTS.value - o0 > 0
+        assert sm.KVSWAP_FALLBACKS.value - f0 > 0, \
+            "corruption never engaged the counted fallback"
+        assert sm.KVSWAP_RESUMES.value - r0 == 0
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _reference(model, p, new))
+        assert eng.counters()["swap_fallbacks"] > 0
+    finally:
+        eng.stop(drain=True, timeout=600)
+    eng.pool.check()
+
+
+def test_tfg113_prefix_cache_ineligible_diagnostic(model):
+    """Repeated prompt prefixes on an engine with the cache OFF leave
+    store_unarmed evidence while the engine runs; lint_plan surfaces
+    it as TFG113 with the arm-the-cache fix; stopping the engine
+    withdraws its evidence (a stopped endpoint's config can no longer
+    be fixed — and later lint tests in this process stay clean)."""
+    from tensorframes_tpu.serving import decode as dec
+
+    cfg, params = model
+
+    def lint():
+        fr = tfs.frame_from_arrays(
+            {"x": np.arange(8, dtype=np.float32)}
+        )
+        f2 = tfs.map_blocks(lambda x: {"y": x + 1.0}, fr)
+        return tfs.lint_plan(f2)
+
+    eng = DecodeEngine("t_tfg113", cfg, params, DecodeConfig(
+        max_slots=2, page_size=8, max_prompt_len=16,
+        max_new_tokens=2,
+    ))
+    eng.start()
+    try:
+        rng = np.random.default_rng(59)
+        p = rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32)
+        eng.call({"prompt": p}, timeout=300)
+        # one miss is not evidence...
+        assert not any(
+            e["reason"] == "store_unarmed"
+            and e["endpoint"] == "t_tfg113"
+            for e in dec.prefix_cache_events()
+        )
+        eng.call({"prompt": p.copy()}, timeout=300)
+        # ...an OBSERVED repeat of the first page is
+        evs = dec.prefix_cache_events()
+        assert any(
+            e["reason"] == "store_unarmed"
+            and e["endpoint"] == "t_tfg113" for e in evs
+        )
+        found = lint().by_code("TFG113")
+        assert found, "lint_plan did not surface TFG113"
+        mine = [d for d in found if d.subject == "t_tfg113"]
+        assert mine, "TFG113 finding not bound to the endpoint"
+        assert "prefix_cache=True" in mine[0].fix
+        assert "docs/analysis.md#tfg113" in mine[0].explain()
+    finally:
+        eng.stop(drain=True, timeout=300)
+    # stop() withdrew the endpoint's evidence: later lints are clean
+    assert not any(
+        e["endpoint"] == "t_tfg113" for e in dec.prefix_cache_events()
+    )
+    assert not any(
+        d.subject == "t_tfg113" for d in lint().by_code("TFG113")
+    )
+
+
+def test_kvswap_prefix_metrics_preregistered():
+    from tensorframes_tpu.observability.metrics import REGISTRY
+
+    names = {m.name for m in REGISTRY.collect()}
+    for want in (
+        "tftpu_kvswap_out_total",
+        "tftpu_kvswap_resume_total",
+        "tftpu_kvswap_fallback_total",
+        "tftpu_kvswap_bytes_total",
+        "tftpu_prefix_cache_hits_total",
+        "tftpu_prefix_cache_misses_total",
+        "tftpu_prefix_cache_shared_pages",
+        "tftpu_prefix_cache_evictions_total",
+    ):
+        assert want in names, f"{want} not pre-registered"
