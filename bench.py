@@ -1268,6 +1268,183 @@ def _bench_kv_hierarchy(n_samples: int = 12, new_tokens: int = 8):
     }
 
 
+def _registered_query_build(f):
+    """The bench's registered pipeline (module-level so the FUSION=0
+    oracle subprocess rebuilds the IDENTICAL chain): dtype-preserving
+    map → keyed sum/min/max aggregate, all int64 so the incremental
+    fold is exact."""
+    import tensorframes_tpu as tfs
+
+    f1 = tfs.map_blocks(
+        lambda v: {"ysum": v * 3 + 1, "ymin": v * 3 + 1,
+                   "ymax": v * 3 + 1},
+        f,
+    )
+    with tfs.with_graph():
+        s_in = tfs.block(f1, "ysum", tf_name="ysum_input")
+        mn_in = tfs.block(f1, "ymin", tf_name="ymin_input")
+        mx_in = tfs.block(f1, "ymax", tf_name="ymax_input")
+        return tfs.aggregate(
+            [
+                tfs.reduce_sum(s_in, axis=0, name="ysum"),
+                tfs.reduce_min(mn_in, axis=0, name="ymin"),
+                tfs.reduce_max(mx_in, axis=0, name="ymax"),
+            ],
+            f1.group_by("k"),
+        )
+
+
+def _registered_query_oracle(data_dir: str, out_npz: str) -> None:
+    """Subprocess half of the bench's bit-identity gate: run under
+    TFTPU_FUSION=0 (plan recording off → the endpoint degrades to full
+    eager recompute), key-sort the table, save it for the parent to
+    compare dtype+bytes. Sorting happens HERE because eager mode does
+    not canonicalize output order."""
+    from tensorframes_tpu.serving import QueryEndpoint, QuerySource
+
+    q = QueryEndpoint(
+        "oracle", QuerySource(path=data_dir, kind="csv"),
+        _registered_query_build,
+    )
+    table = q.execute()
+    order = np.argsort(table["k"], kind="stable")
+    np.savez(out_npz, **{k: np.asarray(v)[order] for k, v in table.items()})
+
+
+def _bench_registered_query(n_chunks: int = 56,
+                            rows_per_chunk: int = 80_000,
+                            check_fusion0: bool = True):
+    """Registered query endpoint (ISSUE 20): plan-fingerprint result
+    caching + incremental aggregate maintenance over a growing CSV scan
+    directory. Equal-row chunks so every per-chunk execution shares ONE
+    compiled shape. Measures: first (cold) execution, warm-repeat p50
+    (the cache-hit path), steady-state compiles across the repeats, the
+    incremental refresh after appending one chunk, and the full-
+    recompute wall over the same post-append table — plus bit-identity
+    of both answers against a TFTPU_FUSION=0 subprocess."""
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.config import get_config
+    from tensorframes_tpu.ops.executor import _JIT_MISSES
+    from tensorframes_tpu.serving import QueryEndpoint, QuerySource
+
+    tmp = tempfile.mkdtemp(prefix="tftpu_regq_")
+    prev_cache = get_config().compilation_cache_dir
+    rng = np.random.default_rng(0)
+    try:
+        data = os.path.join(tmp, "data")
+        os.makedirs(data)
+        tfs.configure(
+            compilation_cache_dir=os.path.join(tmp, "cache")
+        )
+
+        def write_chunk(i):
+            ks = rng.integers(0, 64, size=rows_per_chunk)
+            vs = rng.integers(-1000, 1000, size=rows_per_chunk)
+            with open(os.path.join(data, f"part-{i:05d}.csv"), "w") as fh:
+                fh.write("k,v\n")
+                fh.write("\n".join(f"{k},{v}" for k, v in zip(ks, vs)))
+                fh.write("\n")
+
+        for i in range(n_chunks):
+            write_chunk(i)
+        q = QueryEndpoint(
+            "bench", QuerySource(path=data, kind="csv"),
+            _registered_query_build,
+        )
+        assert q.cache_stats()["incremental"], (
+            "int64 sum/min/max must be fold-eligible"
+        )
+        t0 = time.perf_counter()
+        q.execute()
+        first_s = time.perf_counter() - t0
+        # warm repeats: p50 must be dominated by the cache lookup, with
+        # ZERO compiles (hard gate) — hits never touch the executor
+        miss0 = _JIT_MISSES.value
+        reps = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            q.execute()
+            reps.append(time.perf_counter() - t0)
+        steady = int(_JIT_MISSES.value - miss0)
+        repeat_p50 = sorted(reps)[len(reps) // 2]
+        hits = q.cache_stats()["hits"]
+        assert hits >= 20, f"warm repeats missed the cache ({hits} hits)"
+        # append ONE chunk: the refresh re-reads/re-executes only it
+        write_chunk(n_chunks)
+        ex0 = q.cache_stats()["chunks_executed"]
+        t0 = time.perf_counter()
+        table_inc = q.execute()
+        refresh_s = time.perf_counter() - t0
+        ex1 = q.cache_stats()["chunks_executed"]
+        assert ex1 - ex0 == 1, (
+            f"refresh re-executed {ex1 - ex0} chunks, not just the "
+            "appended one"
+        )
+        # full recompute over the SAME post-append table, through the
+        # endpoint's own oracle path (shared compiled executables;
+        # warmed once so its one big-block compile stays out of the
+        # timed wall — the comparison is steady-state work, not compile)
+        manifest = q._manifest()
+        q._execute_full(manifest)
+        t0 = time.perf_counter()
+        table_full = q._execute_full(manifest)
+        full_s = time.perf_counter() - t0
+        order = np.argsort(table_full["k"], kind="stable")
+        for k in table_inc:
+            a = np.asarray(table_inc[k])
+            b = np.asarray(table_full[k])[order]
+            assert a.dtype == b.dtype and np.array_equal(a, b), (
+                f"incremental refresh diverged from full recompute on "
+                f"column {k!r}"
+            )
+        fusion0_identical = None
+        if check_fusion0:
+            out_npz = os.path.join(tmp, "oracle.npz")
+            env = dict(os.environ)
+            env["TFTPU_FUSION"] = "0"
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            env.pop("TFTPU_COMPILE_CACHE", None)
+            subprocess.run(
+                [_sys.executable, os.path.abspath(__file__),
+                 "registered-query-oracle", data, out_npz],
+                check=True, env=env, timeout=300,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            with np.load(out_npz) as ref:
+                fusion0_identical = True
+                for k in table_inc:
+                    a = np.asarray(table_inc[k])
+                    b = ref[k]
+                    if a.dtype != b.dtype or not np.array_equal(a, b):
+                        fusion0_identical = False
+        cs = q.cache_stats()
+        return {
+            "chunks": n_chunks + 1,
+            "rows": (n_chunks + 1) * rows_per_chunk,
+            "first_execute_s": first_s,
+            "repeat_p50_s": repeat_p50,
+            "repeat_speedup": first_s / max(repeat_p50, 1e-9),
+            "steady_state_compiles": steady,
+            "refresh_s": refresh_s,
+            "full_recompute_s": full_s,
+            "refresh_frac": refresh_s / max(full_s, 1e-9),
+            "fusion0_identical": fusion0_identical,
+            "cache_hits": cs["hits"],
+            "cache_invalidations": cs["invalidations"],
+            "chunks_folded": cs["chunks_folded"],
+            "chunks_executed": cs["chunks_executed"],
+        }
+    finally:
+        tfs.configure(compilation_cache_dir=prev_cache)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_read_csv(n_rows: int = 1_000_000):
     """CSV → frame ingestion (native C++ single-pass parser), s/call."""
     import os
@@ -2524,6 +2701,19 @@ def main():
             "serving_decode_swap_resumes_total",
         ),
     ) or {}
+    # registered query endpoint (ISSUE 20): result-cache repeat speedup
+    # + incremental-refresh fraction ride the snapshot schema; the
+    # FUSION=0 subprocess bit-identity gate runs in the dedicated
+    # `bench.py registered-query` CI leg, not here
+    regq_res = _try(
+        "registered_query",
+        lambda: _bench_registered_query(check_fusion0=False), {},
+        metric_keys=(
+            "registered_query_repeat_speedup",
+            "registered_query_repeat_p50_s",
+            "registered_query_refresh_frac",
+        ),
+    ) or {}
     if serving_res:
         print(
             "# serving | open_loop rows_per_sec={:.0f} p50={:.6f}s "
@@ -2564,6 +2754,18 @@ def main():
                 kvh_res["shared_pages"], kvh_res["swap_resumes"],
                 kvh_res["swap_fallbacks"],
                 kvh_res["steady_state_compiles"],
+            )
+        )
+    if regq_res:
+        print(
+            "# serving | registered_query chunks={} first={:.4f}s "
+            "repeat_p50={:.6f}s speedup={:.0f}x refresh_frac={:.3f} "
+            "steady_state_compiles={} (gates ride `bench.py "
+            "registered-query`)".format(
+                regq_res["chunks"], regq_res["first_execute_s"],
+                regq_res["repeat_p50_s"], regq_res["repeat_speedup"],
+                regq_res["refresh_frac"],
+                regq_res["steady_state_compiles"],
             )
         )
 
@@ -2670,6 +2872,15 @@ def main():
         ),
         "serving_decode_swap_resumes_total": int(
             kvh_res.get("swap_resumes", 0)
+        ),
+        "registered_query_repeat_speedup": round(
+            regq_res.get("repeat_speedup", 0.0), 1
+        ),
+        "registered_query_repeat_p50_s": round(
+            regq_res.get("repeat_p50_s", 0.0), 6
+        ),
+        "registered_query_refresh_frac": round(
+            regq_res.get("refresh_frac", 0.0), 4
         ),
     }
     print(f"# chips={n_chips} devices={jax.devices()}")
@@ -3453,9 +3664,80 @@ def out_of_core_main():
         sys.exit(1)
 
 
+def registered_query_main():
+    """``python bench.py registered-query`` — the CI registered-query
+    smoke: a map→aggregate endpoint over a 56-chunk CSV scan directory.
+    Hard gates (exit nonzero): warm repeat p50 ≥10x faster than the
+    first execution with ZERO steady-state compiles; the incremental
+    refresh after appending one chunk under 10% of the full-recompute
+    wall over the same table; and both answers bit-identical to a
+    TFTPU_FUSION=0 full recompute in a subprocess. Writes
+    ``registered_query_metrics.jsonl`` (the ``tftpu_result_cache_*``
+    family rides it) into ``TFTPU_OBS_EXPORT`` and prints one JSON line
+    for scripting."""
+    import os
+    import sys
+
+    res = _try("registered_query", _bench_registered_query, {}) or {}
+    if res:
+        print(
+            "# registered-query | chunks={} rows={:,} first={:.4f}s "
+            "repeat_p50={:.6f}s speedup={:.0f}x refresh={:.4f}s "
+            "full={:.4f}s refresh_frac={:.3f} steady_compiles={} "
+            "fusion0_identical={}".format(
+                res["chunks"], res["rows"], res["first_execute_s"],
+                res["repeat_p50_s"], res["repeat_speedup"],
+                res["refresh_s"], res["full_recompute_s"],
+                res["refresh_frac"], res["steady_state_compiles"],
+                res["fusion0_identical"],
+            )
+        )
+        for k in ("cache_hits", "cache_invalidations", "chunks_folded",
+                  "chunks_executed"):
+            print(f"# registered_query_{k}={res[k]}")
+    out_dir = os.environ.get("TFTPU_OBS_EXPORT")
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        from tensorframes_tpu.observability.metrics import REGISTRY
+
+        REGISTRY.write_jsonl(
+            os.path.join(out_dir, "registered_query_metrics.jsonl")
+        )
+        print(f"# registered-query | artifacts -> {out_dir}")
+    print(json.dumps({
+        "metric": "registered-query warm repeat speedup",
+        "value": round(res.get("repeat_speedup", 0.0), 1),
+        "unit": "x",
+        "repeat_p50_s": res.get("repeat_p50_s"),
+        "refresh_frac": res.get("refresh_frac"),
+        "steady_state_compiles": res.get("steady_state_compiles"),
+        "fusion0_identical": res.get("fusion0_identical"),
+    }))
+    failed = (
+        not res
+        or res.get("repeat_speedup", 0.0) < 10.0
+        or res.get("refresh_frac", 1.0) >= 0.10
+        or res.get("steady_state_compiles", 1) != 0
+        or res.get("fusion0_identical") is not True
+    )
+    if failed:
+        print(
+            "# registered-query | FAILED: repeat speedup < 10x, refresh "
+            ">= 10% of full recompute, steady-state compiles != 0, or "
+            "divergence from the TFTPU_FUSION=0 oracle"
+        )
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     import sys as _sys
 
+    if len(_sys.argv) > 1 and _sys.argv[1] == "registered-query":
+        registered_query_main()
+        _sys.exit(0)
+    if len(_sys.argv) > 1 and _sys.argv[1] == "registered-query-oracle":
+        _registered_query_oracle(_sys.argv[2], _sys.argv[3])
+        _sys.exit(0)
     if len(_sys.argv) > 1 and _sys.argv[1] == "serving":
         serving_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "serving-decode":

@@ -103,7 +103,7 @@ run_step "Test (8-device virtual CPU mesh)" \
 # green on the per-stage executor path (test_plan omitted: its fixture
 # forces fusion ON; its equivalence sweep runs the fallback internally)
 run_step "Fusion-off smoke (TFTPU_FUSION=0 fallback stays green)" \
-  env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py tests/test_relational_pipeline.py -q
+  env TFTPU_FUSION=0 python -m pytest tests/test_verbs.py tests/test_frame.py tests/test_property_sweep.py tests/test_relational_pipeline.py tests/test_registered_query.py -q
 
 # ci.yml's re-optimization-off smoke (ISSUE 14): TFTPU_REOPT=0 turns
 # the adaptive optimizer (aggregate pushdown below joins, join
@@ -233,6 +233,18 @@ run_step "Serving fleet smoke (kill -9 a replica under open-loop load)" bash -c 
 run_step "Out-of-core smoke (5x-budget CSV stream, bounded RSS)" bash -c "
   env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.out_of_core_main()\" &&
   test -s '$WORK/obs/out_of_core_metrics.jsonl'
+"
+
+# ci.yml's registered-query step (ISSUE 20): the restart smoke (two
+# fresh subprocesses, one compile cache — run 2 answers from the
+# persistent result store with zero executions and zero compiles, bit-
+# identical), then the bench leg's hard gates (warm repeat ≥10x,
+# one-chunk refresh <10% of full recompute, FUSION=0 bit-identity)
+run_step "Registered-query smoke (result cache survives a restart + bench gates)" bash -c "
+  python '$CLONE/dev/registered_query_smoke.py' &&
+  env TFTPU_OBS_EXPORT='$WORK/obs' python -c \"import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.registered_query_main()\" &&
+  test -s '$WORK/obs/registered_query_metrics.jsonl' &&
+  grep -q tftpu_result_cache_hits_total '$WORK/obs/registered_query_metrics.jsonl'
 "
 
 # ci.yml's fleet chaos-drill step: kill-rank + hung-collective +

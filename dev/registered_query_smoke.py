@@ -1,0 +1,127 @@
+"""Registered-query restart smoke (ISSUE 20): the cross-process
+contract of the persistent result cache.
+
+Two FRESH subprocesses share one TFTPU_COMPILE_CACHE (and one CSV scan
+directory). Run 1 registers a map→aggregate endpoint, executes (cold:
+every chunk runs), and publishes per-chunk partials + the result table
+into ``<cache>/results``. Run 2 registers the SAME pipeline and must
+answer from the store alone: result-cache hits > 0, ZERO chunk
+executions, ZERO XLA compiles (the probe only parses one chunk and
+inspects the plan — nothing dispatches), and a bit-identical table.
+Evidence rides each run's metrics JSONL (``tftpu_result_cache_*``,
+``tftpu_executor_compile_seconds``) — the same artifact CI uploads.
+
+Usage: ``python dev/registered_query_smoke.py`` (driver; exits nonzero
+on any gate). The ``--worker`` form is the subprocess half.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker(data_dir: str, cache_dir: str, out_npz: str,
+            obs_dir: str) -> None:
+    sys.path.insert(0, ROOT)
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.observability.metrics import REGISTRY
+    from tensorframes_tpu.serving import QueryEndpoint, QuerySource
+
+    tfs.configure(compilation_cache_dir=cache_dir)
+
+    def build(f):
+        f1 = tfs.map_blocks(lambda v: {"y": v * 5 - 2}, f)
+        with tfs.with_graph():
+            y_in = tfs.block(f1, "y", tf_name="y_input")
+            return tfs.aggregate(
+                [tfs.reduce_sum(y_in, axis=0, name="y")],
+                f1.group_by("k"),
+            )
+
+    q = QueryEndpoint(
+        "smoke", QuerySource(path=data_dir, kind="csv"), build
+    )
+    table = q.execute()
+    os.makedirs(obs_dir, exist_ok=True)
+    REGISTRY.write_jsonl(
+        os.path.join(obs_dir, "registered_query_metrics.jsonl")
+    )
+    np.savez(out_npz, **{k: np.asarray(v) for k, v in table.items()})
+    print(json.dumps({"cache_stats": q.cache_stats()}))
+
+
+def _metric_total(obs_dir: str, name: str) -> float:
+    path = os.path.join(obs_dir, "registered_query_metrics.jsonl")
+    total = 0.0
+    with open(path) as fh:
+        for line in fh:
+            d = json.loads(line)
+            if d["name"] == name:
+                total += d.get("value", d.get("count", 0.0)) or 0.0
+    return total
+
+
+def main() -> None:
+    import numpy as np
+
+    tmp = tempfile.mkdtemp(prefix="tftpu_regq_smoke_")
+    data = os.path.join(tmp, "data")
+    os.makedirs(data)
+    rng = np.random.default_rng(7)
+    for i in range(8):
+        with open(os.path.join(data, f"part-{i:03d}.csv"), "w") as fh:
+            fh.write("k,v\n")
+            for k, v in zip(rng.integers(0, 16, 5000),
+                            rng.integers(-99, 99, 5000)):
+                fh.write(f"{k},{v}\n")
+    cache = os.path.join(tmp, "cache")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("TFTPU_COMPILE_CACHE", None)  # the worker configures it
+    stats = []
+    for run in (1, 2):
+        obs = os.path.join(tmp, f"obs-{run}")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             data, cache, os.path.join(tmp, f"run{run}.npz"), obs],
+            env=env, cwd=ROOT, timeout=300, check=True,
+            capture_output=True, text=True,
+        )
+        stats.append(json.loads(out.stdout.strip().splitlines()[-1]))
+    cs1, cs2 = (s["cache_stats"] for s in stats)
+    assert cs1["chunks_executed"] == 8, cs1
+    assert cs2["hits"] > 0, f"run 2 never hit the result cache: {cs2}"
+    assert cs2["misses"] == 0 and cs2["chunks_executed"] == 0, (
+        f"run 2 executed instead of answering from the store: {cs2}"
+    )
+    obs2 = os.path.join(tmp, "obs-2")
+    jl_hits = _metric_total(obs2, "tftpu_result_cache_hits_total")
+    assert jl_hits > 0, "run 2 metrics JSONL reported no cache hits"
+    compiles = _metric_total(obs2, "tftpu_executor_compile_seconds")
+    assert compiles == 0, (
+        f"run 2 compiled ({compiles} executor compile events) — the "
+        "warm restart must be zero-compile"
+    )
+    with np.load(os.path.join(tmp, "run1.npz")) as a, \
+            np.load(os.path.join(tmp, "run2.npz")) as b:
+        assert sorted(a.files) == sorted(b.files)
+        for k in a.files:
+            assert a[k].dtype == b[k].dtype, (k, a[k].dtype, b[k].dtype)
+            assert np.array_equal(a[k], b[k]), f"column {k!r} diverged"
+    print(
+        "registered-query smoke: run2 hits={:.0f} chunks_executed=0 "
+        "compiles=0 bit-identical".format(jl_hits)
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(*sys.argv[2:6])
+    else:
+        main()

@@ -216,7 +216,8 @@ def lint_plan(frame) -> DiagnosticReport:
     """Lint a frame's *logical plan* (TFG107 fusion-barrier, TFG109
     unfused-aggregate, TFG110 missed-aggregate-pushdown, TFG111
     larger-than-budget materialization, TFG112 liftable-callback /
-    lift-declined, TFG113 prefix-cache-ineligible): warn when a
+    lift-declined, TFG113 prefix-cache-ineligible, TFG114
+    query-not-incremental): warn when a
     chain's otherwise-fusable map stages are split by a barrier — a
     host-callback stage, a ``to_host``/``to_numpy`` materialization or
     repartition between maps, a trim map, or ragged source cells —
@@ -256,6 +257,15 @@ def lint_plan(frame) -> DiagnosticReport:
         prefix_events = prefix_cache_events()
     except Exception:  # pragma: no cover - serving unavailable
         prefix_events = []
+    # serving evidence (TFG114): registered query endpoints record when
+    # their plan blocked result caching / incremental refresh; same
+    # import guard as TFG113
+    try:
+        from ..serving.query import query_cache_events
+
+        query_events = query_cache_events()
+    except Exception:  # pragma: no cover - serving unavailable
+        query_events = []
     ctx = RuleContext(
         program=None,
         plan_barriers=barriers,
@@ -264,11 +274,12 @@ def lint_plan(frame) -> DiagnosticReport:
         oversized_materializations=oversized_materializations(frame),
         lift_events=lift_events,
         prefix_cache_events=prefix_events,
+        query_cache_events=query_events,
     )
     diags = run_rules(
         ctx,
         codes=["TFG107", "TFG109", "TFG110", "TFG111", "TFG112",
-               "TFG113"],
+               "TFG113", "TFG114"],
     )
     return DiagnosticReport(
         diags, subject=f"plan({n_maps} map stage(s))"

@@ -63,6 +63,12 @@ CODES: Dict[str, Tuple[str, str]] = {
     # cache off, prompts below one page, replay-resumed joins) — the
     # fix names the DecodeConfig/page-size change that would enable it.
     "TFG113": ("prefix-cache-ineligible", "warn"),
+    # registered-query degradation: serving evidence that a pipeline
+    # served via Server.register_query cannot ride the result cache or
+    # refresh incrementally (host callback, non-algebraic fetch,
+    # computed key, float accumulation, …) — the fix names the plan
+    # change that restores O(new data) refreshes.
+    "TFG114": ("query-not-incremental", "warn"),
     # TFL: the repo self-lint family (python -m tensorframes_tpu.analysis
     # selfcheck — policy rules over this repo's own sources, not user
     # programs). Registered here so one catalog covers every code a CI

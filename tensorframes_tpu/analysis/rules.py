@@ -121,6 +121,12 @@ class RuleContext:
     #: ``prompt_len``, ``page_size`` — recorded per (endpoint, reason)
     #: by serving.decode, read by TFG113.
     prefix_cache_events: Optional[Sequence[dict]] = None
+    #: Registered-query decline evidence (lint_plan only): dicts with
+    #: ``endpoint``, ``mode`` ('cache' | 'incremental'), ``reason``,
+    #: ``detail`` — recorded per (endpoint, mode, reason) by
+    #: serving.query when a registered pipeline's plan blocks result
+    #: caching or incremental maintenance; read by TFG114.
+    query_cache_events: Optional[Sequence[dict]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -955,6 +961,81 @@ def _rule_prefix_cache_ineligible(ctx: RuleContext) -> List[Diagnostic]:
 
 
 # ---------------------------------------------------------------------------
+# TFG114 — registered query not cacheable/incremental (serving evidence)
+# ---------------------------------------------------------------------------
+
+_TFG114_FIXES = {
+    "host_callback":
+        "the map stage runs a host callback, so results are not a pure "
+        "function of the plan fingerprint — lift the UDF "
+        "(plan.lift/TFG112 names whether it is liftable) or move the "
+        "callback out of the served pipeline",
+    "non_algebraic":
+        "the aggregate fetches a non-algebraic reduction, so it "
+        "executes on the host instead of the plan — restrict fetches "
+        "to sum/min/max/mean over the grouped column "
+        "(docs/plan.md#incremental-partials)",
+    "eager":
+        "build() returned an already-materialized frame (no recorded "
+        "plan chain) — return the LAZY verb chain without forcing it "
+        "(no collect()/column_values inside build), and check "
+        "TFTPU_FUSION is not disabled",
+    "join":
+        "per-chunk partials of a join-then-aggregate are not "
+        "maintained (build-side changes would stale them silently) — "
+        "pre-join into the scanned table, or accept counted full "
+        "recompute per refresh",
+    "computed_key":
+        "the group key is computed by a map stage, so a chunk's key "
+        "set is not a pure function of the chunk — materialize the "
+        "key into the source table so it passes through the scan",
+    "reduce_mean":
+        "a mean only folds across chunks as a (sum, count) companion "
+        "pair, which partial tables do not carry — aggregate "
+        "reduce_sum and a count column instead and divide at read "
+        "time",
+    "float_accumulation":
+        "float sums reassociate across chunk partials, so the fold "
+        "would not be bit-identical to full recompute — cast the "
+        "summed column to an integer dtype, or accept counted full "
+        "recompute (min/max stay incremental at any dtype)",
+    "no_terminal_aggregate":
+        "only terminal keyed aggregates fold incrementally — end the "
+        "registered chain in aggregate(...), or accept that refreshes "
+        "re-execute the whole pipeline (repeat queries still cache)",
+}
+
+
+def _rule_query_not_incremental(ctx: RuleContext) -> List[Diagnostic]:
+    """Registered-query evidence that the served pipeline degraded to
+    counted full recompute: the plan blocks the result cache
+    (mode='cache' — every request re-executes) or incremental
+    maintenance (mode='incremental' — refreshes pay O(table) while
+    repeats still cache). The fix names the blocking stage and the
+    plan change that restores O(new data) refreshes."""
+    if not ctx.query_cache_events:
+        return []
+    out: List[Diagnostic] = []
+    for ev in ctx.query_cache_events:
+        reason = str(ev.get("reason", "unknown"))
+        endpoint = str(ev.get("endpoint", "<endpoint>"))
+        mode = str(ev.get("mode", "cache"))
+        what = ("result caching" if mode == "cache"
+                else "incremental refresh")
+        out.append(Diagnostic(
+            "TFG114", "warn",
+            f"query endpoint {endpoint!r}: plan blocks {what} — "
+            f"{reason}: {ev.get('detail', '')}",
+            subject=endpoint,
+            fix=_TFG114_FIXES.get(
+                reason,
+                "see docs/analysis.md#tfg114 for the reason taxonomy",
+            ),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -972,6 +1053,7 @@ RULES: Dict[str, Callable[[RuleContext], List[Diagnostic]]] = {
     "TFG111": _rule_oversized_materialization,
     "TFG112": _rule_liftable_callback,
     "TFG113": _rule_prefix_cache_ineligible,
+    "TFG114": _rule_query_not_incremental,
 }
 
 

@@ -23,10 +23,11 @@ start.
 """
 
 from .partitioner import SpilledFrame, stream_chain
+from .resultstore import ResultStore
 from .store import BlockCorruptionError, BlockRef, BlockStore
 from . import shuffle
 
 __all__ = [
     "BlockStore", "BlockRef", "BlockCorruptionError",
-    "SpilledFrame", "stream_chain", "shuffle",
+    "ResultStore", "SpilledFrame", "stream_chain", "shuffle",
 ]

@@ -64,12 +64,77 @@ FORMAT_VERSION = 4
 
 __all__ = [
     "FORMAT_VERSION",
+    "content_digest",
     "fingerprint_components",
     "fingerprint_from_closed",
+    "frame_content_digest",
+    "part_signature",
     "program_fingerprint",
 ]
 
 _ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+# ---------------------------------------------------------------------------
+# input-partition content digests (ISSUE 20): the OTHER half of the
+# registered-query result-cache key. The plan fingerprint
+# (plan/stats.chain_fingerprint) names WHAT computes; these name WHAT
+# it computed OVER — a (plan_fp, content_digest) pair is hit-safe
+# across process restarts because both halves are content-derived.
+# ---------------------------------------------------------------------------
+
+def part_signature(path: str) -> str:
+    """Signature of one on-disk part file: sha256 over (basename, size,
+    mtime_ns). A stat proxy, deliberately NOT a content hash — a
+    growing-directory scan must be able to fingerprint a multi-GB table
+    in O(#files) stat calls, and any rewrite bumps mtime_ns. The
+    tradeoff is stated: a byte-level rewrite that preserves size and
+    nanosecond mtime would serve stale (requires a deliberate
+    ``touch -d``-style forgery; ordinary writes always move mtime_ns)."""
+    st = os.stat(path)
+    h = hashlib.sha256()
+    h.update(os.path.basename(path).encode())
+    h.update(b"|%d|%d" % (int(st.st_size), int(st.st_mtime_ns)))
+    return h.hexdigest()[:24]
+
+
+def content_digest(signatures: Iterable[str]) -> str:
+    """Fold per-part signatures into one input-partition digest. Order-
+    sensitive on purpose: the manifest order IS the row order, and a
+    reordered directory is different input even when the part set
+    matches."""
+    h = hashlib.sha256(b"parts|")
+    for sig in signatures:
+        h.update(str(sig).encode())
+        h.update(b"|")
+    return h.hexdigest()[:32]
+
+
+def frame_content_digest(frame) -> str:
+    """Content digest of an in-memory frame (the static-source case of
+    a registered query): schema + every block's bytes. Dense columns
+    hash their buffer; host/object columns hash their repr — exact
+    enough for cache keying (a repr collision between two DIFFERENT
+    host columns would need colliding reprs, and host columns are
+    strings/small objects here)."""
+    h = hashlib.sha256(b"frame|")
+    h.update(json.dumps(
+        [(c.name, c.dtype.name) for c in frame.schema]
+    ).encode())
+    for block in frame.blocks():
+        for name in sorted(block):
+            v = block[name]
+            h.update(name.encode() + b"|")
+            if isinstance(v, list):
+                h.update(repr(v).encode())
+                continue
+            arr = np.asarray(v)
+            if arr.dtype == object:
+                h.update(repr(arr.tolist()).encode())
+            else:
+                h.update(str((arr.shape, str(arr.dtype))).encode())
+                h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:32]
 
 
 def _scrub(text: str) -> str:

@@ -15,7 +15,7 @@ import os
 import queue
 import threading
 import time
-from typing import Dict, Iterator, Iterable, List, Optional, Sequence
+from typing import Dict, Iterator, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -734,6 +734,59 @@ def _part_files(paths, exts) -> List[str]:
             f"no part files matching {sorted(exts)} under {paths!r}"
         )
     return out
+
+
+#: Part-file extensions per scan kind (the same sets scan_csv /
+#: scan_parquet filter by).
+PART_EXTS: Dict[str, tuple] = {
+    "csv": (".csv", ".tsv", ".txt"),
+    "parquet": (".parquet", ".pq"),
+}
+
+
+def part_manifest(paths, kind: str = "csv") -> List[Tuple[str, str]]:
+    """Chunk-arrival manifest of a growing directory (or explicit part
+    list): ``[(path, signature), ...]`` in scan order. The signature is
+    :func:`compilecache.fingerprint.part_signature` (basename + size +
+    mtime_ns — O(#files) stat calls, no content read), so a registered
+    query can decide per request whether anything arrived, changed, or
+    disappeared since its cached partials were computed: appended parts
+    show up as new (path, sig) rows, a rewritten part keeps its path
+    but moves its signature, a removed part drops its row."""
+    from .compilecache.fingerprint import part_signature
+
+    try:
+        exts = PART_EXTS[kind]
+    except KeyError:
+        raise ValueError(
+            f"part_manifest kind must be one of {sorted(PART_EXTS)}, "
+            f"got {kind!r}"
+        ) from None
+    return [(p, part_signature(p)) for p in _part_files(paths, exts)]
+
+
+def part_frame(path: str, kind: str = "csv", delimiter: str = ",",
+               dtypes: Optional[Dict[str, str]] = None):
+    """ONE part file → one frame (possibly zero-row for a header-only
+    CSV part). The per-chunk read of the registered-query incremental
+    path: an appended part is re-read alone, never the directory.
+    ``dtypes`` pins CSV column types exactly like :func:`scan_csv`'s
+    first-part pinning — callers that read parts independently must pin
+    from one authoritative part themselves or two chunks of one table
+    could parse under different types."""
+    if kind == "csv":
+        return _read_csv_single(
+            path, delimiter=delimiter, dtypes=(dtypes or None),
+            num_blocks=1,
+        )
+    if kind == "parquet":
+        _require_pyarrow()
+        import pyarrow.parquet as pq
+
+        return frame_from_arrow(pq.read_table(path), num_blocks=1)
+    raise ValueError(
+        f"part_frame kind must be one of {sorted(PART_EXTS)}, got {kind!r}"
+    )
 
 
 def _iter_row_chunks(block: Dict[str, object], rows_per_chunk: int):

@@ -53,7 +53,7 @@ import dataclasses
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -69,7 +69,10 @@ from .rules import SegmentPlan, plan_segment, split_segments
 
 logger = get_logger(__name__)
 
-__all__ = ["execute_plan", "execute_aggregate", "lower_reduce"]
+__all__ = [
+    "execute_plan", "execute_aggregate", "lower_reduce",
+    "canonical_table_order", "fold_partial_tables",
+]
 
 # Registered at import so expositions always carry the plan family
 # (a process that never fused reads 0 — the series does not vanish).
@@ -2022,3 +2025,131 @@ def _fused_reduce_program(
         epilogue=epilogue,
         extra_pinned=(reduce_program,),
     )
+
+
+# ---------------------------------------------------------------------------
+# incremental aggregate maintenance (ISSUE 20): per-chunk partial
+# tables folded into the full aggregate. The eligibility gate
+# (rules.incremental_fold_safe per (op, out dtype), pass-through group
+# keys, no joins, no host callbacks) lives with the registered-query
+# endpoint; THIS is the fold itself — plain host arithmetic, because
+# every admitted (op, dtype) pair is exactly associative/commutative
+# (int/bool sums are modular adds; min/max are order-free), so the
+# fold is bit-identical to one aggregation over the whole table BY
+# CONSTRUCTION, not by tolerance.
+# ---------------------------------------------------------------------------
+
+def _table_rows(table: Dict[str, object]) -> int:
+    for v in table.values():
+        return len(v)
+    return 0
+
+
+def _key_scalar(v):
+    """Dict-key form of one group-key cell (numpy scalar → python)."""
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def canonical_table_order(table: Dict[str, object],
+                          keys: Sequence[str]) -> Dict[str, object]:
+    """Sort an aggregate table's rows by its group-key columns — the
+    ONE deterministic row order registered query endpoints serve, so a
+    folded refresh, a full recompute, and a ``TFTPU_FUSION=0`` oracle
+    run are byte-comparable without caring which order each path
+    discovered the groups in. Host sort over python key tuples (group
+    counts, not row counts — string keys included); value columns ride
+    the same permutation untouched."""
+    n = _table_rows(table)
+    keycols = [table[k] for k in keys if k in table]
+    if n <= 1 or not keycols:
+        return dict(table)
+    order = sorted(
+        range(n),
+        key=lambda i: tuple(_key_scalar(c[i]) for c in keycols),
+    )
+    out: Dict[str, object] = {}
+    for name, col in table.items():
+        if isinstance(col, list):
+            out[name] = [col[i] for i in order]
+        else:
+            arr = np.asarray(col)
+            out[name] = arr[np.asarray(order, dtype=np.intp)]
+    return out
+
+
+#: fold op per admitted reducer — each exactly associative/commutative
+#: for every dtype incremental_fold_safe admits.
+_FOLD_OPS = {
+    "reduce_sum": np.add,
+    "reduce_min": np.minimum,
+    "reduce_max": np.maximum,
+}
+
+
+def fold_partial_tables(
+    partials: Sequence[Dict[str, object]],
+    keys: Sequence[str],
+    ops: Sequence[Tuple[str, str]],
+    schema,
+) -> Dict[str, object]:
+    """Fold per-chunk aggregate partial tables into the full table.
+
+    ``partials`` are the per-chunk aggregate outputs (each already one
+    row per group KEY SEEN IN THAT CHUNK); ``ops`` is the terminal
+    aggregate node's ``[(out_name, op)]`` spec (every op a
+    ``_FOLD_OPS`` member — the caller's eligibility walk guarantees
+    it); ``schema`` the aggregate node's result schema, used to type
+    empty outputs. Groups accumulate in a dict keyed by the python key
+    tuple; the result comes back in :func:`canonical_table_order`.
+    Value dtypes are preserved end to end (partials carry the
+    aggregate's own output dtypes; numpy same-dtype arithmetic keeps
+    them), so int sums fold modularly exactly like the segment
+    reduction they replace."""
+    keys = list(keys)
+    for out_name, op in ops:
+        if op not in _FOLD_OPS:
+            raise ValueError(
+                f"fold_partial_tables: {out_name!r} uses {op!r}, not a "
+                f"foldable reducer {sorted(_FOLD_OPS)} — the "
+                "eligibility walk must decline before folding"
+            )
+    acc: "OrderedDict[tuple, Dict[str, object]]" = OrderedDict()
+    key_cells: Dict[tuple, tuple] = {}
+    for table in partials:
+        n = _table_rows(table)
+        if n == 0:
+            continue
+        kcols = [table[k] for k in keys]
+        for i in range(n):
+            kt = tuple(_key_scalar(c[i]) for c in kcols)
+            row = acc.get(kt)
+            if row is None:
+                acc[kt] = {
+                    out: np.asarray(table[out])[i] for out, _ in ops
+                }
+                key_cells[kt] = tuple(c[i] for c in kcols)
+            else:
+                for out, op in ops:
+                    row[out] = _FOLD_OPS[op](
+                        row[out], np.asarray(table[out])[i]
+                    )
+    out: Dict[str, object] = {}
+    groups = list(acc)
+    for j, k in enumerate(keys):
+        cells = [key_cells[g][j] for g in groups]
+        info = schema[k] if schema is not None and k in schema else None
+        np_dtype = getattr(getattr(info, "dtype", None), "np_dtype", None)
+        if np_dtype is not None and np.dtype(np_dtype) != object:
+            out[k] = np.asarray(cells, dtype=np_dtype)
+        else:
+            out[k] = np.asarray(cells, dtype=object)
+    for out_name, _ in ops:
+        cells = [acc[g][out_name] for g in groups]
+        if cells:
+            out[out_name] = np.stack([np.asarray(c) for c in cells])
+        else:
+            info = schema[out_name] if schema is not None else None
+            np_dtype = getattr(getattr(info, "dtype", None),
+                               "np_dtype", np.float64)
+            out[out_name] = np.zeros((0,), dtype=np_dtype)
+    return canonical_table_order(out, keys)

@@ -420,6 +420,21 @@ def reassoc_safe(op: str, np_dtype) -> bool:
     return kind in ("i", "u", "b")
 
 
+def incremental_fold_safe(op: str, np_dtype) -> bool:
+    """True when per-CHUNK partials of ``op`` fold across arriving scan
+    chunks to the same bits as one aggregation over the whole table —
+    the eligibility gate of registered-query incremental maintenance
+    (ISSUE 20). Strictly the :func:`reassoc_safe` contract minus
+    ``reduce_mean``: a mean's partials fold only as a (sum, count)
+    companion pair, which the partial tables don't carry yet (a named
+    TFG114 decline, not a wrong answer). min/max fold exactly for any
+    dtype; sums only for integer/bool accumulation — a float sum's
+    fold order differs from the global reduction's row order."""
+    if op == "reduce_mean":
+        return False
+    return reassoc_safe(op, np_dtype)
+
+
 def decide_epilogue(
     ops_and_dtypes: Sequence[Tuple[str, object]],
     num_groups: int,

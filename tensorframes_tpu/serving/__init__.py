@@ -40,6 +40,15 @@ to ``state=running`` replicas, and redrives failed dispatches to
 survivors under the original deadline with idempotency-key dedup —
 every admitted request gets exactly one response through a ``kill -9``.
 See docs/serving.md ("Scale-out").
+
+ISSUE 20 serves the relational plane itself: ``Server.register_query``
+turns a lazy map→join→aggregate pipeline over a growing scan directory
+into an endpoint — fronted by a (plan-fingerprint × input-content-
+digest) result cache with counted invalidation, with algebraic
+aggregates maintained incrementally per arriving chunk (bit-identical
+to full recompute by exact associativity; anything outside the
+contract degrades to a COUNTED full recompute and a TFG114
+diagnostic). See docs/serving.md ("Registered queries").
 """
 
 from __future__ import annotations
@@ -61,6 +70,11 @@ from .kvpool import (  # noqa: F401
     PagedKVPool,
     PoolAccountingError,
     PoolExhaustedError,
+)
+from .query import (  # noqa: F401
+    QueryEndpoint,
+    QuerySource,
+    query_cache_events,
 )
 from .server import (  # noqa: F401
     Endpoint,
@@ -84,6 +98,9 @@ __all__ = [
     "PagedKVPool",
     "PoolAccountingError",
     "PoolExhaustedError",
+    "QueryEndpoint",
+    "QuerySource",
+    "query_cache_events",
     "serve_http",
     "serve_replica",
     "Router",

@@ -275,6 +275,14 @@ class DecodeEngine:
                 )
         self._swap_store = None
         self._swap: Dict[_Request, Dict[str, object]] = {}
+        # PR 18 follow-up: swap segments survive the engine. stop()
+        # PARKS pending keyed sequences' segments (trace_id → swap
+        # snapshot) instead of dropping them; spill() folds them into
+        # the whole-pool snapshot; restore() on a fresh engine re-homes
+        # them here, and a redriven request with the same trace id
+        # resumes from its pages (no prefill recompute).
+        self._swap_parked: Dict[str, Dict[str, object]] = {}
+        self._swap_restored: Dict[str, Dict[str, object]] = {}
         # first-page fingerprints of fresh prompts on an UNARMED
         # engine: a repeat is hard evidence prefill work was shareable
         # (the TFG113 store_unarmed signal) — bounded, never grows past
@@ -514,10 +522,27 @@ class DecodeEngine:
         self._pool.close()  # withdraw from the free-pages gauge
         store = self._swap_store
         if store is not None:
+            # segments of still-unanswered requests WITH a cross-restart
+            # identity are parked for spill(), not dropped: the fleet
+            # redrives such a request (same trace id) into the restarted
+            # engine, and a parked segment turns that redrive into a
+            # swap-in resume instead of a full prefill recompute.
+            # Unkeyed or answered sequences drop as before.
             for r in list(self._swap):
-                self._drop_swap(r)
-            self._swap_store = None
-            store.close()  # deletes the root if the engine created it
+                if r.trace_id:
+                    self._swap_parked[str(r.trace_id)] = self._swap.pop(r)
+                else:
+                    self._drop_swap(r)
+            # restored-but-never-redriven segments already live in this
+            # store: park them too, so chained restarts keep them
+            self._swap_parked.update(self._swap_restored)
+            self._swap_restored.clear()
+            if not self._swap_parked:
+                self._swap_store = None
+                store.close()  # deletes the root if the engine made it
+            # else: the store stays open — spill() reads the parked
+            # segments out of it (and then closes it), or a subsequent
+            # start() reuses it
         # TFG113 evidence is scoped to RUNNING endpoints: a stopped
         # engine's config can no longer be fixed, so its findings are
         # withdrawn (lint_plan reads the live evidence each call)
@@ -531,6 +556,90 @@ class DecodeEngine:
         _flight.record(
             "serving.decode.stop", endpoint=self.name, drain=drain,
         )
+
+    def spill(self, store) -> Dict[str, object]:
+        """Whole-engine KV snapshot (call after ``stop()``): the pool's
+        whole-pool spill PLUS every parked per-sequence swap segment,
+        folded into one snapshot dict — the PR 18 follow-up that stops
+        swap segments dying with the engine. Hand the snapshot to a
+        fresh engine's :meth:`restore` and redrive the pending requests
+        (same trace ids): each resumes from its swapped pages through
+        the normal swap-in path, bit-identically, with no prefill
+        recompute. The engine's own swap store is emptied and closed
+        (the segments now live in ``store``)."""
+        with self._lock:
+            if self._running or self._starting:
+                raise ServingError(
+                    f"decode engine {self.name!r}: spill() requires a "
+                    "stopped engine (stop() first — a live loop would "
+                    "race the snapshot)"
+                )
+            parked = dict(self._swap_parked)
+        snap = self._pool.spill(
+            store, swaps=parked, swap_store=self._swap_store,
+        )
+        swap_store = self._swap_store
+        if swap_store is not None:
+            for entry in parked.values():
+                try:
+                    swap_store.drop(entry["ref"])
+                except Exception:  # pragma: no cover - already dropped
+                    pass
+            self._swap_parked.clear()
+            self._swap_store = None
+            swap_store.close()
+        _flight.record(
+            "serving.decode.spill", endpoint=self.name,
+            swapped=len(snap.get("swapped", {})),
+        )
+        return snap
+
+    def restore(self, store, snapshot: Dict[str, object]) -> int:
+        """Adopt a :meth:`spill` snapshot's host-swapped sequences into
+        this engine: segments are re-homed into the engine's swap store
+        and parked by trace id; when the fleet redrives a pending
+        request (same trace id), it resumes from its pages through the
+        warmed swap-in executables instead of recomputing its prefill.
+        Pool page state is NOT restored — a fresh engine owns a fresh
+        pool, and swapped sequences hold no pages by construction.
+        Returns the number of sequences adopted (corrupt segments are
+        skipped with the store's counted quarantine; those requests
+        degrade to a plain fresh decode on redrive)."""
+        if not self._kv_swap:
+            return 0
+        if self._swap_store is None:
+            from ..blockstore import BlockStore
+
+            self._swap_store = BlockStore(
+                root=self.config.swap_dir, budget_bytes=0,
+            )
+        manifest = self._pool.adopt_swapped(
+            store, snapshot, self._swap_store
+        )
+        with self._lock:
+            self._swap_restored.update(manifest)
+        _flight.record(
+            "serving.decode.restore", endpoint=self.name,
+            adopted=len(manifest),
+            offered=len(snapshot.get("swapped", {})),
+        )
+        return len(manifest)
+
+    def _adopt_restored(self, req: "_Request") -> Optional[Dict]:
+        """Move a restored swap snapshot onto a redriven request (same
+        trace id), keeping the recompute-replay data beside it — the
+        counted fallback if the segment comes back corrupt, exactly as
+        :meth:`_preempt` does for a live preemption."""
+        if not self._swap_restored or not req.trace_id:
+            return None
+        snap = self._swap_restored.pop(str(req.trace_id), None)
+        if snap is None:
+            return None
+        self._swap[req] = snap
+        self._resume[req] = (
+            list(snap["generated"]) + list(snap["replay"] or ())
+        )
+        return snap
 
     # -- request path -------------------------------------------------------
 
@@ -678,6 +787,10 @@ class DecodeEngine:
 
         def can_take(req: _Request) -> bool:
             snap = self._swap.get(req)
+            if snap is None:
+                # a redriven request adopting a restored swap segment
+                # claims its SNAPSHOT pages too (engine-restart resume)
+                snap = self._adopt_restored(req)
             if snap is not None:
                 need = int(snap["pages"])
             else:

@@ -365,9 +365,15 @@ def serve_http(server: Server, port: int = 0, addr: str = "127.0.0.1",
                     500, {"error": f"{type(e).__name__}: {e}"}
                 )
                 return
+            # query-endpoint tables may carry object-dtype key columns
+            # (string group keys) alongside dense arrays — .tolist()
+            # serializes both; len() covers any non-ndarray stragglers
             self._reply(200, {
-                "outputs": {k: v.tolist() for k, v in outs.items()},
-                "rows": next(iter(outs.values())).shape[0] if outs else 0,
+                "outputs": {
+                    k: (v.tolist() if hasattr(v, "tolist") else list(v))
+                    for k, v in outs.items()
+                },
+                "rows": len(next(iter(outs.values()))) if outs else 0,
                 "latency_s": round(time.perf_counter() - t0, 6),
             })
 

@@ -491,7 +491,8 @@ class PagedKVPool:
 
     # -- host-swap tier (blockstore-backed, ISSUE 15 + 19) -------------------
 
-    def spill(self, store) -> Dict[str, object]:
+    def spill(self, store, swaps: Optional[Dict[str, Dict]] = None,
+              swap_store=None) -> Dict[str, object]:
         """Snapshot the whole pool into a
         :class:`~tensorframes_tpu.blockstore.BlockStore`: the device
         columns land as ONE spilled block (explicitly pushed to disk —
@@ -501,11 +502,33 @@ class PagedKVPool:
         whole-pool host-swap tier: a served model's KV state survives an
         engine restart through the same CRC-checked segments frame
         blocks spill to, and :meth:`restore` brings it back
-        bit-identically. Per-sequence swap is :meth:`swap_out_seq`."""
+        bit-identically. Per-sequence swap is :meth:`swap_out_seq`.
+
+        ``swaps`` (PR 18 follow-up) folds per-sequence host-swap
+        segments into the snapshot so they no longer die with the
+        engine: a mapping of cross-restart identity (the request's
+        trace id) → :meth:`swap_out_seq` snapshot. Each segment is
+        CRC-check read from ``swap_store`` and re-published into
+        ``store``; the manifest rides the snapshot's ``"swapped"`` key
+        and :meth:`adopt_swapped` re-homes it into a fresh engine's
+        swap store. A segment that comes back corrupt here is skipped
+        (quarantined + counted by the store) — the sequence degrades
+        to recompute-replay on redrive, never a wrong answer."""
+        swapped: Dict[str, Dict] = {}
+        if swaps and swap_store is not None:
+            for tid, snap in dict(swaps).items():
+                try:
+                    seg = swap_store.get(snap["ref"])
+                except Exception:
+                    continue
+                entry = {k: v for k, v in snap.items() if k != "ref"}
+                entry["ref"] = store.put_spilled(seg)
+                swapped[str(tid)] = entry
         block = {k: np.asarray(v) for k, v in self.columns.items()}
         ref = store.put(block)
         store.spill(ref)
         return {
+            "swapped": swapped,
             "ref": ref,
             "free": list(self._free),
             "owned": {int(s): list(p) for s, p in self._owned.items()},
@@ -524,13 +547,17 @@ class PagedKVPool:
             "max_pages_per_seq": self.max_pages_per_seq,
         }
 
-    def restore(self, store, snapshot: Dict[str, object]) -> None:
+    def restore(self, store, snapshot: Dict[str, object],
+                swap_store=None) -> Dict[str, Dict]:
         """Rehydrate pool state from a :meth:`spill` snapshot:
         CRC-checked reload of the column block (corruption raises
         ``BlockCorruptionError`` — counted + quarantined by the store,
         never silently served), ``device_put`` back to the default
         device, and the page accounting restored exactly. Geometry
-        mismatches raise before anything is touched."""
+        mismatches raise before anything is touched. When the snapshot
+        carries folded per-sequence swap segments and ``swap_store``
+        is given, they are re-homed via :meth:`adopt_swapped` and the
+        manifest is returned (``{}`` otherwise)."""
         import jax
 
         for field in ("num_pages", "page_size", "max_pages_per_seq"):
@@ -582,6 +609,34 @@ class PagedKVPool:
 
             m.DECODE_FREE_PAGES.inc(len(self._free) - old_free)
             m.PREFIX_SHARED_PAGES.inc(len(self._shared_ref) - old_shared)
+        return self.adopt_swapped(store, snapshot, swap_store)
+
+    def adopt_swapped(self, store, snapshot: Dict[str, object],
+                      swap_store) -> Dict[str, Dict]:
+        """Re-home a :meth:`spill` snapshot's folded per-sequence swap
+        segments into a live swap store WITHOUT touching pool page
+        state: swapped sequences hold no pages (``swap_out_seq``
+        released them), so they are the one part of an engine's KV
+        state that is self-contained enough to move between engines.
+        Returns ``{trace_id: swap-in snapshot}`` — the restored
+        engine's parking manifest, consumed when each request is
+        redriven. Corrupt segments are skipped (quarantined + counted
+        by the store; the redrive degrades to recompute-replay)."""
+        manifest: Dict[str, Dict] = {}
+        if swap_store is None:
+            return manifest
+        for tid, entry in dict(snapshot.get("swapped", {})).items():
+            try:
+                seg = store.get(entry["ref"])
+            except Exception:
+                continue
+            new = {k: v for k, v in entry.items() if k != "ref"}
+            new["ref"] = swap_store.put_spilled(seg)
+            if int(new.get("page_size", self.page_size)) != self.page_size:
+                swap_store.drop(new["ref"])
+                continue
+            manifest[str(tid)] = new
+        return manifest
 
     def swap_out_seq(self, store, seq: int,
                      block: Dict[str, np.ndarray]) -> Dict[str, object]:

@@ -34,6 +34,10 @@ __all__ = [
     "KVSWAP_OUTS", "KVSWAP_RESUMES", "KVSWAP_FALLBACKS", "KVSWAP_BYTES",
     "PREFIX_HITS", "PREFIX_MISSES", "PREFIX_SHARED_PAGES",
     "PREFIX_EVICTIONS",
+    "RESULT_CACHE_HITS", "RESULT_CACHE_MISSES",
+    "RESULT_CACHE_INVALIDATIONS", "RESULT_CACHE_BYTES",
+    "RESULT_CACHE_CHUNKS_FOLDED", "RESULT_CACHE_RECOMPUTES",
+    "RECOMPUTE_REASONS", "result_recompute",
     "HTTP_REJECT_REASONS", "HTTP_REJECTIONS", "http_rejected",
     "IDEMPOTENT_DEDUP",
     "ROUTER_REJECT_REASONS", "ROUTER_REQUESTS", "ROUTER_REDRIVES",
@@ -234,6 +238,74 @@ PREFIX_EVICTIONS = _counter(
     "Shared prefix pages reclaimed to the free list under allocation "
     "pressure (only refcount-0 pages are eligible, LRU-first)",
 )
+
+
+# -- registered-query result cache (tftpu_result_cache_*, ISSUE 20) --------
+# A registered relational endpoint's health is a hit rate (repeat
+# queries served from the (plan fingerprint, content digest) keyed
+# store without executing), an invalidation rate (how often the input
+# partition moved under it), and the incremental split: chunks whose
+# cached partials folded vs full recomputes, BY REASON — "the cache
+# degraded" must always name why. Per-endpoint cardinality stays out
+# of the registry (TFL003); Server.stats() carries the per-endpoint
+# rows.
+
+#: Why a registered query ran a counted full recompute (closed set).
+#: cold = first sight of this input partition (nothing cached yet);
+#: invalidated = a previously-seen part changed or disappeared, so the
+#: cached partials no longer describe the table; ineligible = the plan
+#: declined caching or incremental maintenance (TFG114 names the
+#: stage); corrupt_partial = a cached chunk partial failed CRC and
+#: that chunk re-executed (quarantined, never served).
+RECOMPUTE_REASONS: Tuple[str, ...] = (
+    "cold", "invalidated", "ineligible", "corrupt_partial",
+)
+
+RESULT_CACHE_HITS = _counter(
+    "tftpu_result_cache_hits_total",
+    "Registered-query requests served from the result cache (memo or "
+    "persistent store) — no plan execution, no chunk read",
+)
+RESULT_CACHE_MISSES = _counter(
+    "tftpu_result_cache_misses_total",
+    "Registered-query requests whose (plan fingerprint, content "
+    "digest) key was absent from the result cache",
+)
+RESULT_CACHE_INVALIDATIONS = _counter(
+    "tftpu_result_cache_invalidations_total",
+    "Input-partition digest changes observed by registered queries "
+    "(the previous cached result can no longer serve; appends refresh "
+    "incrementally, rewrites/removals force full recompute)",
+)
+RESULT_CACHE_BYTES = _counter(
+    "tftpu_result_cache_bytes_total",
+    "Bytes of result/partial tables published into the persistent "
+    "result store by registered queries",
+)
+RESULT_CACHE_CHUNKS_FOLDED = _counter(
+    "tftpu_result_cache_chunks_folded_total",
+    "Scan chunks whose CACHED aggregate partials were folded into a "
+    "registered query's refresh instead of being re-read and "
+    "re-executed (the incremental-maintenance payoff counter)",
+)
+RESULT_CACHE_RECOMPUTES: Dict[str, Counter] = {
+    r: _counter(
+        "tftpu_result_cache_recomputes_total",
+        "Registered-query executions that could not serve from cached "
+        "results/partials, by reason (cold = first sight of the input "
+        "partition, invalidated = a seen part changed/disappeared, "
+        "ineligible = the plan declined caching/incremental [TFG114 "
+        "names the stage], corrupt_partial = a damaged cached partial "
+        "was quarantined and its chunk re-executed)",
+        labels={"reason": r},
+    )
+    for r in RECOMPUTE_REASONS
+}
+
+
+def result_recompute(reason: str) -> Counter:
+    """The pre-registered recompute counter for ``reason``."""
+    return RESULT_CACHE_RECOMPUTES[reason]
 
 
 def rejected(reason: str) -> Counter:

@@ -219,6 +219,7 @@ class Server:
         self._endpoints: Dict[str, Endpoint] = {}
         self._batchers: Dict[str, ContinuousBatcher] = {}
         self._decode: Dict[str, object] = {}  # name -> DecodeEngine
+        self._queries: Dict[str, object] = {}  # name -> QueryEndpoint
         self._lock = threading.Lock()
         self._running = False
         self._starting = False
@@ -367,9 +368,70 @@ class Server:
                 raise
         return engine
 
+    def register_query(self, name: str, source, build):
+        """Register a relational pipeline as endpoint ``name`` (ISSUE
+        20): ``source`` is a :class:`~tensorframes_tpu.serving.query.
+        QuerySource` (a scan directory or a frame), ``build`` maps the
+        source frame to a lazy verb chain. ``submit(name, {})`` answers
+        with the pipeline's result over the source's CURRENT contents,
+        fronted by the (plan fingerprint × content digest) result cache
+        with counted invalidation; algebraic scan-rooted aggregates
+        refresh incrementally (only new chunks re-read/re-executed,
+        bit-identical to full recompute). Registration probes the plan
+        EAGERLY — a broken build fn or empty source fails here, not on
+        the first request — and records TFG114 evidence when the plan
+        declines either cache level."""
+        from .query import QueryEndpoint, QuerySource
+
+        if not name or "/" in name:
+            raise ValueError(
+                f"endpoint name must be non-empty and '/'-free, "
+                f"got {name!r}"
+            )
+        if not isinstance(source, QuerySource):
+            raise ValueError(
+                f"register_query() needs a QuerySource, "
+                f"got {type(source).__name__}"
+            )
+        with self._lock:
+            if name in self._endpoints or name in self._decode \
+                    or name in self._queries:
+                raise ValueError(f"endpoint {name!r} already registered")
+        # probe OUTSIDE the lock (it reads a chunk and traces the plan);
+        # the name was only reserved by the check above, so a concurrent
+        # duplicate is caught again on insert
+        q = QueryEndpoint(name, source, build)
+        with self._lock:
+            if name in self._endpoints or name in self._decode \
+                    or name in self._queries:
+                raise ValueError(f"endpoint {name!r} already registered")
+            self._queries[name] = q
+            live = self._running or self._starting
+        if live:
+            # late registration on a live server: warm outside the lock,
+            # same rollback contract as register() — a failed warm must
+            # not leave a zombie name (or stale TFG114 evidence) behind
+            if self.config.warmup:
+                try:
+                    self.warmup_reports[name] = q.warm()
+                except BaseException:
+                    from .query import _withdraw_events
+
+                    with self._lock:
+                        self._queries.pop(name, None)
+                    _withdraw_events(name)
+                    raise
+            with self._lock:
+                if self._running:
+                    q.open()
+        return q
+
     def endpoints(self) -> List[str]:
         with self._lock:
-            return sorted(set(self._endpoints) | set(self._decode))
+            return sorted(
+                set(self._endpoints) | set(self._decode)
+                | set(self._queries)
+            )
 
     def _warm(self, ep: Endpoint):
         """Precompile (or disk-load) the endpoint's bucket ladder so the
@@ -395,11 +457,18 @@ class Server:
             self._starting = True
             eps = list(self._endpoints.values())
             engines = list(self._decode.values())
+            queries = list(self._queries.values())
         t0 = time.perf_counter()
         try:
             if self.config.warmup:
                 for ep in eps:
                     self.warmup_reports[ep.name] = self._warm(ep)
+                # query endpoints warm by executing once: the first
+                # request is then a cache hit, and with a persistent
+                # result store armed a RESTARTED process warms from the
+                # store without re-reading a single chunk
+                for q in queries:
+                    self.warmup_reports[q.name] = q.warm()
             # decode engines warm their slot × phase bucket grid inside
             # their own start() — still in the warm phase, so the
             # running flag only flips once every endpoint is hot
@@ -429,6 +498,8 @@ class Server:
             # admission is actually open
             for b in self._batchers.values():
                 b.start()
+            for q in self._queries.values():
+                q.open()
             self._running = True
         _flight.record(
             "serving.start", endpoints=self.endpoints(),
@@ -455,13 +526,19 @@ class Server:
                     # of opening the batchers after this stop() returned
                     self._stop_requested = True
                 if not self._running and not self._batchers \
-                        and not self._decode:
+                        and not self._decode and not self._queries:
                     return
                 self._running = False
                 if drain:
                     self._draining = True
                 batchers = list(self._batchers.values())
                 engines = list(self._decode.values())
+                queries = list(self._queries.values())
+            # query endpoints execute synchronously in the submitting
+            # thread — closing admission IS the drain (no queue to
+            # complete, no worker to join)
+            for q in queries:
+                q.close()
             pending = sum(b.queued_rows for b in batchers)
             _flight.record(
                 "serving.drain" if drain else "serving.stop",
@@ -603,6 +680,15 @@ class Server:
             # server default deadline at register time
             with _context.request_scope(trace_id):
                 return eng.submit(feeds, deadline_s=deadline_s)
+        q = self._queries.get(endpoint)
+        if q is not None:
+            # registered queries execute synchronously under the
+            # endpoint lock: a cache hit's latency IS the lookup, and
+            # there is no batch to coalesce (the input is the source's
+            # current contents, not the request's feeds)
+            with _context.request_scope(trace_id):
+                return q.submit(feeds, deadline_s=deadline_s,
+                                trace_id=trace_id)
         try:
             ep = self._endpoints[endpoint]
         except KeyError:
@@ -639,6 +725,7 @@ class Server:
         with self._lock:
             batchers = dict(self._batchers)
             engines = dict(self._decode)
+            queries = dict(self._queries)
             running = self._running
             state = self._state_locked()
             # TTL-prune the idempotency cache here too: healthz is
@@ -672,6 +759,14 @@ class Server:
 
         for name, b in batchers.items():
             _tally(name, b.counters())
+        # registered queries (ISSUE 20): admission counters tally like
+        # any endpoint; the result-cache rows ride a dedicated section
+        # (per-endpoint cardinality stays out of the registry, TFL003 —
+        # the process-wide tftpu_result_cache_* series carry the totals)
+        query_rows: Dict[str, Dict[str, object]] = {}
+        for name, q in queries.items():
+            _tally(name, q.counters())
+            query_rows[name] = q.cache_stats()
         for name, eng in engines.items():
             snap = eng.counters()
             _tally(name, snap)
@@ -705,6 +800,8 @@ class Server:
         }
         if decode:
             out["decode"] = decode
+        if query_rows:
+            out["queries"] = query_rows
         return out
 
 

@@ -576,6 +576,55 @@ def test_kvpool_spill_restore_bit_identical(tmp_path):
     st.close()
 
 
+def test_kvpool_spill_folds_swap_segments(tmp_path):
+    """PR 18 follow-up: per-sequence host-swap segments ride the
+    whole-pool spill() snapshot (keyed by the request's cross-restart
+    trace id) and adopt_swapped() re-homes them into a FRESH engine's
+    swap store bit-identically — swap segments no longer die with the
+    engine that wrote them."""
+    from tensorframes_tpu.models import generation as gen
+    from tensorframes_tpu.serving.kvpool import PagedKVPool
+
+    st = BlockStore(root=str(tmp_path / "kv"), budget_bytes=0)
+    swap = BlockStore(root=str(tmp_path / "swap"), budget_bytes=0)
+    pool = PagedKVPool(
+        gen.gpt_tiny(), num_pages=9, page_size=4, max_pages_per_seq=4
+    )
+    pool.alloc(1, 2)
+    payload = {
+        k: np.asarray(v)[1:3].copy() for k, v in pool.columns.items()
+    }
+    snap1 = pool.swap_out_seq(swap, 1, payload)
+    # the engine rides pos/generated/replay on the same snapshot dict
+    snap1["pos"] = 7
+    snap1["generated"] = [3, 1]
+    snap1["replay"] = []
+    whole = pool.spill(st, swaps={"tid-1": snap1}, swap_store=swap)
+    assert set(whole["swapped"]) == {"tid-1"}
+    # the folded entry re-published the segment into the spill store:
+    # dropping the ORIGINAL swap store must not lose it
+    swap.drop(snap1["ref"])
+    swap.close()
+    swap2 = BlockStore(root=str(tmp_path / "swap2"), budget_bytes=0)
+    manifest = pool.adopt_swapped(st, whole, swap2)
+    assert set(manifest) == {"tid-1"}
+    entry = manifest["tid-1"]
+    assert entry["pos"] == 7 and entry["generated"] == [3, 1]
+    assert int(entry["pages"]) == 2
+    got = swap2.get(entry["ref"])
+    for k in payload:
+        np.testing.assert_array_equal(np.asarray(got[k]), payload[k])
+    # restore() with a swap_store returns the same manifest alongside
+    # the bit-identical pool rehydration
+    pool.free_seq(1)
+    swap3 = BlockStore(root=str(tmp_path / "swap3"), budget_bytes=0)
+    manifest2 = pool.restore(st, whole, swap_store=swap3)
+    assert set(manifest2) == {"tid-1"}
+    pool.check()
+    for s in (st, swap2, swap3):
+        s.close()
+
+
 # ---------------------------------------------------------------------------
 # concurrency: loader-thread puts while the consumer gets
 # ---------------------------------------------------------------------------

@@ -1,0 +1,547 @@
+"""Registered query endpoints (ISSUE 20): the result-cache and
+incremental-maintenance contracts.
+
+What must hold, stated in serving/query.py: a repeat query over
+unchanged inputs is a cache hit (memo or persistent store — zero chunk
+reads, zero plan executions); appending a chunk to the scan directory
+invalidates with a COUNTED invalidation and an eligible aggregate
+refreshes by re-reading/re-executing ONLY the new chunk, bit-identical
+to the one-shot full-table query across ops × dtypes × key kinds ×
+ragged chunk sizes; anything outside the incremental contract degrades
+to counted full recompute with a named reason (and TFG114 evidence) —
+never a wrong answer; a damaged cached partial is quarantined, counted
+as ``corrupt_partial``, and recomputed exactly; and a re-registered
+endpoint over the same cache dir warms from DISK with zero chunk
+executions.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.config import get_config
+from tensorframes_tpu.observability import context as _ctx
+from tensorframes_tpu.plan import ir as plan_ir
+from tensorframes_tpu.plan.lower import canonical_table_order
+from tensorframes_tpu.serving import (
+    QueryEndpoint,
+    QuerySource,
+    RejectedError,
+    Server,
+    query_cache_events,
+    serve_http,
+)
+from tensorframes_tpu.validation import ValidationError
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    """Point the compile cache (and hence the result store + plan-stats
+    sidecar) at a per-test dir; restore afterwards."""
+    prev = get_config().compilation_cache_dir
+    d = str(tmp_path / "cache")
+    tfs.configure(compilation_cache_dir=d)
+    yield d
+    tfs.configure(compilation_cache_dir=prev)
+
+
+def _write_chunk(data_dir, i, rows):
+    """One CSV part; ``rows`` is a list of (k, v) tuples (may be empty:
+    header-only parts must parse as zero rows, not fail)."""
+    path = os.path.join(data_dir, f"part-{i:04d}.csv")
+    with open(path, "w") as fh:
+        fh.write("k,v\n")
+        for k, v in rows:
+            fh.write(f"{k},{v}\n")
+    return path
+
+
+def _ragged_rows(n, seed, key_kind, dtype):
+    rng = np.random.default_rng(seed)
+    out = []
+    for j in range(n):
+        g = int(rng.integers(0, 3))
+        k = f"grp{g}" if key_kind == "string" else g
+        v = int(rng.integers(-50, 50))
+        out.append((k, v if dtype == "int64" else float(v) + 0.5))
+    return out
+
+
+def _table_rows(table, keys):
+    """(key-tuple → {out: scalar}) for order-insensitive comparison."""
+    names = [n for n in table if n not in keys]
+    n = len(next(iter(table.values())))
+    out = {}
+    for i in range(n):
+        kt = tuple(np.asarray(table[k])[i] for k in keys)
+        out[kt] = {m: np.asarray(table[m])[i] for m in names}
+    return out
+
+
+def _assert_tables_equal(got, want, keys):
+    a, b = _table_rows(got, keys), _table_rows(want, keys)
+    assert set(a) == set(b), (sorted(a), sorted(b))
+    for kt in a:
+        for m in a[kt]:
+            ga, gb = a[kt][m], b[kt][m]
+            assert ga.dtype == gb.dtype, (kt, m, ga.dtype, gb.dtype)
+            assert np.array_equal(ga, gb), (kt, m, ga, gb)
+
+
+def _build_fn(op):
+    """map (dtype-preserving) → keyed aggregate: the canonical
+    registered pipeline. ``op`` ∈ sum|min|max|mean."""
+    red = {
+        "sum": tfs.reduce_sum, "min": tfs.reduce_min,
+        "max": tfs.reduce_max, "mean": tfs.reduce_mean,
+    }[op]
+
+    def build(f):
+        f1 = tfs.map_blocks(lambda v: {"y": v * 2}, f)
+        with tfs.with_graph():
+            y_in = tfs.block(f1, "y", tf_name="y_input")
+            return tfs.aggregate(
+                [red(y_in, axis=0, name="y")], f1.group_by("k")
+            )
+
+    return build
+
+
+def _oracle(data_dir, build, dtypes):
+    """The one-shot full-table query a non-registered user would run:
+    every part concatenated into ONE frame, the same build fn executed
+    once over it. The registered endpoint's answer (cached, folded, or
+    recomputed) must equal this bit-for-bit."""
+    from tensorframes_tpu.io import part_frame, part_manifest
+
+    frames = [
+        part_frame(p, kind="csv", dtypes=dtypes)
+        for p, _ in part_manifest(data_dir, kind="csv")
+    ]
+    frames = [f for f in frames if f.num_rows > 0]
+    cols = {}
+    for info in frames[0].schema:
+        parts = [f.column_values(info.name) for f in frames]
+        if any(p.dtype == object for p in parts):
+            merged = []
+            for p in parts:
+                merged.extend(p.tolist())
+            cols[info.name] = merged
+        else:
+            cols[info.name] = np.concatenate(parts)
+    full = tfs.frame_from_arrays(cols, num_blocks=1)
+    out = build(full)
+    return {n: out.column_values(n) for n in out.schema.names}
+
+
+# ---------------------------------------------------------------------------
+# property sweep: ops × dtypes × key kinds × ragged chunks, every
+# refresh bit-equal to the one-shot full-table query
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["sum", "min", "max", "mean"])
+@pytest.mark.parametrize("key_kind", ["string", "int"])
+@pytest.mark.parametrize("dtype", ["int64", "float64"])
+def test_refresh_bit_equal_full_recompute(
+    tmp_path, cache_dir, op, key_kind, dtype
+):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    sizes = [7, 0, 23, 1]  # ragged, incl. a header-only part
+    for i, n in enumerate(sizes):
+        _write_chunk(data, i, _ragged_rows(n, 100 + i, key_kind, dtype))
+    build = _build_fn(op)
+    q = QueryEndpoint(
+        f"sweep-{op}-{key_kind}-{dtype}",
+        QuerySource(path=data, kind="csv"), build,
+    )
+    dtypes = q._csv_dtypes
+    # eligibility is a pure function of (op, dtype) — mean never folds,
+    # float sums reassociate, min/max fold at any dtype
+    if plan_ir.fusion_enabled():
+        expect_inc = op in ("min", "max") or (
+            op == "sum" and dtype == "int64"
+        )
+        assert q.cache_stats()["incremental"] == expect_inc
+        assert q.cache_stats()["cacheable"]
+    _assert_tables_equal(q.execute(), _oracle(data, build, dtypes),
+                         ("k",))
+    # append a ragged tail (incl. another empty part), refresh each time
+    for i, n in enumerate([5, 0, 31], start=len(sizes)):
+        _write_chunk(data, i, _ragged_rows(n, 200 + i, key_kind, dtype))
+        _assert_tables_equal(q.execute(), _oracle(data, build, dtypes),
+                             ("k",))
+    # rewrite chunk 0 in place (same path, new content + signature)
+    _write_chunk(data, 0, _ragged_rows(11, 999, key_kind, dtype))
+    _assert_tables_equal(q.execute(), _oracle(data, build, dtypes),
+                         ("k",))
+    cs = q.cache_stats()
+    assert cs["invalidations"] == 4  # 3 appends + 1 rewrite
+    if plan_ir.fusion_enabled() and q.cache_stats()["incremental"]:
+        # each refresh re-executed ONLY the changed/new chunks: 5 non-
+        # empty initial + 3 appended (one empty still folds its typed
+        # empty partial... it executes once) + 1 rewrite
+        assert cs["chunks_folded"] > 0
+        assert cs["chunks_executed"] == len(sizes) + 3 + 1
+
+
+def test_incremental_refresh_reexecutes_only_new_chunks(
+    tmp_path, cache_dir
+):
+    if not plan_ir.fusion_enabled():
+        pytest.skip("plan chain does not record under TFTPU_FUSION=0")
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    for i in range(6):
+        _write_chunk(data, i, _ragged_rows(20, i, "string", "int64"))
+    build = _build_fn("sum")
+    q = QueryEndpoint("inc", QuerySource(path=data, kind="csv"), build)
+    q.execute()
+    base = q.cache_stats()
+    assert base["chunks_executed"] == 6
+    _write_chunk(data, 6, _ragged_rows(20, 60, "string", "int64"))
+    q.execute()
+    cs = q.cache_stats()
+    assert cs["chunks_executed"] == 7, "an old chunk was re-executed"
+    assert cs["chunks_folded"] - base["chunks_folded"] == 6
+    assert cs["invalidations"] == 1
+    assert cs["recomputes"]["cold"] >= 1
+    # repeat: pure memo hit, nothing read or folded
+    q.execute()
+    cs2 = q.cache_stats()
+    assert cs2["hits"] == cs["hits"] + 1
+    assert cs2["chunks_executed"] == cs["chunks_executed"]
+    assert cs2["chunks_folded"] == cs["chunks_folded"]
+
+
+# ---------------------------------------------------------------------------
+# server lifecycle: warm-at-start, repeat hits, restart-from-disk,
+# admission taxonomy
+# ---------------------------------------------------------------------------
+
+def test_server_registered_query_lifecycle(tmp_path, cache_dir):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    for i in range(3):
+        _write_chunk(data, i, _ragged_rows(15, i, "string", "int64"))
+    build = _build_fn("sum")
+    srv = Server()
+    q = srv.register_query(
+        "daily", QuerySource(path=data, kind="csv"), build
+    )
+    # pre-start: admission closed, counted rejection
+    with pytest.raises(RejectedError) as ei:
+        q.submit(None)
+    assert ei.value.reason == "closed"
+    # duplicate names refuse across every endpoint kind
+    with pytest.raises(ValueError):
+        srv.register_query(
+            "daily", QuerySource(path=data, kind="csv"), build
+        )
+    with pytest.raises(ValueError):
+        srv.register_query(
+            "a/b", QuerySource(path=data, kind="csv"), build
+        )
+    srv.start()
+    try:
+        assert "daily" in srv.endpoints()
+        assert srv.warmup_reports["daily"]["rows"] == 3
+        t1 = srv.call("daily", None)
+        t2 = srv.call("daily", {})
+        for k in t1:
+            assert np.array_equal(t1[k], t2[k])
+        cs = q.cache_stats()
+        assert cs["hits"] >= 2  # warm primed the cache
+        # feeds are meaningless for a registered query: loud refusal
+        with pytest.raises(ValidationError):
+            srv.call("daily", {"x": np.zeros(3)})
+        with pytest.raises(ValueError):
+            srv.call("daily", None, deadline_s=-1)
+        st = srv.stats()
+        assert st["queries"]["daily"]["hits"] >= 2
+        assert st["admitted_requests"] >= 2
+        assert "daily" in st["latency"]
+    finally:
+        srv.stop()
+    with pytest.raises(RejectedError):
+        q.submit(None)
+
+
+def test_reregistration_warms_from_disk(tmp_path, cache_dir):
+    if not plan_ir.fusion_enabled():
+        pytest.skip("persistent result store disarms under FUSION=0")
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    for i in range(4):
+        _write_chunk(data, i, _ragged_rows(12, i, "string", "int64"))
+    build = _build_fn("sum")
+    srv = Server()
+    srv.register_query(
+        "q", QuerySource(path=data, kind="csv"), build
+    )
+    srv.start()
+    first = srv.call("q", None)
+    srv.stop()
+    # a FRESH server over the same cache dir: registration re-probes
+    # (reads one chunk), but warm answers from the persistent store —
+    # zero chunk executions, bit-identical table
+    srv2 = Server()
+    q2 = srv2.register_query(
+        "q", QuerySource(path=data, kind="csv"), build
+    )
+    srv2.start()
+    try:
+        cs = q2.cache_stats()
+        assert cs["chunks_executed"] == 0
+        assert cs["hits"] == 1 and cs["misses"] == 0
+        again = srv2.call("q", None)
+        for k in first:
+            assert first[k].dtype == again[k].dtype
+            assert np.array_equal(first[k], again[k])
+    finally:
+        srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# corruption: a damaged cached partial degrades to counted recompute,
+# never a wrong answer
+# ---------------------------------------------------------------------------
+
+def test_corrupt_partial_counted_recompute_exact(tmp_path, cache_dir):
+    if not plan_ir.fusion_enabled():
+        pytest.skip("persistent partials disarm under FUSION=0")
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    for i in range(4):
+        _write_chunk(data, i, _ragged_rows(10, i, "string", "int64"))
+    build = _build_fn("sum")
+    src = QuerySource(path=data, kind="csv")
+    q = QueryEndpoint("qc", src, build)
+    q.execute()
+    results_dir = os.path.join(cache_dir, "results")
+    partials = [f for f in os.listdir(results_dir) if "-p" in f]
+    assert len(partials) == 4
+    for fn in partials:  # flip one payload byte in EVERY partial
+        p = os.path.join(results_dir, fn)
+        with open(p, "r+b") as fh:
+            fh.seek(-1, os.SEEK_END)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_END)
+            fh.write(bytes([b[0] ^ 0xFF]))
+    # fresh endpoint (empty memo), grown directory (forces the
+    # incremental path past the cached full result)
+    _write_chunk(data, 4, _ragged_rows(10, 40, "string", "int64"))
+    q2 = QueryEndpoint("qc", src, build)
+    table = q2.execute()
+    cs = q2.cache_stats()
+    assert cs["recomputes"]["corrupt_partial"] == 4
+    assert cs["chunks_executed"] == 5  # every damaged partial re-ran
+    _assert_tables_equal(
+        table, _oracle(data, build, q2._csv_dtypes), ("k",)
+    )
+    # the quarantine renamed the damaged entries: a THIRD endpoint
+    # sees clean rewritten partials and folds without re-executing
+    q3 = QueryEndpoint("qc", src, build)
+    q3.execute()
+    assert q3.cache_stats()["chunks_executed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# TFG114: the decline taxonomy names the blocking stage
+# ---------------------------------------------------------------------------
+
+def test_tfg114_decline_reasons_and_lint(tmp_path, cache_dir):
+    if not plan_ir.fusion_enabled():
+        pytest.skip("declines are operator-chosen under FUSION=0, "
+                    "no TFG114 evidence by design")
+    from tensorframes_tpu.analysis import lint_plan
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    _write_chunk(data, 0, _ragged_rows(25, 0, "string", "float64"))
+    src = QuerySource(path=data, kind="csv")
+
+    mine = {"e_float", "e_mean", "e_ck", "e_map"}
+
+    def by_reason():
+        # events are process-global and survive earlier tests in the
+        # session: filter to THIS test's endpoints
+        out = {}
+        for e in query_cache_events():
+            if e["endpoint"] in mine:
+                out.setdefault(e["reason"], []).append(e)
+        return out
+
+    # float accumulation: sum over float64 reassociates across chunks
+    QueryEndpoint("e_float", src, _build_fn("sum"))
+    # mean: partials would need a (sum, count) companion pair
+    QueryEndpoint("e_mean", src, _build_fn("mean"))
+
+    # computed key: the group key comes out of a map stage
+    def build_ck(f):
+        f1 = tfs.map_blocks(lambda v: {"k2": (v > 0)}, f)
+        with tfs.with_graph():
+            v_in = tfs.block(f1, "v", tf_name="v_input")
+            return tfs.aggregate(
+                [tfs.reduce_min(v_in, axis=0, name="v")],
+                f1.group_by("k2"),
+            )
+    QueryEndpoint("e_ck", src, build_ck)
+
+    # no terminal aggregate: a map-only pipeline still caches, but
+    # refreshes re-execute everything
+    QueryEndpoint(
+        "e_map", src,
+        lambda f: tfs.map_blocks(lambda v: {"y": v * 3.0}, f),
+    )
+    evs = by_reason()
+    assert [e["endpoint"] for e in evs["float_accumulation"]] == \
+        ["e_float"]
+    assert [e["endpoint"] for e in evs["reduce_mean"]] == ["e_mean"]
+    assert [e["endpoint"] for e in evs["computed_key"]] == ["e_ck"]
+    assert [e["endpoint"] for e in evs["no_terminal_aggregate"]] == \
+        ["e_map"]
+    assert all(
+        e["mode"] == "incremental"
+        for es in evs.values() for e in es
+    )
+    # lint_plan surfaces each with an actionable fix
+    fr = tfs.frame_from_arrays({"v": np.arange(4.0)})
+    lazy = tfs.map_blocks(lambda v: {"y": v + 1.0}, fr)
+    rep = lint_plan(lazy)
+    found = [d for d in rep.diagnostics
+             if d.code == "TFG114" and d.subject in mine]
+    assert len(found) == 4
+    for d in found:
+        assert d.fix, d
+    # every decline still answers (counted full recompute)
+    q = QueryEndpoint("e_exec", src, _build_fn("mean"))
+    q.execute()
+    assert q.cache_stats()["recomputes"]["ineligible"] == 1
+
+
+def test_registration_rollback_withdraws_tfg114(tmp_path, cache_dir):
+    if not plan_ir.fusion_enabled():
+        pytest.skip("no TFG114 evidence under FUSION=0")
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    _write_chunk(data, 0, _ragged_rows(8, 0, "string", "float64"))
+    srv = Server()
+    srv.start()
+    try:
+        # live registration: probe succeeds (evidence recorded), warm
+        # fails → rollback must withdraw the endpoint AND its evidence
+        class Boom(RuntimeError):
+            pass
+
+        def build(f):
+            out = _build_fn("mean")(f)
+            if getattr(build, "armed", False):
+                raise Boom()
+            return out
+
+        srv.register_query(
+            "ghost", QuerySource(path=data, kind="csv"), build
+        )
+        assert any(e["endpoint"] == "ghost"
+                   for e in query_cache_events())
+        srv2_names = srv.endpoints()
+        assert "ghost" in srv2_names
+    finally:
+        srv.stop()
+    # stopping is not withdrawal (the endpoint still exists on the
+    # server object); rollback is exercised via a warm failure
+    srv3 = Server()
+    srv3.start()
+    try:
+        def build_fail(f):
+            raise RuntimeError("broken build")
+
+        with pytest.raises(RuntimeError):
+            srv3.register_query(
+                "broken", QuerySource(path=data, kind="csv"),
+                build_fail,
+            )
+        assert "broken" not in srv3.endpoints()
+        assert not any(e["endpoint"] == "broken"
+                       for e in query_cache_events())
+    finally:
+        srv3.stop()
+
+
+# ---------------------------------------------------------------------------
+# sources: frames, parquet gating, empty dirs
+# ---------------------------------------------------------------------------
+
+def test_frame_source_and_validation(tmp_path, cache_dir):
+    fr = tfs.frame_from_arrays({
+        "k": np.arange(12, dtype=np.int64) % 3,
+        "v": np.arange(12, dtype=np.int64),
+    })
+    q = QueryEndpoint(
+        "mem", QuerySource(frame=fr), _build_fn("sum")
+    )
+    t = q.execute()
+    want = canonical_table_order(
+        {"k": np.arange(3, dtype=np.int64),
+         "y": np.array([2 * (0 + 3 + 6 + 9), 2 * (1 + 4 + 7 + 10),
+                        2 * (2 + 5 + 8 + 11)])},
+        ("k",),
+    )
+    _assert_tables_equal(t, want, ("k",))
+    q.execute()
+    assert q.cache_stats()["hits"] == 1  # digest-stable frame memoizes
+    with pytest.raises(ValueError):
+        QuerySource()  # neither path nor frame
+    with pytest.raises(ValueError):
+        QuerySource(path="/x", frame=fr)  # both
+    with pytest.raises(ValueError):
+        QuerySource(path="/x", kind="orc")
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    with pytest.raises((ValueError, FileNotFoundError)):
+        QueryEndpoint(
+            "e", QuerySource(path=empty, kind="csv"), _build_fn("sum")
+        )
+
+
+# ---------------------------------------------------------------------------
+# HTTP: a registered query rides the same adapter (string keys take
+# the object-dtype serialization path)
+# ---------------------------------------------------------------------------
+
+def test_http_serves_registered_query(tmp_path, cache_dir):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    for i in range(2):
+        _write_chunk(data, i, _ragged_rows(9, i, "string", "int64"))
+    srv = Server()
+    srv.register_query(
+        "web", QuerySource(path=data, kind="csv"), _build_fn("sum")
+    )
+    srv.start()
+    httpd = serve_http(srv, port=0)
+    port = httpd.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/web",
+            data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        want = _oracle(data, _build_fn("sum"), None)
+        want = canonical_table_order(want, ("k",))
+        srt = np.argsort(np.asarray(body["outputs"]["k"], dtype=object))
+        got_k = [body["outputs"]["k"][i] for i in srt]
+        got_y = [body["outputs"]["y"][i] for i in srt]
+        assert got_k == list(want["k"])
+        assert got_y == list(want["y"])
+        assert body["rows"] == len(want["k"])
+    finally:
+        httpd.shutdown()
+        srv.stop()
